@@ -1,0 +1,32 @@
+from .base import ConfigModel, ConfigError
+from .config import (
+    DeepSpeedConfig,
+    OptimizerConfig,
+    SchedulerConfig,
+    FP16Config,
+    BF16Config,
+    ZeroConfig,
+    MeshConfig,
+    OffloadDeviceEnum,
+    ActivationCheckpointingConfig,
+    CommsLoggerConfig,
+    FlopsProfilerConfig,
+    load_config,
+)
+
+__all__ = [
+    "ConfigModel",
+    "ConfigError",
+    "DeepSpeedConfig",
+    "OptimizerConfig",
+    "SchedulerConfig",
+    "FP16Config",
+    "BF16Config",
+    "ZeroConfig",
+    "MeshConfig",
+    "OffloadDeviceEnum",
+    "ActivationCheckpointingConfig",
+    "CommsLoggerConfig",
+    "FlopsProfilerConfig",
+    "load_config",
+]

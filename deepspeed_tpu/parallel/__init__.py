@@ -1,0 +1,27 @@
+from .topology import (
+    ProcessTopology,
+    PipelineParallelGrid,
+    build_mesh,
+    resolve_mesh_dims,
+    topology_from_mesh_dims,
+    DATA_AXIS,
+    MODEL_AXIS,
+    PIPE_AXIS,
+    SEQ_AXIS,
+    EXPERT_AXIS,
+    CANONICAL_AXIS_ORDER,
+)
+
+__all__ = [
+    "ProcessTopology",
+    "PipelineParallelGrid",
+    "build_mesh",
+    "resolve_mesh_dims",
+    "topology_from_mesh_dims",
+    "DATA_AXIS",
+    "MODEL_AXIS",
+    "PIPE_AXIS",
+    "SEQ_AXIS",
+    "EXPERT_AXIS",
+    "CANONICAL_AXIS_ORDER",
+]

@@ -1,0 +1,4 @@
+from .logging import logger, log_dist
+from .timer import SynchronizedWallClockTimer, ThroughputTimer
+
+__all__ = ["logger", "log_dist", "SynchronizedWallClockTimer", "ThroughputTimer"]

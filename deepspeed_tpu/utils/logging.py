@@ -1,0 +1,68 @@
+"""Rank-aware logging.
+
+TPU-native equivalent of the reference's ``deepspeed/utils/logging.py`` (``log_dist``,
+``logger``): the same rank-filtered logging surface, with ranks taken from
+``jax.process_index()`` instead of ``torch.distributed``.
+"""
+
+import logging
+import os
+import sys
+import functools
+
+LOG_LEVELS = {
+    "debug": logging.DEBUG,
+    "info": logging.INFO,
+    "warning": logging.WARNING,
+    "error": logging.ERROR,
+    "critical": logging.CRITICAL,
+}
+
+
+@functools.lru_cache(None)
+def _create_logger(name="deepspeed_tpu", level=logging.INFO):
+    logger_ = logging.getLogger(name)
+    logger_.setLevel(level)
+    logger_.propagate = False
+    if not logger_.handlers:
+        handler = logging.StreamHandler(stream=sys.stdout)
+        handler.setFormatter(
+            logging.Formatter(
+                "[%(asctime)s] [%(levelname)s] [%(name)s:%(lineno)d] %(message)s"
+            )
+        )
+        logger_.addHandler(handler)
+    return logger_
+
+
+logger = _create_logger(
+    level=LOG_LEVELS.get(os.environ.get("DS_TPU_LOG_LEVEL", "info").lower(), logging.INFO)
+)
+
+
+def _process_index():
+    try:
+        import jax
+
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
+def log_dist(message, ranks=None, level=logging.INFO):
+    """Log ``message`` only on the given process ranks (None / [-1] = all ranks).
+
+    Mirrors the reference's ``log_dist`` semantics (deepspeed/utils/logging.py).
+    """
+    my_rank = _process_index()
+    if ranks is None or -1 in ranks or my_rank in ranks:
+        logger.log(level, f"[Rank {my_rank}] {message}")
+
+
+def warning_once(message):
+    _warn_once(message)
+
+
+@functools.lru_cache(None)
+def _warn_once(message):
+    logger.warning(message)

@@ -1,0 +1,51 @@
+"""Test harness configuration.
+
+The reference simulates multi-node as multi-process on localhost
+(``tests/unit/common.py:86`` DistributedExec). On TPU we instead virtualize: force the
+CPU platform with 8 XLA host devices, so every test sees an 8-device mesh and the same
+SPMD programs that run on a TPU slice compile and execute here. This must run before
+jax is imported anywhere.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"  # force: the shell presets JAX_PLATFORMS=axon (TPU)
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+
+# The axon boot hook (sitecustomize) programmatically sets jax_platforms="axon,cpu",
+# which overrides the env var — force CPU at the config level before backend init.
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices8():
+    import jax
+
+    devs = jax.devices()
+    assert len(devs) >= 8, f"expected >=8 virtual devices, got {len(devs)}"
+    return devs[:8]
+
+
+@pytest.fixture
+def mesh8(devices8):
+    """Canonical 8-device mesh: pure data-parallel by default."""
+    from deepspeed_tpu.parallel import build_mesh
+    from deepspeed_tpu.config import MeshConfig
+
+    return build_mesh(MeshConfig(), devices=devices8)
+
+
+@pytest.fixture
+def mesh_2d(devices8):
+    """data=4 x model=2 mesh for TP tests."""
+    from deepspeed_tpu.parallel import build_mesh
+    from deepspeed_tpu.config import MeshConfig
+
+    return build_mesh(MeshConfig(data=4, model=2), devices=devices8)

@@ -1,0 +1,123 @@
+"""Config system tests (reference analogue: tests/unit/runtime/test_ds_config_dict.py)."""
+
+import json
+
+import pytest
+
+from deepspeed_tpu.config import (
+    ConfigError,
+    DeepSpeedConfig,
+    load_config,
+    OffloadDeviceEnum,
+)
+
+
+def test_defaults():
+    cfg = DeepSpeedConfig.from_dict({"train_micro_batch_size_per_gpu": 2})
+    assert cfg.zero_optimization.stage == 0
+    assert not cfg.fp16.enabled
+    assert not cfg.bf16.enabled
+    assert cfg.steps_per_print == 10
+    assert cfg.gradient_clipping == 0.0
+
+
+def test_batch_triangle_infer_gas():
+    cfg = DeepSpeedConfig.from_dict(
+        {"train_batch_size": 32, "train_micro_batch_size_per_gpu": 2}
+    )
+    tbs, micro, gas = cfg.resolve_batch_size(dp_world_size=4)
+    assert (tbs, micro, gas) == (32, 2, 4)
+
+
+def test_batch_triangle_infer_micro():
+    cfg = DeepSpeedConfig.from_dict(
+        {"train_batch_size": 32, "gradient_accumulation_steps": 2}
+    )
+    tbs, micro, gas = cfg.resolve_batch_size(dp_world_size=4)
+    assert (tbs, micro, gas) == (32, 4, 2)
+
+
+def test_batch_triangle_infer_total():
+    cfg = DeepSpeedConfig.from_dict({"train_micro_batch_size_per_gpu": 3})
+    tbs, micro, gas = cfg.resolve_batch_size(dp_world_size=8)
+    assert (tbs, micro, gas) == (24, 3, 1)
+
+
+def test_batch_triangle_violation():
+    cfg = DeepSpeedConfig.from_dict(
+        {
+            "train_batch_size": 30,
+            "train_micro_batch_size_per_gpu": 2,
+            "gradient_accumulation_steps": 2,
+        }
+    )
+    with pytest.raises(ConfigError):
+        cfg.resolve_batch_size(dp_world_size=4)
+
+
+def test_batch_triangle_missing():
+    cfg = DeepSpeedConfig.from_dict({})
+    with pytest.raises(ConfigError):
+        cfg.resolve_batch_size(dp_world_size=4)
+
+
+def test_fp16_bf16_exclusive():
+    with pytest.raises(ConfigError):
+        DeepSpeedConfig.from_dict(
+            {"fp16": {"enabled": True}, "bf16": {"enabled": True}}
+        )
+
+
+def test_zero_stage_validation():
+    with pytest.raises(ConfigError):
+        DeepSpeedConfig.from_dict({"zero_optimization": {"stage": 5}})
+
+
+def test_zero_deprecated_keys():
+    cfg = DeepSpeedConfig.from_dict(
+        {
+            "zero_optimization": {
+                "stage": 3,
+                "stage3_prefetch_bucket_size": 123,
+                "cpu_offload": True,
+            }
+        }
+    )
+    assert cfg.zero_optimization.prefetch_bucket_size == 123
+    assert cfg.zero_optimization.offload_optimizer.device == OffloadDeviceEnum.cpu
+
+
+def test_load_from_json_file(tmp_path):
+    path = tmp_path / "ds_config.json"
+    path.write_text(
+        json.dumps(
+            {
+                "train_batch_size": 16,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                "fp16": {"enabled": True, "initial_scale_power": 8},
+            }
+        )
+    )
+    cfg = load_config(str(path))
+    assert cfg.optimizer.type == "Adam"
+    assert cfg.optimizer.params["lr"] == 1e-3
+    assert cfg.fp16.initial_scale_power == 8
+    assert cfg.mixed_precision_dtype == "float16"
+
+
+def test_unknown_keys_ignored_with_warning():
+    cfg = DeepSpeedConfig.from_dict(
+        {"train_batch_size": 8, "some_future_key": {"a": 1}}
+    )
+    assert cfg.train_batch_size == 8
+
+
+def test_roundtrip():
+    d = {
+        "train_batch_size": 8,
+        "zero_optimization": {"stage": 2},
+        "bf16": {"enabled": True},
+    }
+    cfg = DeepSpeedConfig.from_dict(d)
+    cfg2 = DeepSpeedConfig.from_dict(cfg.to_dict())
+    assert cfg == cfg2

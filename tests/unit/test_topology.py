@@ -1,0 +1,78 @@
+"""Topology / mesh tests (reference analogue: tests/unit/runtime/pipe/test_topology.py)."""
+
+import pytest
+
+from deepspeed_tpu.config import ConfigError, MeshConfig
+from deepspeed_tpu.parallel import (
+    ProcessTopology,
+    PipelineParallelGrid,
+    build_mesh,
+    resolve_mesh_dims,
+    topology_from_mesh_dims,
+)
+
+
+def test_topology_rank_math():
+    topo = ProcessTopology(axes=["pipe", "data"], dims=[2, 4])
+    assert topo.world_size() == 8
+    assert topo.get_rank(pipe=0, data=0) == 0
+    assert topo.get_rank(pipe=0, data=3) == 3
+    assert topo.get_rank(pipe=1, data=0) == 4
+    coord = topo.get_coord(5)
+    assert coord.pipe == 1 and coord.data == 1
+
+
+def test_topology_axis_lists():
+    topo = ProcessTopology(axes=["pipe", "data"], dims=[2, 2])
+    # data comm lists: ranks varying only in data
+    lists = topo.get_axis_comm_lists("data")
+    assert lists == [[0, 1], [2, 3]]
+    lists = topo.get_axis_comm_lists("pipe")
+    assert lists == [[0, 2], [1, 3]]
+
+
+def test_topology_filter_and_axis_list():
+    topo = ProcessTopology(axes=["pipe", "data", "model"], dims=[2, 2, 2])
+    assert topo.get_axis_list("model", 0) == [0, 2, 4, 6]
+    assert topo.filter_match(pipe=1, model=1) == [5, 7]
+
+
+def test_topology_rank_repr():
+    topo = ProcessTopology(axes=["pipe", "data", "model"], dims=[2, 2, 2])
+    assert topo.get_rank_repr(0) == "model_00"
+    assert topo.get_rank_repr(1) == "model_01"
+
+
+def test_resolve_mesh_dims_infer():
+    dims = resolve_mesh_dims(MeshConfig(), 8)
+    assert dims["data"] == 8
+    assert dims["model"] == dims["pipe"] == dims["seq"] == dims["expert"] == 1
+
+    dims = resolve_mesh_dims(MeshConfig(model=2), 8)
+    assert dims["data"] == 4 and dims["model"] == 2
+
+
+def test_resolve_mesh_dims_errors():
+    with pytest.raises(ConfigError):
+        resolve_mesh_dims(MeshConfig(data=3, model=2), 8)
+    with pytest.raises(ConfigError):
+        resolve_mesh_dims(MeshConfig(model=3), 8)
+
+
+def test_build_mesh(devices8):
+    mesh = build_mesh(MeshConfig(data=4, model=2), devices=devices8)
+    assert mesh.shape["data"] == 4
+    assert mesh.shape["model"] == 2
+    assert set(mesh.axis_names) == {"pipe", "data", "expert", "seq", "model"}
+
+
+def test_pipeline_grid():
+    topo = topology_from_mesh_dims({"pipe": 2, "data": 2, "model": 2})
+    grid = PipelineParallelGrid(topo)
+    assert grid.pipe_parallel_size == 2
+    assert grid.data_parallel_size == 2
+    assert grid.is_first_stage(0)
+    assert grid.is_last_stage(7)
+    assert grid.stage_of_rank(4) == 1
+    # dp group of rank 0: same pipe/model coords, varying data
+    assert grid.dp_group_of_rank(0) == [0, 2]
