@@ -1,0 +1,3 @@
+from .engine import CheckpointEngine, NpzCheckpointEngine, AsyncCheckpointEngine
+
+__all__ = ["CheckpointEngine", "NpzCheckpointEngine", "AsyncCheckpointEngine"]

@@ -117,7 +117,9 @@ def all_reduce(tensor, axis_name, op=ReduceOp.SUM):
     if op == ReduceOp.MIN:
         return jax.lax.pmin(tensor, axis_name)
     if op == ReduceOp.PROD:
-        return jnp.exp(jax.lax.psum(jnp.log(tensor), axis_name))
+        # exact product: gather then multiply (no log-space sign/zero pitfalls)
+        gathered = jax.lax.all_gather(tensor, axis_name, axis=0)
+        return jnp.prod(gathered, axis=0)
     raise ValueError(f"unsupported reduce op {op}")
 
 
@@ -241,12 +243,27 @@ def barrier():
 
 def broadcast_obj(obj, src=0):
     """Host-side object broadcast (reference ``pipe/p2p.py:100`` send_obj /
-    engine broadcasts of small python objects)."""
+    engine broadcasts of small python objects).
+
+    Arbitrary picklable objects: pickled to bytes, length broadcast first (fixed
+    shape), then the padded payload — multihost broadcast only moves numeric arrays.
+    """
     if jax.process_count() == 1:
         return obj
+    import pickle
+
     from jax.experimental import multihost_utils
 
-    return multihost_utils.broadcast_one_to_all(obj, is_source=jax.process_index() == src)
+    is_source = jax.process_index() == src
+    payload = np.frombuffer(pickle.dumps(obj), dtype=np.uint8) if is_source else np.zeros(0, np.uint8)
+    length = multihost_utils.broadcast_one_to_all(
+        np.asarray(payload.size, np.int32), is_source=is_source
+    )
+    buf = np.zeros(int(length), np.uint8)
+    if is_source:
+        buf[:] = payload
+    buf = multihost_utils.broadcast_one_to_all(buf, is_source=is_source)
+    return pickle.loads(np.asarray(buf).tobytes())
 
 
 @contextmanager

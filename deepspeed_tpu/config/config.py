@@ -257,6 +257,12 @@ class DeepSpeedConfig(ConfigModel):
         tbs = self.train_batch_size
         micro = self.train_micro_batch_size_per_gpu
         gas = self.gradient_accumulation_steps
+        for name, v in (("train_batch_size", tbs),
+                        ("train_micro_batch_size_per_gpu", micro),
+                        ("gradient_accumulation_steps", gas),
+                        ("dp_world_size", dp_world_size)):
+            if v is not None and v <= 0:
+                raise ConfigError(f"{name} must be positive, got {v}")
 
         if tbs is not None and micro is not None and gas is None:
             gas, rem = divmod(tbs, micro * dp_world_size)

@@ -1,0 +1,61 @@
+"""Environment doctor.
+
+TPU-native equivalent of the reference's ``deepspeed/env_report.py`` / ``bin/ds_report``:
+prints framework, JAX/jaxlib versions, device inventory, and which optional
+subsystems are importable — the "op compatibility matrix" role.
+"""
+
+import importlib
+import sys
+
+
+GREEN_OK = "[OKAY]"
+RED_NO = "[NO]"
+
+
+def _try(modname):
+    try:
+        importlib.import_module(modname)
+        return True
+    except Exception:
+        return False
+
+
+def main():
+    import deepspeed_tpu
+
+    print("-" * 60)
+    print("DeepSpeed-TPU environment report")
+    print("-" * 60)
+    print(f"deepspeed_tpu ........ {deepspeed_tpu.__version__}")
+    print(f"python ............... {sys.version.split()[0]}")
+
+    try:
+        import jax
+        import jaxlib
+
+        print(f"jax / jaxlib ......... {jax.__version__} / {jaxlib.__version__}")
+        devices = jax.devices()
+        print(f"backend .............. {jax.default_backend()}")
+        print(f"devices .............. {len(devices)} x {devices[0].device_kind}")
+        print(f"process count ........ {jax.process_count()}")
+    except Exception as e:
+        print(f"jax .................. {RED_NO} ({e})")
+
+    print("-" * 60)
+    print("subsystem availability")
+    print("-" * 60)
+    for label, mod in [
+        ("pallas (TPU kernels)", "jax.experimental.pallas"),
+        ("torch (tensorboard/interop)", "torch"),
+        ("transformers (HF import)", "transformers"),
+        ("orbax (alt checkpointing)", "orbax.checkpoint"),
+        ("einops", "einops"),
+    ]:
+        print(f"{label:<30} {GREEN_OK if _try(mod) else RED_NO}")
+    print("-" * 60)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,3 @@
+from .runner import main, fetch_hostfile, parse_inclusion_exclusion
+
+__all__ = ["main", "fetch_hostfile", "parse_inclusion_exclusion"]

@@ -1,0 +1,126 @@
+"""The ``ds_tpu`` CLI launcher.
+
+TPU-native equivalent of the reference's ``deepspeed`` CLI
+(``bin/deepspeed`` -> ``launcher/runner.py:376 main`` -> per-node
+``launcher/launch.py:216``). On GPU clusters the launcher forks one process per
+device and wires NCCL rendezvous env; on TPU the unit is one process per *host*
+(all local chips belong to it), so:
+
+- single host: exec the script in-process-count-1 mode (JAX sees all local chips);
+- multi-host pods: each host runs the same command (GKE/`gcloud compute tpus
+  tpu-vm ssh --worker=all`); this launcher sets the rendezvous env
+  (``DS_TPU_COORDINATOR``/``DS_TPU_NUM_PROCESSES``/``DS_TPU_PROCESS_ID``) that
+  ``deepspeed_tpu.comm.init_distributed`` consumes, from flags or TPU metadata.
+
+Hostfile / --include / --exclude filters are parsed with the reference's syntax so
+existing job scripts port.
+"""
+
+import argparse
+import os
+import subprocess
+import sys
+
+from ..utils.logging import logger
+
+
+def parse_args(args=None):
+    parser = argparse.ArgumentParser(
+        description="DeepSpeed-TPU launcher", usage="ds_tpu [options] script.py [script args]"
+    )
+    parser.add_argument("--hostfile", type=str, default="",
+                        help="hostfile (reference syntax: '<host> slots=<n>')")
+    parser.add_argument("--include", type=str, default="",
+                        help="hosts to include, e.g. 'worker-0@worker-1'")
+    parser.add_argument("--exclude", type=str, default="",
+                        help="hosts to exclude")
+    parser.add_argument("--num_nodes", type=int, default=-1)
+    parser.add_argument("--master_addr", type=str, default="")
+    parser.add_argument("--master_port", type=int, default=8476)
+    parser.add_argument("--node_rank", type=int, default=-1,
+                        help="this host's index in the pod (auto from TPU metadata if unset)")
+    parser.add_argument("--deepspeed_config", type=str, default=None)
+    parser.add_argument("--module", action="store_true",
+                        help="run the target as 'python -m <module>'")
+    parser.add_argument("user_script", type=str, help="training script")
+    parser.add_argument("user_args", nargs=argparse.REMAINDER)
+    return parser.parse_args(args)
+
+
+def fetch_hostfile(path):
+    """Reference ``launcher/runner.py:188``: '<hostname> slots=<n>' lines."""
+    if not path or not os.path.isfile(path):
+        return {}
+    resource_pool = {}
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            try:
+                hostname, slots = line.split()
+                _, slot_count = slots.split("=")
+                resource_pool[hostname] = int(slot_count)
+            except ValueError:
+                raise ValueError(f"Hostfile contains a bad entry: {line!r}")
+    return resource_pool
+
+
+def parse_inclusion_exclusion(resource_pool, inclusion, exclusion):
+    """Reference ``launcher/runner.py:243`` filter syntax: 'host1@host2'."""
+    active = dict(resource_pool)
+    if inclusion:
+        wanted = set(inclusion.split("@"))
+        unknown = wanted - set(active)
+        if unknown:
+            raise ValueError(f"--include hosts not in hostfile: {sorted(unknown)}")
+        active = {h: s for h, s in active.items() if h in wanted}
+    if exclusion:
+        banned = set(exclusion.split("@"))
+        unknown = banned - set(active)
+        if unknown:
+            raise ValueError(f"--exclude hosts not in hostfile: {sorted(unknown)}")
+        active = {h: s for h, s in active.items() if h not in banned}
+    return active
+
+
+def main(args=None):
+    args = parse_args(args)
+
+    env = os.environ.copy()
+    resource_pool = fetch_hostfile(args.hostfile)
+    if resource_pool:
+        resource_pool = parse_inclusion_exclusion(resource_pool, args.include, args.exclude)
+        hosts = sorted(resource_pool)
+        num_nodes = len(hosts) if args.num_nodes < 0 else args.num_nodes
+        master = args.master_addr or hosts[0]
+        node_rank = args.node_rank
+        if node_rank < 0:
+            import socket
+
+            hostname = socket.gethostname()
+            node_rank = hosts.index(hostname) if hostname in hosts else 0
+        env["DS_TPU_NUM_PROCESSES"] = str(num_nodes)
+        env["DS_TPU_COORDINATOR"] = master
+        env["DS_TPU_PROCESS_ID"] = str(node_rank)
+        env["MASTER_PORT"] = str(args.master_port)
+        logger.info(
+            f"ds_tpu: pod launch — {num_nodes} hosts, coordinator {master}:"
+            f"{args.master_port}, this host rank {node_rank}"
+        )
+    else:
+        logger.info("ds_tpu: single-host launch (all local TPU chips)")
+
+    if args.deepspeed_config:
+        env["DS_TPU_CONFIG"] = args.deepspeed_config
+
+    if args.module:
+        cmd = [sys.executable, "-m", args.user_script] + args.user_args
+    else:
+        cmd = [sys.executable, args.user_script] + args.user_args
+    result = subprocess.call(cmd, env=env)
+    return result
+
+
+if __name__ == "__main__":
+    sys.exit(main())

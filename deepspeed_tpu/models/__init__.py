@@ -1,0 +1,20 @@
+from .layers import Param, split_params_axes
+from .transformer import CausalLM, TransformerConfig, cross_entropy_loss
+from .registry import get_model, MODEL_CONFIGS, gpt2_config, opt_config, bloom_config, llama_config
+from .simple import SimpleModel, random_batch
+
+__all__ = [
+    "Param",
+    "split_params_axes",
+    "CausalLM",
+    "TransformerConfig",
+    "cross_entropy_loss",
+    "get_model",
+    "MODEL_CONFIGS",
+    "gpt2_config",
+    "opt_config",
+    "bloom_config",
+    "llama_config",
+    "SimpleModel",
+    "random_batch",
+]

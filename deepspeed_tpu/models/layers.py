@@ -1,0 +1,257 @@
+"""Core neural-net layers as pure functions.
+
+The reference builds on torch ``nn.Module``; the TPU-native design keeps models as
+pure ``init``/``apply`` function pairs over parameter pytrees. Every parameter carries
+*logical axis names* (a tuple of strings, one per dim) in a parallel "axes" pytree —
+the sharding layer (``parallel/sharding.py``) maps logical names to mesh axes per
+parallelism config. This replaces the reference's module-walking machinery
+(``module_inject/replace_module.py``) with data: resharding a model = changing the
+rule table, not surgically editing modules.
+
+Logical axis vocabulary (used across the model zoo):
+    "vocab"   — vocabulary dim of embeddings / LM head
+    "embed"   — model (residual) width
+    "mlp"     — feed-forward hidden width (TP-sharded: column parallel in, row out)
+    "heads"   — attention heads * head_dim flattened width (TP-sharded)
+    "kv"      — kv heads width for GQA/MQA
+    "layers"  — scan dim over stacked transformer blocks
+    None      — never sharded (biases, layernorm scales use ("embed",) etc.)
+
+Compute dtype: params are stored in fp32 (the master copy; reference
+``runtime/fp16/fused_optimizer.py`` keeps the same split) and cast to the compute
+dtype (bf16/fp16) at apply time.
+"""
+
+import dataclasses
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
+
+
+@dataclasses.dataclass
+class Param:
+    """A parameter leaf paired with its logical axes.
+
+    Registered as a pytree node (value = child, axes = static aux) so transforms
+    like ``vmap`` over block init carry the axes metadata through untouched.
+    """
+
+    value: jnp.ndarray
+    axes: tuple
+
+
+jax.tree_util.register_pytree_node(
+    Param,
+    lambda p: ((p.value,), p.axes),
+    lambda axes, children: Param(children[0], axes),
+)
+
+
+def split_params_axes(tree):
+    """Split a tree of Param into (values, axes) trees."""
+    is_param = lambda x: isinstance(x, Param)
+    values = jax.tree_util.tree_map(lambda p: p.value, tree, is_leaf=is_param)
+    axes = jax.tree_util.tree_map(lambda p: p.axes, tree, is_leaf=is_param)
+    return values, axes
+
+
+# ---------------------------------------------------------------------------------
+# Initializers
+# ---------------------------------------------------------------------------------
+def normal_init(rng, shape, stddev=0.02, dtype=jnp.float32):
+    return jax.random.normal(rng, shape, dtype) * stddev
+
+
+def zeros_init(shape, dtype=jnp.float32):
+    return jnp.zeros(shape, dtype)
+
+
+def ones_init(shape, dtype=jnp.float32):
+    return jnp.ones(shape, dtype)
+
+
+# ---------------------------------------------------------------------------------
+# Linear / embedding / layernorm
+# ---------------------------------------------------------------------------------
+def linear_init(rng, in_dim, out_dim, axes, bias=True, stddev=0.02):
+    p = {"kernel": Param(normal_init(rng, (in_dim, out_dim), stddev), axes)}
+    if bias:
+        p["bias"] = Param(zeros_init((out_dim,)), (axes[-1],))
+    return p
+
+
+def linear_apply(p, x, compute_dtype=None):
+    kernel = p["kernel"]
+    if compute_dtype is not None:
+        kernel = kernel.astype(compute_dtype)
+        x = x.astype(compute_dtype)
+    y = x @ kernel
+    if "bias" in p:
+        b = p["bias"].astype(y.dtype) if compute_dtype is not None else p["bias"]
+        y = y + b
+    return y
+
+
+def embedding_init(rng, vocab_size, embed_dim, stddev=0.02):
+    return {"weight": Param(normal_init(rng, (vocab_size, embed_dim), stddev), ("vocab", "embed"))}
+
+
+def embedding_apply(p, ids, compute_dtype=None):
+    w = p["weight"]
+    if compute_dtype is not None:
+        w = w.astype(compute_dtype)
+    return jnp.take(w, ids, axis=0)
+
+
+def embedding_attend(p, x):
+    """Tied LM head: logits = x @ E^T."""
+    return x @ p["weight"].astype(x.dtype).T
+
+
+def layernorm_init(dim):
+    return {
+        "scale": Param(ones_init((dim,)), ("embed",)),
+        "bias": Param(zeros_init((dim,)), ("embed",)),
+    }
+
+
+def layernorm_apply(p, x, eps=1e-5):
+    """LayerNorm computed in fp32 regardless of compute dtype (the reference's fused
+    kernels do the same internally; csrc/transformer/normalize_kernels.cu)."""
+    dtype = x.dtype
+    x32 = x.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x32 - mean), axis=-1, keepdims=True)
+    y = (x32 - mean) * jax.lax.rsqrt(var + eps)
+    return (y * p["scale"] + p["bias"]).astype(dtype)
+
+
+def rmsnorm_init(dim):
+    return {"scale": Param(ones_init((dim,)), ("embed",))}
+
+
+def rmsnorm_apply(p, x, eps=1e-6):
+    dtype = x.dtype
+    x32 = x.astype(jnp.float32)
+    y = x32 * jax.lax.rsqrt(jnp.mean(jnp.square(x32), axis=-1, keepdims=True) + eps)
+    return (y * p["scale"]).astype(dtype)
+
+
+# ---------------------------------------------------------------------------------
+# Activations (reference: csrc/transformer/gelu_kernels.cu — XLA fuses these)
+# ---------------------------------------------------------------------------------
+ACTIVATIONS = {
+    "gelu": jax.nn.gelu,
+    "gelu_new": lambda x: jax.nn.gelu(x, approximate=True),
+    "relu": jax.nn.relu,
+    "silu": jax.nn.silu,
+    "swiglu": None,  # handled structurally in the MLP
+}
+
+
+# ---------------------------------------------------------------------------------
+# Attention
+# ---------------------------------------------------------------------------------
+def attention_init(rng, embed_dim, n_heads, n_kv_heads=None, bias=True, stddev=0.02,
+                   out_stddev=None):
+    """QKV + output projection. Fused qkv as one matrix (the reference's inference
+    kernels fuse qkv gemm the same way; csrc/transformer/inference)."""
+    n_kv_heads = n_kv_heads or n_heads
+    head_dim = embed_dim // n_heads
+    kv_dim = n_kv_heads * head_dim
+    k1, k2, k3, k4 = jax.random.split(rng, 4)
+    return {
+        "q": linear_init(k1, embed_dim, embed_dim, ("embed", "heads"), bias, stddev),
+        "k": linear_init(k2, embed_dim, kv_dim, ("embed", "kv"), bias, stddev),
+        "v": linear_init(k3, embed_dim, kv_dim, ("embed", "kv"), bias, stddev),
+        "o": linear_init(k4, embed_dim, embed_dim, ("heads", "embed"), bias,
+                         out_stddev or stddev),
+    }
+
+
+def _repeat_kv(x, n_rep):
+    if n_rep == 1:
+        return x
+    b, s, h, d = x.shape
+    return jnp.broadcast_to(x[:, :, :, None, :], (b, s, h, n_rep, d)).reshape(b, s, h * n_rep, d)
+
+
+def dot_product_attention(q, k, v, mask=None, scale=None, dropout_rate=0.0,
+                          dropout_rng=None, alibi_bias=None):
+    """Plain XLA attention: softmax(q k^T / sqrt(d)) v, fp32 softmax.
+
+    The reference's fused softmax/dropout kernels (csrc/transformer/softmax_kernels.cu,
+    dropout_kernels.cu) are XLA fusions here; the flash/pallas path lives in
+    ``ops/flash_attention.py`` and is selected by the model config.
+    q,k,v: [batch, seq, heads, head_dim]
+    """
+    head_dim = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / math.sqrt(head_dim)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    logits = checkpoint_name(logits, "attn_logits")
+    if alibi_bias is not None:
+        logits = logits + alibi_bias
+    if mask is not None:
+        logits = jnp.where(mask, logits, jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(logits, axis=-1)
+    probs = checkpoint_name(probs, "attn_probs")
+    if dropout_rate > 0.0 and dropout_rng is not None:
+        keep = jax.random.bernoulli(dropout_rng, 1.0 - dropout_rate, probs.shape)
+        probs = probs * keep / (1.0 - dropout_rate)
+    probs = probs.astype(v.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def causal_mask(q_len, kv_len, dtype=jnp.bool_):
+    """[1, 1, q, kv] lower-triangular mask aligned to the end of the kv window."""
+    q_idx = jnp.arange(q_len)[:, None]
+    kv_idx = jnp.arange(kv_len)[None, :]
+    offset = kv_len - q_len
+    return (kv_idx <= q_idx + offset)[None, None, :, :].astype(dtype)
+
+
+def rotary_embedding(positions, head_dim, base=10000.0, dtype=jnp.float32):
+    """RoPE cos/sin tables (reference csrc/transformer/inference/apply_rotary_pos_emb.cu)."""
+    inv_freq = 1.0 / (base ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    angles = positions.astype(jnp.float32)[..., None] * inv_freq  # [..., head_dim/2]
+    return jnp.cos(angles).astype(dtype), jnp.sin(angles).astype(dtype)
+
+
+def apply_rotary(x, cos, sin):
+    """x: [batch, seq, heads, head_dim]; cos/sin: [batch, seq, head_dim/2]."""
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    cos = cos[:, :, None, :].astype(x.dtype)
+    sin = sin[:, :, None, :].astype(x.dtype)
+    return jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+
+
+def alibi_slopes(n_heads):
+    """ALiBi slopes (reference inference kernels support alibi for BLOOM)."""
+    def pow2slopes(n):
+        start = 2.0 ** (-(2.0 ** -(math.log2(n) - 3)))
+        return [start * (start ** i) for i in range(n)]
+
+    if math.log2(n_heads).is_integer():
+        return jnp.asarray(pow2slopes(n_heads))
+    closest = 2 ** math.floor(math.log2(n_heads))
+    base = pow2slopes(closest)
+    extra = pow2slopes(2 * closest)[0::2][: n_heads - closest]
+    return jnp.asarray(base + extra)
+
+
+def alibi_bias(n_heads, q_len, kv_len):
+    """[1, heads, q, kv] additive bias."""
+    slopes = alibi_slopes(n_heads)  # [h]
+    kv_idx = jnp.arange(kv_len)[None, :]
+    q_idx = jnp.arange(q_len)[:, None] + (kv_len - q_len)
+    dist = kv_idx - q_idx  # <= 0 within causal window
+    return (slopes[:, None, None] * dist[None, :, :])[None].astype(jnp.float32)
+
+
+def dropout(rng, x, rate, deterministic):
+    if deterministic or rate == 0.0:
+        return x
+    keep = jax.random.bernoulli(rng, 1.0 - rate, x.shape)
+    return x * keep / (1.0 - rate)

@@ -1,0 +1,96 @@
+"""Model zoo registry: the reference's per-architecture injection policies
+(``module_inject/containers/{gpt2,opt,bloom,gptj,gptneox,...}.py``) become
+TransformerConfig presets — the families differ in config, not code.
+
+Size presets follow the published architectures (GPT-2 paper table 2; OPT paper
+table 1; BLOOM config; LLaMA paper table 2).
+"""
+
+import jax.numpy as jnp
+
+from .transformer import CausalLM, TransformerConfig
+
+
+def gpt2_config(size="small", **overrides):
+    presets = {
+        "tiny": dict(n_layers=2, d_model=128, n_heads=2, d_ff=512, max_seq_len=256),
+        "small": dict(n_layers=12, d_model=768, n_heads=12, d_ff=3072),
+        "medium": dict(n_layers=24, d_model=1024, n_heads=16, d_ff=4096),
+        "large": dict(n_layers=36, d_model=1280, n_heads=20, d_ff=5120),
+        "xl": dict(n_layers=48, d_model=1600, n_heads=25, d_ff=6400),
+    }
+    base = dict(
+        vocab_size=50257, max_seq_len=1024, activation="gelu_new", norm="layernorm",
+        position_embedding="learned", tie_embeddings=True, use_bias=True, prenorm=True,
+    )
+    base.update(presets[size])
+    base.update(overrides)
+    return TransformerConfig(**base)
+
+
+def opt_config(size="125m", **overrides):
+    presets = {
+        "125m": dict(n_layers=12, d_model=768, n_heads=12, d_ff=3072),
+        "350m": dict(n_layers=24, d_model=1024, n_heads=16, d_ff=4096),
+        "1.3b": dict(n_layers=24, d_model=2048, n_heads=32, d_ff=8192),
+        "2.7b": dict(n_layers=32, d_model=2560, n_heads=32, d_ff=10240),
+        "6.7b": dict(n_layers=32, d_model=4096, n_heads=32, d_ff=16384),
+        "13b": dict(n_layers=40, d_model=5120, n_heads=40, d_ff=20480),
+        "30b": dict(n_layers=48, d_model=7168, n_heads=56, d_ff=28672),
+    }
+    base = dict(
+        vocab_size=50272, max_seq_len=2048, activation="relu", norm="layernorm",
+        position_embedding="learned", tie_embeddings=True, use_bias=True, prenorm=True,
+    )
+    base.update(presets[size])
+    base.update(overrides)
+    return TransformerConfig(**base)
+
+
+def bloom_config(size="560m", **overrides):
+    presets = {
+        "560m": dict(n_layers=24, d_model=1024, n_heads=16, d_ff=4096),
+        "1.7b": dict(n_layers=24, d_model=2048, n_heads=16, d_ff=8192),
+        "3b": dict(n_layers=30, d_model=2560, n_heads=32, d_ff=10240),
+        "7b": dict(n_layers=30, d_model=4096, n_heads=32, d_ff=16384),
+    }
+    base = dict(
+        vocab_size=250880, max_seq_len=2048, activation="gelu", norm="layernorm",
+        position_embedding="alibi", tie_embeddings=True, use_bias=True, prenorm=True,
+    )
+    base.update(presets[size])
+    base.update(overrides)
+    return TransformerConfig(**base)
+
+
+def llama_config(size="7b", **overrides):
+    presets = {
+        "tiny": dict(n_layers=2, d_model=128, n_heads=4, n_kv_heads=2, d_ff=352,
+                     max_seq_len=256, vocab_size=1024),
+        "7b": dict(n_layers=32, d_model=4096, n_heads=32, d_ff=11008),
+        "13b": dict(n_layers=40, d_model=5120, n_heads=40, d_ff=13824),
+    }
+    base = dict(
+        vocab_size=32000, max_seq_len=2048, activation="swiglu", norm="rmsnorm",
+        position_embedding="rope", tie_embeddings=False, use_bias=False, prenorm=True,
+        layernorm_eps=1e-6,
+    )
+    base.update(presets[size])
+    base.update(overrides)
+    return TransformerConfig(**base)
+
+
+MODEL_CONFIGS = {
+    "gpt2": gpt2_config,
+    "opt": opt_config,
+    "bloom": bloom_config,
+    "llama": llama_config,
+}
+
+
+def get_model(family, size=None, **overrides):
+    """Build a CausalLM by family name, e.g. get_model('gpt2', 'medium')."""
+    if family not in MODEL_CONFIGS:
+        raise ValueError(f"Unknown model family '{family}'. Available: {sorted(MODEL_CONFIGS)}")
+    kwargs = {} if size is None else {"size": size}
+    return CausalLM(MODEL_CONFIGS[family](**kwargs, **overrides))

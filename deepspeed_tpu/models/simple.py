@@ -1,0 +1,49 @@
+"""Tiny test models — the reference's fixture zoo (``tests/unit/simple_model.py``:
+SimpleModel linear stacks used by most engine/ZeRO tests)."""
+
+import jax
+import jax.numpy as jnp
+
+from . import layers as L
+from .layers import Param
+
+
+class SimpleModel:
+    """Stack of linear+relu layers with an MSE head; batch = {"x": [b, d], "y": [b, d]}."""
+
+    def __init__(self, hidden_dim=16, n_layers=2, compute_dtype=jnp.float32):
+        self.hidden_dim = hidden_dim
+        self.n_layers = n_layers
+        self.compute_dtype = compute_dtype
+
+    @property
+    def config(self):
+        return self
+
+    def init(self, rng):
+        params = {}
+        for i, k in enumerate(jax.random.split(rng, self.n_layers)):
+            params[f"layer_{i}"] = L.linear_init(
+                k, self.hidden_dim, self.hidden_dim, ("embed", "mlp"), bias=True, stddev=0.1
+            )
+        return params
+
+    def apply(self, params, x, deterministic=True, dropout_rng=None):
+        h = x.astype(self.compute_dtype)
+        for i in range(self.n_layers):
+            h = L.linear_apply(params[f"layer_{i}"], h)
+            if i < self.n_layers - 1:
+                h = jax.nn.relu(h)
+        return h
+
+    def loss(self, params, batch, deterministic=True, dropout_rng=None):
+        pred = self.apply(params, batch["x"], deterministic, dropout_rng)
+        return jnp.mean(jnp.square(pred.astype(jnp.float32) - batch["y"].astype(jnp.float32)))
+
+
+def random_batch(rng, batch_size, hidden_dim):
+    kx, ky = jax.random.split(jax.random.PRNGKey(rng) if isinstance(rng, int) else rng)
+    return {
+        "x": jax.random.normal(kx, (batch_size, hidden_dim), jnp.float32),
+        "y": jax.random.normal(ky, (batch_size, hidden_dim), jnp.float32),
+    }

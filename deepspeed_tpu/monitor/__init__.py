@@ -1,0 +1,3 @@
+from .monitor import Monitor, MonitorMaster, TensorBoardMonitor, WandbMonitor, CSVMonitor
+
+__all__ = ["Monitor", "MonitorMaster", "TensorBoardMonitor", "WandbMonitor", "CSVMonitor"]

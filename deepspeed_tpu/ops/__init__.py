@@ -1,0 +1,44 @@
+from .optimizers import Adam, Adagrad, Lamb, SGD, TPUOptimizer, get_optimizer, OPTIMIZERS
+from .lr_schedules import (
+    WarmupLR,
+    WarmupDecayLR,
+    OneCycle,
+    LRRangeTest,
+    get_lr_schedule,
+    SCHEDULES,
+)
+from .loss_scaler import (
+    make_scaler_state,
+    check_overflow,
+    update_scale,
+    scale_loss,
+    unscale_grads,
+    global_grad_norm,
+    clip_grads_by_global_norm,
+)
+
+__all__ = [
+    "Adam",
+    "Adagrad",
+    "Lamb",
+    "SGD",
+    "TPUOptimizer",
+    "get_optimizer",
+    "OPTIMIZERS",
+    "WarmupLR",
+    "WarmupDecayLR",
+    "OneCycle",
+    "LRRangeTest",
+    "get_lr_schedule",
+    "SCHEDULES",
+    "make_scaler_state",
+    "check_overflow",
+    "update_scale",
+    "scale_loss",
+    "unscale_grads",
+    "global_grad_norm",
+    "clip_grads_by_global_norm",
+]
+from .flash_attention import flash_attention  # noqa: E402
+
+__all__.append("flash_attention")

@@ -1,0 +1,82 @@
+"""Memory-efficient attention.
+
+Replaces the reference's fused attention kernels (``csrc/transformer/softmax_kernels.cu``
+for training, ``csrc/transformer/inference/csrc/softmax.cu`` "softmax_context" for
+inference). Two implementations behind one signature:
+
+- ``flash_attention``: online-softmax attention, chunked over the KV axis with
+  ``lax.scan`` so the [batch, heads, q, kv] score matrix is never materialized —
+  O(seq) memory like FlashAttention. Pure XLA; runs anywhere.
+- ``pallas_flash_attention`` (``ops/pallas/flash_attention.py``): the hand-tiled TPU
+  kernel used when available; same semantics.
+
+Inputs q,k,v: [batch, seq, heads, head_dim]; returns the same layout.
+"""
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def flash_attention(q, k, v, causal=True, scale=None, block_size=512):
+    """Online-softmax attention, scanned over KV blocks.
+
+    For each query block the running (max, sum, acc) triple is updated per KV chunk —
+    the same recurrence the FlashAttention kernel uses, expressed as ``lax.scan`` so
+    XLA keeps the working set in registers/VMEM.
+    """
+    if jax.default_backend() == "tpu" and q.shape[1] % 128 == 0 and k.shape[1] % 128 == 0:
+        from .pallas.flash_attention import pallas_flash_attention
+
+        return pallas_flash_attention(q, k, v, causal=causal, scale=scale,
+                                      block_q=min(256, q.shape[1]),
+                                      block_kv=min(512, k.shape[1]))
+    return _chunked_attention(q, k, v, causal=causal, scale=scale,
+                              block_size=block_size)
+
+
+def _chunked_attention(q, k, v, causal=True, scale=None, block_size=512):
+    b, s_q, h, d = q.shape
+    s_kv = k.shape[1]
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    block = min(block_size, s_kv)
+    if s_kv % block:
+        block = s_kv  # fall back to one chunk for ragged sizes
+    n_blocks = s_kv // block
+
+    qf = (q.astype(jnp.float32) * scale).transpose(0, 2, 1, 3)  # [b,h,q,d]
+    kf = k.astype(jnp.float32).transpose(0, 2, 1, 3)
+    vf = v.astype(jnp.float32).transpose(0, 2, 1, 3)
+
+    k_blocks = kf.reshape(b, h, n_blocks, block, d).transpose(2, 0, 1, 3, 4)
+    v_blocks = vf.reshape(b, h, n_blocks, block, d).transpose(2, 0, 1, 3, 4)
+
+    q_idx = jnp.arange(s_q)[:, None] + (s_kv - s_q)  # align causal window to kv end
+
+    def body(carry, inputs):
+        m, l, acc = carry
+        (kb, vb, blk) = inputs
+        logits = jnp.einsum("bhqd,bhkd->bhqk", qf, kb)  # [b,h,q,block]
+        if causal:
+            kv_idx = blk * block + jnp.arange(block)[None, :]
+            mask = kv_idx <= q_idx  # [q, block]
+            logits = jnp.where(mask[None, None], logits, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(logits, axis=-1))
+        p = jnp.exp(logits - m_new[..., None])
+        correction = jnp.exp(m - m_new)
+        l_new = l * correction + jnp.sum(p, axis=-1)
+        acc_new = acc * correction[..., None] + jnp.einsum("bhqk,bhkd->bhqd", p, vb)
+        return (m_new, l_new, acc_new), None
+
+    m0 = jnp.full((b, h, s_q), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, s_q), jnp.float32)
+    acc0 = jnp.zeros((b, h, s_q, d), jnp.float32)
+    blks = jnp.arange(n_blocks)
+    (m, l, acc), _ = jax.lax.scan(body, (m0, l0, acc0), (k_blocks, v_blocks, blks))
+
+    out = acc / jnp.maximum(l, 1e-30)[..., None]
+    return out.transpose(0, 2, 1, 3).astype(q.dtype)

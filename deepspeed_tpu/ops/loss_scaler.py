@@ -1,0 +1,91 @@
+"""fp16 loss scaling and overflow detection.
+
+TPU-native equivalent of the reference's ``runtime/fp16/loss_scaler.py``
+(``LossScaler``/``DynamicLossScaler``) and ``CheckOverflow`` (``runtime/utils.py:176``).
+
+The scaler state is a small pytree of jnp scalars that lives inside the engine's
+train state, so the scale update (check for non-finite grads -> halve scale / after a
+clean window -> double scale) is traced into the jitted train step with ``lax.cond``
+semantics via ``jnp.where`` — no host round-trip per step. The cross-replica overflow
+propagation the reference does with an allreduce (``CheckOverflow.check``) falls out
+for free: grads are already globally reduced when we inspect them.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def make_scaler_state(static_scale=0.0, initial_scale_power=16, min_scale=1.0):
+    """Initial scaler state. static_scale > 0 disables dynamic scaling
+    (reference: ``fp16.loss_scale`` config; 0 means dynamic)."""
+    if static_scale and static_scale > 0:
+        scale = float(static_scale)
+        dynamic = False
+    else:
+        scale = float(2.0 ** initial_scale_power)
+        dynamic = True
+    return {
+        "scale": jnp.asarray(scale, jnp.float32),
+        "good_steps": jnp.zeros((), jnp.int32),
+        # static metadata rides outside the traced state
+        "_dynamic": dynamic,
+        "_min_scale": float(min_scale),
+    }
+
+
+def traced_state(state):
+    return {"scale": state["scale"], "good_steps": state["good_steps"]}
+
+
+def check_overflow(grads):
+    """True iff any grad element is non-finite (reference ``CheckOverflow``,
+    ``runtime/utils.py:176``; has_overflow_serial + allreduce)."""
+    leaves = jax.tree_util.tree_leaves(grads)
+    flags = [jnp.logical_not(jnp.all(jnp.isfinite(g))) for g in leaves]
+    overflow = flags[0]
+    for f in flags[1:]:
+        overflow = jnp.logical_or(overflow, f)
+    return overflow
+
+
+def update_scale(scale, good_steps, overflow, loss_scale_window=1000, hysteresis=2,
+                 min_scale=1.0, max_scale=2.0 ** 32):
+    """Dynamic scale update (reference ``DynamicLossScaler.update_scale``):
+    on overflow halve (bounded below), else after ``loss_scale_window`` clean steps
+    double (bounded above). Pure; safe inside jit."""
+    del hysteresis  # single-halve policy; reference hysteresis counts repeated overflows
+    new_scale = jnp.where(
+        overflow,
+        jnp.maximum(scale * 0.5, min_scale),
+        jnp.where(good_steps + 1 >= loss_scale_window, jnp.minimum(scale * 2.0, max_scale), scale),
+    )
+    new_good = jnp.where(
+        overflow, 0, jnp.where(good_steps + 1 >= loss_scale_window, 0, good_steps + 1)
+    )
+    return new_scale, new_good
+
+
+def scale_loss(loss, scale):
+    return loss * scale.astype(loss.dtype)
+
+
+def unscale_grads(grads, scale):
+    inv = (1.0 / scale).astype(jnp.float32)
+    return jax.tree_util.tree_map(lambda g: (g.astype(jnp.float32) * inv), grads)
+
+
+def global_grad_norm(grads, eps=1e-6):
+    """L2 norm over the whole grad pytree (reference ``get_global_norm`` /
+    ``clip_grad_norm_`` in ``runtime/utils.py``). Under pjit the grads are global
+    arrays, so no explicit cross-rank reduction is needed."""
+    leaves = jax.tree_util.tree_leaves(grads)
+    sq = sum(jnp.sum(jnp.square(g.astype(jnp.float32))) for g in leaves)
+    return jnp.sqrt(sq + eps)
+
+
+def clip_grads_by_global_norm(grads, max_norm, norm=None):
+    """Reference ``clip_grad_norm_``: scale all grads by max_norm/global_norm if over."""
+    if norm is None:
+        norm = global_grad_norm(grads)
+    factor = jnp.minimum(1.0, max_norm / (norm + 1e-6))
+    return jax.tree_util.tree_map(lambda g: g * factor.astype(g.dtype), grads), norm

@@ -1,0 +1,129 @@
+"""Pallas TPU flash-attention (forward) kernel.
+
+The TPU-native replacement for the reference's fused attention CUDA kernels
+(``csrc/transformer/softmax_kernels.cu``, inference ``softmax.cu`` "softmax_context"):
+online-softmax attention tiled over query blocks (grid) and key/value blocks
+(in-kernel fori_loop), fp32 accumulators in VMEM scratch, causal blocks skipped
+entirely.
+
+Training backward uses the chunked-XLA recompute path via ``custom_vjp`` (memory-safe
+and differentiable everywhere); the forward kernel is the latency/throughput-critical
+piece for both training fwd and inference prefill.
+"""
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, block_kv, kv_len,
+                q_offset, block_q):
+    """One (batch*head, q_block) program; loops over kv blocks.
+
+    Block shapes: q_ref/o_ref [1, block_q, d]; k_ref/v_ref [1, kv_len, d].
+    """
+    qb = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * scale  # [bq, d]
+    d = q.shape[-1]
+
+    n_kv_total = kv_len // block_kv
+    if causal:
+        # last kv position any row in this q block may attend to (global index)
+        last_kv = qb * block_q + (block_q - 1) + q_offset
+        n_kv = jnp.minimum((last_kv // block_kv) + 1, n_kv_total)
+    else:
+        n_kv = n_kv_total
+
+    def body(i, carry):
+        m, l, acc = carry
+        k = k_ref[0, pl.ds(i * block_kv, block_kv), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(i * block_kv, block_kv), :].astype(jnp.float32)
+        s_ij = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [bq, bkv]
+        if causal:
+            row = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_kv), 0)
+            col = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_kv), 1)
+            q_pos = qb * block_q + row + q_offset
+            kv_pos = i * block_kv + col
+            s_ij = jnp.where(kv_pos <= q_pos, s_ij, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s_ij, axis=-1, keepdims=True))
+        p = jnp.exp(s_ij - m_new)
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc * corr + jnp.dot(p, v, preferred_element_type=jnp.float32)
+        return m_new, l_new, acc_new
+
+    m0 = jnp.full((block_q, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
+    acc0 = jnp.zeros((block_q, d), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(0, n_kv, body, (m0, l0, acc0))
+    o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+def _flash_fwd(q, k, v, causal, scale, block_q, block_kv, interpret):
+    """q,k,v: [b, s, h, d] -> out [b, s, h, d]."""
+    b, s_q, h, d = q.shape
+    s_kv = k.shape[1]
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+
+    bq = min(block_q, s_q)
+    bkv = min(block_kv, s_kv)
+    if s_q % bq or s_kv % bkv:
+        raise ValueError(f"seq lengths ({s_q},{s_kv}) must divide blocks ({bq},{bkv})")
+
+    # [b, s, h, d] -> [b*h, s, d]
+    qr = q.transpose(0, 2, 1, 3).reshape(b * h, s_q, d)
+    kr = k.transpose(0, 2, 1, 3).reshape(b * h, s_kv, d)
+    vr = v.transpose(0, 2, 1, 3).reshape(b * h, s_kv, d)
+
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal, block_kv=bkv, kv_len=s_kv,
+        q_offset=s_kv - s_q, block_q=bq,
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=(b * h, s_q // bq),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, s_kv, d), lambda i, j: (i, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, s_kv, d), lambda i, j: (i, 0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0), memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((b * h, s_q, d), q.dtype),
+        interpret=interpret,
+    )(qr, kr, vr)
+    return out.reshape(b, h, s_q, d).transpose(0, 2, 1, 3)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def pallas_flash_attention(q, k, v, causal=True, scale=None, block_q=256,
+                           block_kv=256, interpret=False):
+    return _flash_fwd(q, k, v, causal, scale, block_q, block_kv, interpret)
+
+
+def _vjp_fwd(q, k, v, causal, scale, block_q, block_kv, interpret):
+    out = _flash_fwd(q, k, v, causal, scale, block_q, block_kv, interpret)
+    return out, (q, k, v)
+
+
+def _vjp_bwd(causal, scale, block_q, block_kv, interpret, residuals, g):
+    """Backward via recompute through the chunked-XLA path (same semantics)."""
+    from ..flash_attention import _chunked_attention
+
+    q, k, v = residuals
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: _chunked_attention(q_, k_, v_, causal=causal, scale=scale,
+                                              block_size=block_kv),
+        q, k, v,
+    )
+    return vjp(g)
+
+
+pallas_flash_attention.defvjp(_vjp_fwd, _vjp_bwd)

@@ -130,6 +130,9 @@ def resolve_mesh_dims(mesh_config, n_devices):
         SEQ_AXIS: mesh_config.seq,
         MODEL_AXIS: mesh_config.model,
     }
+    for name, v in sizes.items():
+        if v == 0 or v < -1:
+            raise ConfigError(f"Mesh axis '{name}' has invalid size {v} (use -1 to infer)")
     n_infer = sum(1 for v in sizes.values() if v == -1)
     if n_infer > 1:
         raise ConfigError("Only one mesh axis may be -1 (inferred)")
@@ -221,5 +224,5 @@ class PipelineParallelGrid:
 
 def topology_from_mesh_dims(dims):
     """ProcessTopology over the canonical axes with the given sizes dict."""
-    axes = [a for a in CANONICAL_AXIS_ORDER if dims.get(a, 1) >= 1]
+    axes = list(CANONICAL_AXIS_ORDER)
     return ProcessTopology(axes=axes, dims=[dims.get(a, 1) for a in axes])

@@ -1,0 +1,4 @@
+from .engine import DeepSpeedEngine
+from .dataloader import DeepSpeedDataLoader, RepeatingLoader
+
+__all__ = ["DeepSpeedEngine", "DeepSpeedDataLoader", "RepeatingLoader"]

@@ -1,0 +1,583 @@
+"""The training engine.
+
+TPU-native equivalent of the reference's ``DeepSpeedEngine`` (``runtime/engine.py:183``):
+a config-driven wrapper exposing ``forward`` / ``backward`` / ``step`` /
+``save_checkpoint`` / ``load_checkpoint`` plus a fused ``train_batch``. The torch
+version orchestrates hooks, buckets, and streams at runtime; here the whole training
+step is a handful of jitted XLA programs whose sharding specs realize the configured
+parallelism (see ``parallel/sharding.py`` for the ZeRO-stage -> spec mapping):
+
+- params: fp32 master copies (reference keeps the same fp32 master in
+  ``fp16/fused_optimizer.py``), sharded per ZeRO-3 / TP, donated through the step
+- compute: bf16/fp16 cast at apply time (``fp16``/``bf16`` config sections)
+- grads: accumulated in a persistent buffer sharded per ZeRO-2
+- optimizer state: sharded per ZeRO-1
+- fp16: dynamic loss scaling with in-program overflow check and step skip
+  (reference ``runtime/fp16/loss_scaler.py`` + ``CheckOverflow``)
+
+Init sequence mirrors the reference (``engine.py:186-380``): dist init -> config
+parse -> mesh ("distributed model") -> optimizer -> lr scheduler -> checkpointing.
+Parameter init happens *sharded*: ``model.init`` runs under jit with the ZeRO specs
+as out_shardings, so a 13B model never materializes unsharded — the reference needs
+the ``zero.Init`` monkey-patch context (``partition_parameters.py:601``) for this.
+"""
+
+import os
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from .. import comm as dist
+from ..config import load_config, ConfigError
+from ..models.layers import Param, split_params_axes
+from ..ops import (
+    get_optimizer,
+    get_lr_schedule,
+    make_scaler_state,
+    check_overflow,
+    update_scale,
+    clip_grads_by_global_norm,
+    global_grad_norm,
+)
+from ..parallel import build_mesh, DATA_AXIS, EXPERT_AXIS
+from ..parallel.sharding import (
+    param_partition_specs,
+    state_partition_specs,
+    batch_partition_specs,
+    named,
+)
+from ..utils.logging import log_dist, logger
+from ..utils.timer import (
+    SynchronizedWallClockTimer,
+    ThroughputTimer,
+    FORWARD_GLOBAL_TIMER,
+    BACKWARD_GLOBAL_TIMER,
+    STEP_GLOBAL_TIMER,
+)
+from .dataloader import DeepSpeedDataLoader
+
+DTYPES = {"float16": jnp.float16, "bfloat16": jnp.bfloat16, "float32": jnp.float32}
+
+
+class DeepSpeedEngine:
+    def __init__(self, model, optimizer=None, model_parameters=None, training_data=None,
+                 lr_scheduler=None, mesh=None, collate_fn=None, config=None):
+        if model is None:
+            raise ConfigError("deepspeed_tpu.initialize: model is required")
+        self.module = model
+        self.client_optimizer = optimizer
+        self._config = load_config(config)
+
+        # -- mesh (the reference's _configure_distributed_model + process groups) ---
+        self.mesh = mesh if mesh is not None else build_mesh(self._config.mesh)
+        self.dp_world_size = self.mesh.shape[DATA_AXIS] * self.mesh.shape.get(EXPERT_AXIS, 1)
+        self.mp_world_size = self.mesh.shape.get("model", 1)
+
+        # -- batch triangle ----------------------------------------------------------
+        (self.train_batch_size_, self.micro_batch_size,
+         self.gradient_accumulation_steps_) = self._config.resolve_batch_size(self.dp_world_size)
+
+        # -- precision ---------------------------------------------------------------
+        self.compute_dtype = DTYPES[self._config.mixed_precision_dtype]
+        if hasattr(self.module, "config") and hasattr(self.module.config, "compute_dtype"):
+            self.module.config.compute_dtype = self.compute_dtype
+        if self._config.gradient_checkpointing and hasattr(self.module, "config") \
+                and hasattr(self.module.config, "remat"):
+            self.module.config.remat = True
+        self.fp16_enabled = self._config.fp16.enabled
+
+        self.zero_stage = self._config.zero_optimization.stage
+        self._persist_threshold = self._config.zero_optimization.param_persistence_threshold
+
+        # -- parameters (sharded at init = zero.Init) --------------------------------
+        self._rng = jax.random.PRNGKey(self._config.seed)
+        self._init_parameters(model_parameters)
+
+        # -- optimizer ---------------------------------------------------------------
+        self._configure_optimizer()
+
+        # -- lr scheduler ------------------------------------------------------------
+        self.lr_scheduler = lr_scheduler
+        if self.lr_scheduler is None and self._config.scheduler.type:
+            self.lr_scheduler = get_lr_schedule(
+                self._config.scheduler.type, self._config.scheduler.params
+            )
+
+        # -- fp16 loss scaler --------------------------------------------------------
+        fp16 = self._config.fp16
+        self._scaler_meta = make_scaler_state(
+            static_scale=fp16.loss_scale,
+            initial_scale_power=fp16.initial_scale_power,
+            min_scale=fp16.min_loss_scale,
+        ) if self.fp16_enabled else None
+        if self.fp16_enabled:
+            self._scale = self._scaler_meta["scale"]
+            self._good_steps = self._scaler_meta["good_steps"]
+        else:
+            self._scale = jnp.asarray(1.0, jnp.float32)
+            self._good_steps = jnp.zeros((), jnp.int32)
+
+        # -- grad accumulation buffer (ZeRO-2 sharded) -------------------------------
+        self._grad_specs = state_partition_specs(
+            self._axes, self._shapes, self.mesh,
+            zero_stage=self.zero_stage if self.zero_stage >= 2 else 0,
+            min_data_shard_elems=self._persist_threshold if self.zero_stage >= 2 else 2 ** 62,
+        )
+        self._grad_shardings = named(self.mesh, self._grad_specs)
+        self._acc_grads = None
+        self._cached = None  # (loss, grads) from the last forward
+
+        # -- counters / timers / monitor ---------------------------------------------
+        self.global_steps = 0
+        self.micro_steps = 0
+        self.skipped_steps = 0
+        self.timers = SynchronizedWallClockTimer()
+        self.tput_timer = ThroughputTimer(
+            batch_size=self.train_batch_size_, steps_per_output=self._config.steps_per_print
+        )
+        self._wall_clock_breakdown = self._config.wall_clock_breakdown
+        from ..monitor.monitor import MonitorMaster
+
+        self.monitor = MonitorMaster(self._config)
+
+        # -- dataloader --------------------------------------------------------------
+        self.training_dataloader = None
+        if training_data is not None:
+            self.training_dataloader = self.deepspeed_io(training_data, collate_fn=collate_fn)
+
+        # -- checkpointing -----------------------------------------------------------
+        from ..checkpoint.engine import NpzCheckpointEngine
+
+        self.checkpoint_engine = NpzCheckpointEngine()
+
+        # -- compiled functions (built lazily) ---------------------------------------
+        self._fwd_bwd_fn = None
+        self._accumulate_fn = None
+        self._apply_fn = None
+        self._eval_fn = None
+
+        log_dist(
+            f"DeepSpeedEngine: mesh={dict(self.mesh.shape)} zero_stage={self.zero_stage} "
+            f"dtype={self._config.mixed_precision_dtype} "
+            f"batch(total={self.train_batch_size_}, micro={self.micro_batch_size}, "
+            f"gas={self.gradient_accumulation_steps_})",
+            ranks=[0],
+        )
+
+    # ------------------------------------------------------------------------------
+    # init helpers
+    # ------------------------------------------------------------------------------
+    def _init_parameters(self, model_parameters):
+        if model_parameters is not None:
+            if isinstance(model_parameters, tuple) and len(model_parameters) == 2:
+                values, axes = model_parameters
+            else:
+                values, axes = split_params_axes(model_parameters)
+        else:
+            # Trace init to get shapes/axes without materializing anything.
+            params_shape = jax.eval_shape(self.module.init, self._rng)
+            is_param = lambda x: isinstance(x, Param)
+            axes = jax.tree_util.tree_map(lambda p: p.axes, params_shape, is_leaf=is_param)
+            values = None
+
+        if values is not None:
+            shapes = jax.tree_util.tree_map(lambda v: tuple(v.shape), values)
+        else:
+            shapes = jax.tree_util.tree_map(
+                lambda p: tuple(p.value.shape), params_shape,
+                is_leaf=lambda x: isinstance(x, Param),
+            )
+
+        self._axes = axes
+        self._shapes = shapes
+        self.param_specs = param_partition_specs(
+            axes, shapes, self.mesh, zero_stage=self.zero_stage,
+            min_data_shard_elems=self._persist_threshold,
+        )
+        self.param_shardings = named(self.mesh, self.param_specs)
+
+        if values is None:
+            # init directly into the sharded layout: the zero.Init equivalent.
+            init_fn = lambda rng: split_params_axes(self.module.init(rng))[0]
+            with self.mesh:
+                self.params = jax.jit(init_fn, out_shardings=self.param_shardings)(self._rng)
+        else:
+            self.params = jax.tree_util.tree_map(jax.device_put, values, self.param_shardings)
+
+        n_params = sum(int(np.prod(s)) for s in jax.tree_util.tree_leaves(
+            self._shapes, is_leaf=lambda x: isinstance(x, tuple)))
+        self.num_parameters = n_params
+        log_dist(f"Model parameters: {n_params / 1e6:.2f}M", ranks=[0])
+
+    def _configure_optimizer(self):
+        """Reference ``engine.py:1157`` _configure_optimizer: client optimizer wins,
+        else build from config; then "wrap" = attach sharded state specs."""
+        if self.client_optimizer is not None:
+            self.optimizer = self.client_optimizer
+        else:
+            opt_cfg = self._config.optimizer
+            self.optimizer = get_optimizer(opt_cfg.type or "adamw", opt_cfg.params)
+
+        # weight decay mask: no decay on 1-D params (biases, norms) — the grouping
+        # the reference expresses via param_groups.
+        self._wd_mask = jax.tree_util.tree_map(lambda s: len(s) > 1, self._shapes,
+                                               is_leaf=lambda x: isinstance(x, tuple))
+
+        state_shape = jax.eval_shape(self.optimizer.init, self.params)
+        opt_state_specs = self._opt_state_specs(state_shape)
+        self._opt_shardings = named(self.mesh, opt_state_specs)
+        with self.mesh:
+            self.optimizer_state = jax.jit(
+                self.optimizer.init, out_shardings=self._opt_shardings
+            )(self.params)
+
+    def _opt_state_specs(self, state_shape):
+        """Param-shaped leaves get ZeRO-1+ data-sharded specs; scalars replicate."""
+        sharded_specs = state_partition_specs(
+            self._axes, self._shapes, self.mesh,
+            zero_stage=self.zero_stage if self.zero_stage >= 1 else 0,
+            min_data_shard_elems=self._persist_threshold if self.zero_stage >= 1 else 2 ** 62,
+        )
+
+        def spec_for(path, leaf):
+            if leaf.ndim == 0:
+                return P()
+            # state leaves live under a head key ("exp_avg", ...) followed by the
+            # param path; strip the head and look up the param's sharded spec.
+            sub = tuple(path[1:])
+            node = sharded_specs
+            try:
+                for k in sub:
+                    node = node[k.key if hasattr(k, "key") else k]
+                if isinstance(node, P):
+                    return node
+            except (KeyError, TypeError):
+                pass
+            return P()
+
+        paths, treedef = jax.tree_util.tree_flatten_with_path(state_shape)
+        specs = [spec_for(path, leaf) for path, leaf in paths]
+        return jax.tree_util.tree_unflatten(treedef, specs)
+
+    # ------------------------------------------------------------------------------
+    # compiled programs
+    # ------------------------------------------------------------------------------
+    def _build_fwd_bwd(self):
+        gas = self.gradient_accumulation_steps_
+
+        def fwd_bwd(params, batch, scale, rng):
+            def scaled_loss(p):
+                loss = self.module.loss(p, batch, deterministic=False, dropout_rng=rng)
+                # reference scales by 1/gas at backward (engine.py:1793) and by the
+                # fp16 loss scale inside the scaler
+                return loss * scale.astype(loss.dtype) / gas, loss
+
+            (_, loss), grads = jax.value_and_grad(scaled_loss, has_aux=True)(params)
+            return loss, grads
+
+        with self.mesh:
+            self._fwd_bwd_fn = jax.jit(
+                fwd_bwd, out_shardings=(NamedSharding(self.mesh, P()), self._grad_shardings)
+            )
+
+    def _build_accumulate(self):
+        def accumulate(acc, grads):
+            return jax.tree_util.tree_map(jnp.add, acc, grads)
+
+        with self.mesh:
+            self._accumulate_fn = jax.jit(
+                accumulate, donate_argnums=(0,), out_shardings=self._grad_shardings
+            )
+
+    def _build_apply(self):
+        clip = self._config.gradient_clipping
+        fp16 = self.fp16_enabled
+        window = self._config.fp16.loss_scale_window
+        min_scale = self._config.fp16.min_loss_scale
+        dynamic = (self._scaler_meta or {}).get("_dynamic", False)
+
+        def apply_step(params, opt_state, acc_grads, scale, good_steps, lr):
+            inv = (1.0 / scale).astype(jnp.float32)
+            grads = jax.tree_util.tree_map(lambda g: g.astype(jnp.float32) * inv, acc_grads)
+            overflow = check_overflow(grads) if fp16 else jnp.asarray(False)
+            norm = global_grad_norm(grads)
+            if clip > 0:
+                grads, _ = clip_grads_by_global_norm(grads, clip, norm=norm)
+            new_params, new_state = self.optimizer.update(
+                grads, opt_state, params, lr=lr, wd_mask=self._wd_mask
+            )
+            if fp16:
+                # skip the update on overflow (reference FP16_Optimizer.step)
+                new_params = jax.tree_util.tree_map(
+                    lambda old, new: jnp.where(overflow, old, new), params, new_params
+                )
+                new_state = jax.tree_util.tree_map(
+                    lambda old, new: jnp.where(overflow, old, new), opt_state, new_state
+                )
+                if dynamic:
+                    scale, good_steps = update_scale(
+                        scale, good_steps, overflow, loss_scale_window=window,
+                        min_scale=min_scale,
+                    )
+            return new_params, new_state, scale, good_steps, overflow, norm
+
+        with self.mesh:
+            self._apply_fn = jax.jit(
+                apply_step,
+                donate_argnums=(0, 1, 2),
+                out_shardings=(
+                    self.param_shardings,
+                    self._opt_shardings,
+                    NamedSharding(self.mesh, P()),
+                    NamedSharding(self.mesh, P()),
+                    NamedSharding(self.mesh, P()),
+                    NamedSharding(self.mesh, P()),
+                ),
+            )
+
+    # ------------------------------------------------------------------------------
+    # data placement
+    # ------------------------------------------------------------------------------
+    def _shard_batch(self, batch):
+        batch = {k: jnp.asarray(v) for k, v in batch.items()}
+        data_size = self.mesh.shape[DATA_AXIS]
+        for k, v in batch.items():
+            if v.ndim >= 1 and v.shape[0] % data_size:
+                raise ConfigError(
+                    f"Batch leaf '{k}' has {v.shape[0]} rows, not divisible by the "
+                    f"data-parallel mesh axis ({data_size}); global micro-batch must "
+                    f"be a multiple of dp size"
+                )
+        shapes = {k: tuple(v.shape) for k, v in batch.items()}
+        specs = batch_partition_specs(shapes, self.mesh)
+        shardings = named(self.mesh, specs)
+        return {k: jax.device_put(batch[k], shardings[k]) for k in batch}
+
+    def deepspeed_io(self, dataset, batch_size=None, collate_fn=None):
+        """Reference ``engine.py:1542`` deepspeed_io."""
+        return DeepSpeedDataLoader(
+            dataset,
+            batch_size=batch_size or self.micro_batch_size * self.dp_world_size
+            // max(dist.get_world_size(), 1),
+            shuffle=True,
+            seed=self._config.seed,
+            collate_fn=collate_fn,
+            rank=dist.get_rank(),
+            num_shards=dist.get_world_size(),
+        )
+
+    # ------------------------------------------------------------------------------
+    # train API (reference engine.forward :1634 / backward :1775 / step :1971)
+    # ------------------------------------------------------------------------------
+    def __call__(self, batch):
+        return self.forward(batch)
+
+    def forward(self, batch):
+        """Compute loss AND gradients for one micro-batch (cached for backward).
+
+        The reference runs a separate autograd backward; under XLA forward and
+        backward are one fused program — ``forward`` returns the loss and stashes
+        the grads, ``backward`` accumulates them. Numerically identical, one less
+        pass over the activations.
+        """
+        if self._wall_clock_breakdown:
+            self.timers(FORWARD_GLOBAL_TIMER).start()
+        if self._fwd_bwd_fn is None:
+            self._build_fwd_bwd()
+        batch = self._shard_batch(batch)
+        self._rng, step_rng = jax.random.split(self._rng)
+        loss, grads = self._fwd_bwd_fn(self.params, batch, self._scale, step_rng)
+        self._cached = (loss, grads)
+        if self._wall_clock_breakdown:
+            self.timers(FORWARD_GLOBAL_TIMER).stop()
+        return loss
+
+    def backward(self, loss=None):
+        """Accumulate the cached micro-batch grads (reference engine.backward)."""
+        if self._cached is None:
+            raise RuntimeError("backward() called before forward()")
+        if self._wall_clock_breakdown:
+            self.timers(BACKWARD_GLOBAL_TIMER).start()
+        _, grads = self._cached
+        self._cached = None
+        if self._acc_grads is None:
+            self._acc_grads = grads
+        else:
+            if self._accumulate_fn is None:
+                self._build_accumulate()
+            self._acc_grads = self._accumulate_fn(self._acc_grads, grads)
+        self.micro_steps += 1
+        if self._wall_clock_breakdown:
+            self.timers(BACKWARD_GLOBAL_TIMER).stop()
+        return loss
+
+    def is_gradient_accumulation_boundary(self):
+        """Reference ``engine.py:1565``."""
+        return self.micro_steps % self.gradient_accumulation_steps_ == 0
+
+    def step(self):
+        """Apply the optimizer at the accumulation boundary (reference engine.step)."""
+        if not self.is_gradient_accumulation_boundary():
+            return
+        if self._acc_grads is None:
+            raise RuntimeError("step() called with no accumulated gradients")
+        if self._wall_clock_breakdown:
+            self.timers(STEP_GLOBAL_TIMER).start()
+        if self._apply_fn is None:
+            self._build_apply()
+        lr = self._current_lr()
+        (self.params, self.optimizer_state, self._scale,
+         self._good_steps, overflow, grad_norm) = self._apply_fn(
+            self.params, self.optimizer_state, self._acc_grads, self._scale,
+            self._good_steps, jnp.asarray(lr, jnp.float32),
+        )
+        self._acc_grads = None  # donated; re-seeded by the next backward()
+        self.global_steps += 1
+        if self.fp16_enabled and bool(overflow):
+            self.skipped_steps += 1
+            log_dist(
+                f"step {self.global_steps}: fp16 overflow, skipping update "
+                f"(loss scale -> {float(self._scale)})",
+                ranks=[0],
+            )
+        elif self.lr_scheduler is not None:
+            self.lr_scheduler.step()
+        if self._wall_clock_breakdown:
+            self.timers(STEP_GLOBAL_TIMER).stop()
+            self.timers.log(
+                [FORWARD_GLOBAL_TIMER, BACKWARD_GLOBAL_TIMER, STEP_GLOBAL_TIMER]
+            )
+        if self.global_steps % self._config.steps_per_print == 0:
+            self.monitor.write_events(
+                [("Train/lr", lr, self.global_steps),
+                 ("Train/grad_norm", float(grad_norm), self.global_steps)]
+            )
+        return grad_norm
+
+    def train_batch(self, data_iter=None, batch=None):
+        """Full accumulation window in one call (reference PipelineEngine.train_batch
+        shape). Feeds ``gradient_accumulation_steps`` micro-batches."""
+        self.tput_timer.start()
+        losses = []
+        for _ in range(self.gradient_accumulation_steps_):
+            if batch is not None:
+                micro = batch
+            else:
+                micro = next(data_iter)
+            loss = self.forward(micro)
+            self.backward(loss)
+            losses.append(loss)
+        self.step()
+        self.tput_timer.stop(global_step=True)
+        mean_loss = float(jnp.mean(jnp.stack(losses)))
+        if self.global_steps % self._config.steps_per_print == 0:
+            self.monitor.write_events([("Train/loss", mean_loss, self.global_steps)])
+            self._report_progress()
+        return mean_loss
+
+    def eval_batch(self, batch):
+        """Loss without grads."""
+        if self._eval_fn is None:
+            with self.mesh:
+                self._eval_fn = jax.jit(lambda p, b: self.module.loss(p, b))
+        return self._eval_fn(self.params, self._shard_batch(batch))
+
+    def _current_lr(self):
+        if self.lr_scheduler is not None:
+            return self.lr_scheduler.get_last_lr()[0]
+        return self.optimizer.lr
+
+    def get_lr(self):
+        return [self._current_lr()]
+
+    def _report_progress(self):
+        """Reference ``engine.py:2167`` _report_progress."""
+        log_dist(
+            f"step={self.global_steps}, skipped={self.skipped_steps}, "
+            f"lr={self._current_lr():.3e}, loss_scale={float(self._scale):.1f}",
+            ranks=[0],
+        )
+
+    # ------------------------------------------------------------------------------
+    # config accessors (reference engine.py:641-836 property farm)
+    # ------------------------------------------------------------------------------
+    @property
+    def config(self):
+        return self._config
+
+    def train_batch_size(self):
+        return self.train_batch_size_
+
+    def train_micro_batch_size_per_gpu(self):
+        return self.micro_batch_size
+
+    def gradient_accumulation_steps(self):
+        return self.gradient_accumulation_steps_
+
+    def zero_optimization_stage(self):
+        return self.zero_stage
+
+    @property
+    def loss_scale(self):
+        return float(self._scale)
+
+    def get_global_grad_norm(self):
+        if self._acc_grads is None:
+            return 0.0
+        return float(global_grad_norm(self._acc_grads))
+
+    # ------------------------------------------------------------------------------
+    # checkpointing (reference engine.py:2493 load / :2798 save)
+    # ------------------------------------------------------------------------------
+    def save_checkpoint(self, save_dir, tag=None, client_state=None):
+        tag = tag or f"global_step{self.global_steps}"
+        state = {
+            "params": self.params,
+            "optimizer_state": self.optimizer_state,
+        }
+        meta = {
+            "global_steps": self.global_steps,
+            "micro_steps": self.micro_steps,
+            "skipped_steps": self.skipped_steps,
+            "loss_scale": float(self._scale),
+            "good_steps": int(self._good_steps),
+            "lr_scheduler": self.lr_scheduler.state_dict() if self.lr_scheduler else None,
+            "zero_stage": self.zero_stage,
+            "mesh": dict(self.mesh.shape),
+            "client_state": client_state or {},
+        }
+        path = os.path.join(save_dir, tag)
+        self.checkpoint_engine.save(state, path, meta=meta)
+        self.checkpoint_engine.commit(tag)
+        log_dist(f"Saved checkpoint {path}", ranks=[0])
+        return path
+
+    def load_checkpoint(self, load_dir, tag=None, load_optimizer_states=True):
+        if tag is None:
+            latest = os.path.join(load_dir, "latest")
+            if os.path.exists(latest):
+                tag = open(latest).read().strip()
+            else:
+                tags = sorted(d for d in os.listdir(load_dir)
+                              if os.path.isdir(os.path.join(load_dir, d)))
+                if not tags:
+                    return None, {}
+                tag = tags[-1]
+        path = os.path.join(load_dir, tag)
+        template = {"params": self.params, "optimizer_state": self.optimizer_state}
+        shardings = {"params": self.param_shardings, "optimizer_state": self._opt_shardings}
+        state, meta = self.checkpoint_engine.load(path, template=template, shardings=shardings)
+        self.params = state["params"]
+        if load_optimizer_states:
+            self.optimizer_state = state["optimizer_state"]
+        self.global_steps = meta["global_steps"]
+        self.micro_steps = meta["micro_steps"]
+        self.skipped_steps = meta["skipped_steps"]
+        self._scale = jnp.asarray(meta["loss_scale"], jnp.float32)
+        self._good_steps = jnp.asarray(meta["good_steps"], jnp.int32)
+        if self.lr_scheduler is not None and meta.get("lr_scheduler"):
+            self.lr_scheduler.load_state_dict(meta["lr_scheduler"])
+        log_dist(f"Loaded checkpoint {path} at step {self.global_steps}", ranks=[0])
+        return path, meta.get("client_state", {})

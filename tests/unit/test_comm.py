@@ -47,6 +47,20 @@ def test_all_reduce_avg_max_min(data_mesh):
         np.testing.assert_allclose(f(x), ref(np.asarray(x), axis=0, keepdims=True))
 
 
+def test_all_reduce_prod(data_mesh):
+    # negatives, a zero lane, and integer dtype must all reduce exactly
+    x = jnp.asarray([1.0, -2.0, 3.0, -4.0, 1.0, 1.0, 1.0, 1.0]).reshape(8, 1)
+    f = _shard_map(lambda v: dist.all_reduce(v, "data", op=dist.ReduceOp.PROD),
+                   data_mesh, (P("data"),), P("data"))
+    np.testing.assert_allclose(np.asarray(f(x)).ravel(), np.full(8, 24.0))
+    np.testing.assert_allclose(np.asarray(f(x.at[2, 0].set(0.0))).ravel(), np.zeros(8))
+    xi = jnp.asarray([3, 7, 1, 1, 1, 1, 1, 1], jnp.int32).reshape(8, 1)
+    fi = _shard_map(lambda v: dist.all_reduce(v, "data", op=dist.ReduceOp.PROD),
+                    data_mesh, (P("data"),), P("data"))
+    out = np.asarray(fi(xi)).ravel()
+    assert out.dtype == np.int32 and np.all(out == 21)
+
+
 def test_all_gather(data_mesh):
     x = jnp.arange(8, dtype=jnp.float32).reshape(8, 1)
     f = _shard_map(

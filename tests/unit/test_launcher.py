@@ -1,0 +1,39 @@
+"""Launcher tests (reference analogue: tests/unit/launcher/test_ds_arguments.py)."""
+
+import pytest
+
+from deepspeed_tpu.launcher import fetch_hostfile, parse_inclusion_exclusion
+from deepspeed_tpu.launcher.runner import parse_args
+
+
+def test_hostfile_parse(tmp_path):
+    hf = tmp_path / "hostfile"
+    hf.write_text("# comment\nworker-0 slots=4\nworker-1 slots=4\n\n")
+    pool = fetch_hostfile(str(hf))
+    assert pool == {"worker-0": 4, "worker-1": 4}
+
+
+def test_hostfile_bad_entry(tmp_path):
+    hf = tmp_path / "hostfile"
+    hf.write_text("worker-0 4\n")
+    with pytest.raises(ValueError):
+        fetch_hostfile(str(hf))
+
+
+def test_missing_hostfile_is_empty():
+    assert fetch_hostfile("/nonexistent/hostfile") == {}
+
+
+def test_include_exclude():
+    pool = {"a": 4, "b": 4, "c": 4}
+    assert parse_inclusion_exclusion(pool, "a@b", "") == {"a": 4, "b": 4}
+    assert parse_inclusion_exclusion(pool, "", "c") == {"a": 4, "b": 4}
+    with pytest.raises(ValueError):
+        parse_inclusion_exclusion(pool, "zzz", "")
+
+
+def test_parse_args_passthrough():
+    args = parse_args(["--master_port", "9999", "train.py", "--lr", "0.1"])
+    assert args.master_port == 9999
+    assert args.user_script == "train.py"
+    assert args.user_args == ["--lr", "0.1"]

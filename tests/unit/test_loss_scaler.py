@@ -1,0 +1,69 @@
+"""Loss scaler / overflow tests (reference analogue: tests/unit/runtime/half_precision)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.ops import (
+    make_scaler_state,
+    check_overflow,
+    update_scale,
+    scale_loss,
+    unscale_grads,
+    global_grad_norm,
+    clip_grads_by_global_norm,
+)
+
+
+def test_static_vs_dynamic_init():
+    s = make_scaler_state(static_scale=128.0)
+    assert float(s["scale"]) == 128.0 and not s["_dynamic"]
+    d = make_scaler_state(initial_scale_power=8)
+    assert float(d["scale"]) == 256.0 and d["_dynamic"]
+
+
+def test_check_overflow():
+    clean = {"a": jnp.ones(4), "b": jnp.zeros(3)}
+    assert not bool(check_overflow(clean))
+    dirty = {"a": jnp.array([1.0, jnp.nan]), "b": jnp.zeros(3)}
+    assert bool(check_overflow(dirty))
+    inf = {"a": jnp.array([1.0, jnp.inf])}
+    assert bool(check_overflow(inf))
+
+
+def test_update_scale_dynamics():
+    scale = jnp.asarray(1024.0)
+    good = jnp.asarray(0)
+    # overflow halves
+    s1, g1 = update_scale(scale, good, jnp.asarray(True))
+    assert float(s1) == 512.0 and int(g1) == 0
+    # clean window doubles
+    s, g = jnp.asarray(4.0), jnp.asarray(0)
+    for _ in range(3):
+        s, g = update_scale(s, g, jnp.asarray(False), loss_scale_window=3)
+    assert float(s) == 8.0 and int(g) == 0
+    # floor at min_scale
+    s2, _ = update_scale(jnp.asarray(1.0), good, jnp.asarray(True), min_scale=1.0)
+    assert float(s2) == 1.0
+
+
+def test_scale_unscale_roundtrip():
+    grads = {"w": jnp.asarray([2.0, 4.0], jnp.float16)}
+    scale = jnp.asarray(1024.0, jnp.float32)
+    loss = scale_loss(jnp.asarray(0.5, jnp.float16), scale)
+    assert float(loss) == 512.0
+    un = unscale_grads({"w": grads["w"] * scale.astype(jnp.float16)}, scale)
+    np.testing.assert_allclose(np.asarray(un["w"]), [2.0, 4.0], rtol=1e-3)
+    assert un["w"].dtype == jnp.float32
+
+
+def test_global_norm_and_clip():
+    grads = {"a": jnp.asarray([3.0]), "b": jnp.asarray([4.0])}
+    norm = global_grad_norm(grads, eps=0.0)
+    assert float(norm) == 5.0
+    clipped, norm2 = clip_grads_by_global_norm(grads, max_norm=1.0)
+    assert float(norm2) == 5.0
+    total = np.sqrt(sum(float(jnp.sum(g ** 2)) for g in clipped.values()))
+    np.testing.assert_allclose(total, 1.0, rtol=1e-4)
+    # under the limit: unchanged
+    same, _ = clip_grads_by_global_norm(grads, max_norm=10.0)
+    np.testing.assert_allclose(np.asarray(same["a"]), [3.0])

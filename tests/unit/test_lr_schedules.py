@@ -1,0 +1,70 @@
+"""LR schedule tests (reference analogue: tests/unit/runtime/test_lr_schedulers.py)."""
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.ops import get_lr_schedule, WarmupLR, WarmupDecayLR, OneCycle, LRRangeTest
+
+
+def test_warmup_lr_linear():
+    s = WarmupLR(warmup_min_lr=0.0, warmup_max_lr=0.1, warmup_num_steps=10, warmup_type="linear")
+    assert float(s.lr_at(0)) == pytest.approx(0.0)
+    assert float(s.lr_at(5)) == pytest.approx(0.05)
+    assert float(s.lr_at(10)) == pytest.approx(0.1)
+    assert float(s.lr_at(100)) == pytest.approx(0.1)
+
+
+def test_warmup_lr_log():
+    s = WarmupLR(warmup_min_lr=0.0, warmup_max_lr=0.1, warmup_num_steps=100, warmup_type="log")
+    assert float(s.lr_at(1)) == pytest.approx(0.0)
+    assert float(s.lr_at(100)) == pytest.approx(0.1, rel=1e-5)
+    # monotone increasing during warmup
+    vals = [float(s.lr_at(t)) for t in range(1, 100, 7)]
+    assert all(b >= a for a, b in zip(vals, vals[1:]))
+
+
+def test_warmup_decay():
+    s = WarmupDecayLR(
+        total_num_steps=100, warmup_max_lr=0.1, warmup_num_steps=10, warmup_type="linear"
+    )
+    assert float(s.lr_at(10)) == pytest.approx(0.1)
+    assert float(s.lr_at(55)) == pytest.approx(0.05)
+    assert float(s.lr_at(100)) == pytest.approx(0.0, abs=1e-7)
+    assert float(s.lr_at(200)) == pytest.approx(0.0, abs=1e-7)
+
+
+def test_one_cycle():
+    s = OneCycle(cycle_min_lr=0.01, cycle_max_lr=0.1, cycle_first_step_size=10)
+    assert float(s.lr_at(0)) == pytest.approx(0.01)
+    assert float(s.lr_at(10)) == pytest.approx(0.1)
+    assert float(s.lr_at(20)) == pytest.approx(0.01)
+    # decay phase
+    s2 = OneCycle(
+        cycle_min_lr=0.01, cycle_max_lr=0.1, cycle_first_step_size=10,
+        decay_step_size=10, decay_lr_rate=1.0,
+    )
+    assert float(s2.lr_at(30)) < 0.01
+
+
+def test_lr_range_test():
+    s = LRRangeTest(lr_range_test_min_lr=0.001, lr_range_test_step_size=10,
+                    lr_range_test_step_rate=1.0)
+    assert float(s.lr_at(0)) == pytest.approx(0.001)
+    assert float(s.lr_at(10)) == pytest.approx(0.002)
+    stair = LRRangeTest(lr_range_test_min_lr=0.001, lr_range_test_step_size=10,
+                        lr_range_test_step_rate=1.0, lr_range_test_staircase=True)
+    assert float(stair.lr_at(9)) == pytest.approx(0.001)
+    assert float(stair.lr_at(10)) == pytest.approx(0.002)
+
+
+def test_registry_and_step_api():
+    s = get_lr_schedule("WarmupLR", {"warmup_max_lr": 0.1, "warmup_num_steps": 10,
+                                     "warmup_type": "linear"})
+    lrs = [s.step()[0] for _ in range(12)]
+    assert lrs[-1] == pytest.approx(0.1)
+    sd = s.state_dict()
+    s2 = get_lr_schedule("WarmupLR", {"warmup_max_lr": 0.1, "warmup_num_steps": 10})
+    s2.load_state_dict(sd)
+    assert s2.last_step == 12
+    with pytest.raises(ValueError):
+        get_lr_schedule("bogus")
