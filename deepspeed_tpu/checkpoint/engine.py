@@ -114,6 +114,10 @@ class AsyncCheckpointEngine(NpzCheckpointEngine):
             with open(os.path.join(parent, "latest"), "w") as f:
                 f.write(os.path.basename(path))
 
+        # Serialize with any in-flight save: two writers would race on the shared
+        # "latest" pointer and commit() only joins the newest thread.
+        if self._thread is not None:
+            self._thread.join()
         self._thread = threading.Thread(target=write, daemon=True)
         self._thread.start()
 

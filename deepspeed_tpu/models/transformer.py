@@ -52,6 +52,13 @@ class TransformerConfig:
     remat_policy: str = "nothing_saveable"  # nothing_saveable | dots_with_no_batch_dims
     compute_dtype: typing.Any = jnp.bfloat16
     attention_impl: str = "xla"  # xla | flash (pallas)
+    # Pipeline parallelism (set by the engine from mesh/config; see parallel/pipeline.py)
+    pipeline_stages: int = 1
+    pipeline_microbatches: int = 1
+    mesh: typing.Any = None  # jax.sharding.Mesh when pipeline_stages > 1
+    # Sequence parallelism: shard the sequence dim over the ``seq`` mesh axis with
+    # ring attention (set by the engine; see parallel/ring_attention.py)
+    sequence_parallel: bool = False
 
     @property
     def head_dim(self):
@@ -205,6 +212,20 @@ def block_apply(cfg, p, x, mask=None, rope=None, alibi=None, deterministic=True,
     return x
 
 
+def _remat_policy(cfg):
+    """Named checkpoint policies. "minimal" saves only the cheap named activations
+    (projections, mlp hidden) and recomputes the O(s^2) attention internals in bwd —
+    the reference's "selective activation checkpointing" sweet spot."""
+    return {
+        "nothing_saveable": jax.checkpoint_policies.nothing_saveable,
+        "dots_with_no_batch_dims": jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims,
+        "everything_saveable": jax.checkpoint_policies.everything_saveable,
+        "minimal": jax.checkpoint_policies.save_only_these_names(
+            "q_proj", "k_proj", "v_proj", "attn_out", "mlp_hidden"
+        ),
+    }[cfg.remat_policy]
+
+
 def stack_init(rng, cfg):
     """Init all blocks stacked along a leading "layers" dim via vmap — the pytree has
     one leaf per block param with shape [n_layers, ...]. This is what makes
@@ -225,23 +246,21 @@ def stack_apply(cfg, stacked_params, x, mask=None, rope=None, alibi=None,
     """Run the L blocks. scan_layers=True: one compiled block iterated L times
     (compile-time constant in depth); False: unrolled python loop (better for very
     shallow nets / per-layer sharding experiments)."""
+    if cfg.sequence_parallel:
+        raise NotImplementedError(
+            "sequence_parallel requires ring attention (parallel/ring_attention.py); "
+            "not wired into the dense stack yet"
+        )
+    if cfg.pipeline_stages > 1:
+        return _pipeline_stack(cfg, stacked_params, x, mask, rope, alibi,
+                               deterministic, dropout_rng)
+
     body = lambda p, h, rng: block_apply(
         cfg, p, h, mask=mask, rope=rope, alibi=alibi,
         deterministic=deterministic, dropout_rng=rng,
     )
     if cfg.remat:
-        policy = {
-            "nothing_saveable": jax.checkpoint_policies.nothing_saveable,
-            "dots_with_no_batch_dims": jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims,
-            "everything_saveable": jax.checkpoint_policies.everything_saveable,
-            # save only the cheap named activations (projections, mlp hidden);
-            # recompute the O(s^2) attention internals in bwd. The reference's
-            # "selective activation checkpointing" sweet spot.
-            "minimal": jax.checkpoint_policies.save_only_these_names(
-                "q_proj", "k_proj", "v_proj", "attn_out", "mlp_hidden"
-            ),
-        }[cfg.remat_policy]
-        body = jax.checkpoint(body, policy=policy, static_argnums=())
+        body = jax.checkpoint(body, policy=_remat_policy(cfg), static_argnums=())
 
     if not cfg.scan_layers:
         for i in range(cfg.n_layers):
@@ -259,6 +278,51 @@ def stack_apply(cfg, stacked_params, x, mask=None, rope=None, alibi=None,
 
     (x, _), _ = jax.lax.scan(scan_fn, (x, jnp.zeros((), jnp.int32)), stacked_params)
     return x
+
+
+def _pipeline_stack(cfg, stacked_params, x, mask, rope, alibi, deterministic,
+                    dropout_rng):
+    """Pipeline-parallel path of ``stack_apply`` (see parallel/pipeline.py)."""
+    from ..parallel.pipeline import pipeline_stack_apply
+
+    if cfg.mesh is None:
+        raise ValueError("pipeline_stages > 1 requires cfg.mesh to be set")
+
+    # Batched side inputs must travel with their microbatch through the pipe
+    # rotation; unbatched ones ride the closure. Shapes from CausalLM.apply:
+    # mask [b,1,q,kv] (causal-only masks are [1,1,q,kv]), rope cos/sin [b,s,hd/2].
+    b = x.shape[0]
+    side = {}
+    if mask is not None and mask.ndim == 4 and mask.shape[0] == b and b > 1:
+        side["mask"] = mask
+    if rope is not None and rope[0].ndim == 3 and rope[0].shape[0] == b:
+        side["rope_cos"], side["rope_sin"] = rope
+
+    def pipe_block(p, h, side_mb, rng):
+        m = side_mb["mask"] if "mask" in side_mb else mask
+        r = ((side_mb["rope_cos"], side_mb["rope_sin"])
+             if "rope_cos" in side_mb else rope)
+        return block_apply(cfg, p, h, mask=m, rope=r, alibi=alibi,
+                           deterministic=deterministic, dropout_rng=rng)
+
+    if cfg.remat:
+        pipe_block = jax.checkpoint(pipe_block, policy=_remat_policy(cfg))
+
+    def block_fn(p, h, side_mb, layer_idx, mb_idx):
+        # fold in both layer and microbatch so dropout masks are independent
+        # across the accumulation window (non-pipeline grad-accum draws a fresh
+        # step rng per micro-step)
+        rng_i = None
+        if dropout_rng is not None:
+            rng_i = jax.random.fold_in(
+                jax.random.fold_in(dropout_rng, layer_idx), mb_idx
+            )
+        return pipe_block(p, h, side_mb, rng_i)
+
+    return pipeline_stack_apply(
+        cfg, stacked_params, x, mesh=cfg.mesh,
+        n_microbatches=cfg.pipeline_microbatches, block_fn=block_fn, side=side,
+    )
 
 
 class CausalLM:

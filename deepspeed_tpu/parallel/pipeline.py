@@ -1,0 +1,151 @@
+"""Pipeline parallelism: a compiled GPipe microbatch loop over the ``pipe`` mesh axis.
+
+TPU-native equivalent of the reference's pipeline engine
+(``runtime/pipe/engine.py:40`` / ``schedule.py:189`` / ``p2p.py``): the reference
+interprets an instruction list per step (1F1B ``TrainSchedule``) and moves
+activations with ``dist.send/recv`` between stage processes. Here the whole schedule
+is ONE differentiable XLA program:
+
+- the layer stack (leading ``layers`` dim) is sharded over the ``pipe`` axis, so each
+  stage holds ``n_layers / n_stages`` contiguous layers — the reference's
+  ``PipelineModule._partition_layers(method='uniform')`` (``pipe/module.py:353``);
+- a ``lax.scan`` runs ``M + S - 1`` ticks; each tick every stage applies its local
+  layers to its in-flight microbatch, then ``ppermute`` rotates activations to the
+  next stage — the Send/RecvActivation instructions (``pipe/engine.py:907,:999``)
+  become one ICI collective-permute;
+- reverse-mode AD through the scan+ppermute yields the backward pipeline (grads flow
+  stage S-1 -> 0 via the transposed permute) — the reference's Send/RecvGrad
+  instructions for free, with identical bubble structure to GPipe;
+- shapes are static, so the activation-meta handshake (``pipe/engine.py:789
+  _send_tensor_meta``) disappears by construction.
+
+Implementation notes:
+- ``jax.shard_map(axis_names={'pipe'})``: the program is *manual* over ``pipe`` only;
+  ``data`` / ``model`` / ``seq`` stay under the SPMD partitioner, so ZeRO sharding
+  and tensor parallelism compose with the pipeline without hand-written collectives.
+- batched side inputs (padding masks, rope tables built from per-row positions)
+  travel WITH their microbatch through the ppermute rotation, so every stage sees
+  the side inputs matching its in-flight microbatch.
+- microbatch accounting: with M microbatches and S stages the bubble fraction is
+  (S-1)/(M+S-1); gradient accumulation happens inside the loop (sum over
+  microbatches), mirroring how the reference folds grad-accum into the schedule.
+- the last stage's outputs are made pipe-replicated with a masked ``psum`` so the
+  LM head / loss can run outside the manual region.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from .topology import PIPE_AXIS, DATA_AXIS
+
+
+def pipeline_stack_apply(cfg, stacked_params, x, *, mesh, n_microbatches,
+                         block_fn, side=None):
+    """Run stacked transformer blocks pipelined over the ``pipe`` mesh axis.
+
+    Args:
+      cfg: model config (provides ``n_layers``).
+      stacked_params: pytree of arrays with leading ``layers`` dim (sharded over
+        ``pipe``).
+      x: [batch, seq, d_model] activations (batch sharded over ``data``).
+      mesh: the device mesh; must contain a ``pipe`` axis of size S > 1.
+      n_microbatches: M; batch must be divisible by M.
+      block_fn: ``block_fn(params_i, h, side_mb, layer_idx, mb_idx) -> h`` — one
+        transformer block (already remat-wrapped by the caller). ``side_mb`` is the
+        per-microbatch slice of ``side``; ``mb_idx`` identifies the in-flight
+        microbatch (for per-microbatch rng folding).
+      side: optional pytree of per-row side inputs with leading dim == batch
+        (padding mask, rope cos/sin). Unbatched side inputs should be closed over
+        in ``block_fn`` instead.
+
+    Returns: [batch, seq, d_model] transformed activations (pipe-replicated).
+    """
+    S = mesh.shape[PIPE_AXIS]
+    M = int(n_microbatches)
+    if M < 1:
+        raise ValueError(f"n_microbatches must be >= 1, got {M}")
+    b, s, d = x.shape
+    if b % M:
+        raise ValueError(f"batch {b} not divisible by pipeline microbatches {M}")
+    n_layers = cfg.n_layers
+    if n_layers % S:
+        raise ValueError(f"n_layers {n_layers} not divisible by pipeline stages {S}")
+    layers_per_stage = n_layers // S
+    side = side if side is not None else {}
+
+    # [b, ...] -> [M, mb, ...] for activations and every batched side input; keep the
+    # microbatch rows sharded over data.
+    def to_microbatches(a):
+        a = a.reshape((M, b // M) + a.shape[1:])
+        spec = P(*((None, DATA_AXIS) + (None,) * (a.ndim - 2)))
+        return jax.lax.with_sharding_constraint(a, jax.sharding.NamedSharding(mesh, spec))
+
+    xs = to_microbatches(x)
+    side_ms = jax.tree_util.tree_map(to_microbatches, side)
+
+    def local_layers(w, h, side_mb, stage, mb_idx):
+        def body(carry, w_i):
+            h, i = carry
+            h = block_fn(w_i, h, side_mb, stage * layers_per_stage + i, mb_idx)
+            return (h, i + 1), None
+
+        (h, _), _ = jax.lax.scan(body, (h, jnp.zeros((), jnp.int32)), w)
+        return h
+
+    perm = [(i, (i + 1) % S) for i in range(S)]
+
+    def pipe_fn(w, xs, side_ms):
+        stage = jax.lax.axis_index(PIPE_AXIS)
+        T = M + S - 1
+        state = {"h": jnp.zeros_like(xs[0]),
+                 "side": jax.tree_util.tree_map(lambda a: jnp.zeros_like(a[0]), side_ms),
+                 "mb": jnp.zeros((), jnp.int32)}
+        outs = jnp.zeros_like(xs)
+
+        def tick(carry, t):
+            state, outs = carry
+            # stage 0 injects microbatch t (LoadMicroBatch, pipe/engine.py:748)
+            tm = jnp.clip(t, 0, M - 1)
+            inj = {"h": jax.lax.dynamic_index_in_dim(xs, tm, 0, keepdims=False),
+                   "side": jax.tree_util.tree_map(
+                       lambda a: jax.lax.dynamic_index_in_dim(a, tm, 0, keepdims=False),
+                       side_ms),
+                   "mb": tm}
+            state = jax.tree_util.tree_map(
+                lambda new, old: jnp.where(stage == 0, new, old), inj, state)
+            h = local_layers(w, state["h"], state["side"], stage, state["mb"])
+            # last stage collects microbatch t-(S-1)
+            idx = t - (S - 1)
+            sel = (stage == S - 1) & (idx >= 0)
+            cidx = jnp.clip(idx, 0, M - 1)
+            cur = jax.lax.dynamic_index_in_dim(outs, cidx, 0, keepdims=False)
+            outs = jax.lax.dynamic_update_index_in_dim(
+                outs, jnp.where(sel, h, cur), cidx, 0
+            )
+            # rotate the microbatch (activations + its side inputs + identity) to
+            # the next stage (Send/RecvActivation as one collective-permute)
+            nxt = jax.tree_util.tree_map(
+                lambda a: jax.lax.ppermute(a, PIPE_AXIS, perm),
+                {"h": h, "side": state["side"], "mb": state["mb"]})
+            return (nxt, outs), None
+
+        (state, outs), _ = jax.lax.scan(tick, (state, outs), jnp.arange(T))
+        # make the last stage's outputs pipe-replicated for the head/loss
+        outs = jax.lax.psum(
+            jnp.where(stage == S - 1, outs, jnp.zeros((), outs.dtype)), PIPE_AXIS
+        )
+        return outs
+
+    param_specs = jax.tree_util.tree_map(lambda _: P(PIPE_AXIS), stacked_params)
+    side_specs = jax.tree_util.tree_map(lambda _: P(), side_ms)
+    sm = jax.shard_map(
+        pipe_fn,
+        mesh=mesh,
+        in_specs=(param_specs, P(), side_specs),
+        out_specs=P(),
+        axis_names={PIPE_AXIS},
+        check_vma=False,
+    )
+    outs = sm(stacked_params, xs, side_ms)
+    return outs.reshape(b, s, d)

@@ -26,7 +26,7 @@ import numpy as np
 import jax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from .topology import DATA_AXIS, MODEL_AXIS, SEQ_AXIS
+from .topology import DATA_AXIS, MODEL_AXIS, SEQ_AXIS, PIPE_AXIS
 from ..utils.logging import logger
 
 # Tensor-parallel rule table: logical axis name -> mesh axis (None = replicated).
@@ -36,7 +36,7 @@ DEFAULT_TP_RULES = {
     "kv": MODEL_AXIS,
     "mlp": MODEL_AXIS,
     "embed": None,
-    "layers": None,      # scan dim; pipeline shards it over "pipe" explicitly
+    "layers": PIPE_AXIS,  # scan dim; sharded iff the mesh has a pipe axis > 1
     "seq_table": None,   # learned position table
     "expert": None,      # expert dim handled by the MoE layer itself
 }

@@ -41,7 +41,7 @@ from ..ops import (
     clip_grads_by_global_norm,
     global_grad_norm,
 )
-from ..parallel import build_mesh, DATA_AXIS, EXPERT_AXIS
+from ..parallel import build_mesh, DATA_AXIS, EXPERT_AXIS, PIPE_AXIS
 from ..parallel.sharding import (
     param_partition_specs,
     state_partition_specs,
@@ -90,6 +90,26 @@ class DeepSpeedEngine:
 
         self.zero_stage = self._config.zero_optimization.stage
         self._persist_threshold = self._config.zero_optimization.param_persistence_threshold
+
+        # -- pipeline parallelism ----------------------------------------------------
+        # With pipe > 1 the whole accumulation window runs as ONE compiled GPipe
+        # sweep (parallel/pipeline.py): pipeline microbatches = the configured
+        # gradient_accumulation_steps (the reference folds grad-accum into the 1F1B
+        # schedule the same way, pipe/engine.py:285 train_batch).
+        self.pipe_stages = self.mesh.shape.get(PIPE_AXIS, 1)
+        self._pipe_microbatches = 1
+        if self.pipe_stages > 1:
+            if not (hasattr(self.module, "config")
+                    and hasattr(self.module.config, "pipeline_stages")):
+                raise ConfigError(
+                    "pipeline parallelism (mesh pipe > 1) requires a model whose "
+                    "config supports pipeline_stages (the transformer backbone)"
+                )
+            self._pipe_microbatches = self.gradient_accumulation_steps_
+            self.gradient_accumulation_steps_ = 1
+            self.module.config.pipeline_stages = self.pipe_stages
+            self.module.config.pipeline_microbatches = self._pipe_microbatches
+            self.module.config.mesh = self.mesh
 
         # -- parameters (sharded at init = zero.Init) --------------------------------
         self._rng = jax.random.PRNGKey(self._config.seed)
@@ -359,8 +379,8 @@ class DeepSpeedEngine:
         """Reference ``engine.py:1542`` deepspeed_io."""
         return DeepSpeedDataLoader(
             dataset,
-            batch_size=batch_size or self.micro_batch_size * self.dp_world_size
-            // max(dist.get_world_size(), 1),
+            batch_size=batch_size or self.micro_batch_size * self._pipe_microbatches
+            * self.dp_world_size // max(dist.get_world_size(), 1),
             shuffle=True,
             seed=self._config.seed,
             collate_fn=collate_fn,
@@ -478,10 +498,19 @@ class DeepSpeedEngine:
         return mean_loss
 
     def eval_batch(self, batch):
-        """Loss without grads."""
+        """Loss without grads. Runs the non-pipelined forward even on pipe meshes
+        (eval has no accumulation window, so there is no microbatch contract; the
+        plain scan path reads the pipe-sharded layer stack via XLA's partitioner)."""
         if self._eval_fn is None:
+            module = self.module
+            if self.pipe_stages > 1:
+                import dataclasses
+
+                module = type(self.module)(
+                    dataclasses.replace(self.module.config, pipeline_stages=1)
+                )
             with self.mesh:
-                self._eval_fn = jax.jit(lambda p, b: self.module.loss(p, b))
+                self._eval_fn = jax.jit(lambda p, b: module.loss(p, b))
         return self._eval_fn(self.params, self._shard_batch(batch))
 
     def _current_lr(self):
