@@ -59,6 +59,14 @@ class TransformerConfig:
     # Sequence parallelism: shard the sequence dim over the ``seq`` mesh axis with
     # ring attention (set by the engine; see parallel/ring_attention.py)
     sequence_parallel: bool = False
+    # Mixture-of-Experts (see moe/sharded_moe.py; reference deepspeed/moe/)
+    n_experts: int = 0            # 0 = dense FFN
+    moe_top_k: int = 1
+    moe_capacity_factor: float = 1.25
+    moe_eval_capacity_factor: float = 2.0
+    moe_min_capacity: int = 4
+    moe_aux_loss_weight: float = 0.01
+    moe_noise_std: float = 0.0
 
     @property
     def head_dim(self):
@@ -131,6 +139,12 @@ def _mlp_apply(cfg, p, x):
 def block_init(rng, cfg):
     k_attn, k_mlp = jax.random.split(rng)
     out_std = cfg.initializer_range / (2.0 * cfg.n_layers) ** 0.5
+    if cfg.n_experts > 0:
+        from ..moe import moe_mlp_init
+
+        mlp = moe_mlp_init(k_mlp, cfg)
+    else:
+        mlp = _mlp_init(k_mlp, cfg)
     return {
         "ln_1": _norm_init(cfg),
         "attn": L.attention_init(
@@ -138,13 +152,14 @@ def block_init(rng, cfg):
             cfg.initializer_range, out_stddev=out_std,
         ),
         "ln_2": _norm_init(cfg),
-        "mlp": _mlp_init(k_mlp, cfg),
+        "mlp": mlp,
     }
 
 
 def block_apply(cfg, p, x, mask=None, rope=None, alibi=None, deterministic=True,
                 dropout_rng=None):
     """One transformer block. x: [batch, seq, d_model] in compute dtype.
+    Returns ``(x, aux_loss)`` — aux is the MoE load-balancing term (0 for dense).
 
     Params arrive as fp32 masters and are cast to the compute dtype here (norm
     params stay fp32 — layernorm computes in fp32 internally anyway)."""
@@ -153,7 +168,9 @@ def block_apply(cfg, p, x, mask=None, rope=None, alibi=None, deterministic=True,
         "ln_1": p["ln_1"],
         "ln_2": p["ln_2"],
         "attn": jax.tree_util.tree_map(lambda a: a.astype(cfg.compute_dtype), p["attn"]),
-        "mlp": jax.tree_util.tree_map(lambda a: a.astype(cfg.compute_dtype), p["mlp"]),
+        # MoE params cast inside moe_mlp_apply (router stays fp32 for stable gating)
+        "mlp": p["mlp"] if cfg.n_experts > 0 else jax.tree_util.tree_map(
+            lambda a: a.astype(cfg.compute_dtype), p["mlp"]),
     }
     b, s, d = x.shape
 
@@ -199,17 +216,33 @@ def block_apply(cfg, p, x, mask=None, rope=None, alibi=None, deterministic=True,
             return h
         return L.dropout(jax.random.fold_in(dropout_rng, salt), h, cfg.dropout, False)
 
+    aux = jnp.zeros((), jnp.float32)
+
+    def mlp(h):
+        nonlocal aux
+        if cfg.n_experts > 0:
+            from ..moe import moe_mlp_apply
+
+            moe_rng = (jax.random.fold_in(dropout_rng, 4)
+                       if dropout_rng is not None else None)
+            out, aux_i = moe_mlp_apply(cfg, p["mlp"], h, deterministic=deterministic,
+                                       rng=moe_rng)
+            aux = aux + aux_i
+            return out
+        return _mlp_apply(cfg, p["mlp"], h)
+
     if cfg.parallel_attn_mlp:
         h = _norm_apply(cfg, p["ln_1"], x)
-        return x + maybe_drop(attn(h), 2) + maybe_drop(_mlp_apply(cfg, p["mlp"], h), 3)
-    if cfg.prenorm:
+        return x + maybe_drop(attn(h), 2) + maybe_drop(mlp(h), 3), aux
+    elif cfg.prenorm:
         x = x + maybe_drop(attn(_norm_apply(cfg, p["ln_1"], x)), 2)
-        x = x + maybe_drop(_mlp_apply(cfg, p["mlp"], _norm_apply(cfg, p["ln_2"], x)), 3)
-        return x
-    # post-norm (BERT)
-    x = _norm_apply(cfg, p["ln_1"], x + maybe_drop(attn(x), 2))
-    x = _norm_apply(cfg, p["ln_2"], x + maybe_drop(_mlp_apply(cfg, p["mlp"], x), 3))
-    return x
+        x = x + maybe_drop(mlp(_norm_apply(cfg, p["ln_2"], x)), 3)
+        return x, aux
+    else:
+        # post-norm (BERT)
+        x = _norm_apply(cfg, p["ln_1"], x + maybe_drop(attn(x), 2))
+        x = _norm_apply(cfg, p["ln_2"], x + maybe_drop(mlp(x), 3))
+        return x, aux
 
 
 def _remat_policy(cfg):
@@ -243,9 +276,9 @@ def stack_init(rng, cfg):
 
 def stack_apply(cfg, stacked_params, x, mask=None, rope=None, alibi=None,
                 deterministic=True, dropout_rng=None):
-    """Run the L blocks. scan_layers=True: one compiled block iterated L times
-    (compile-time constant in depth); False: unrolled python loop (better for very
-    shallow nets / per-layer sharding experiments)."""
+    """Run the L blocks; returns ``(x, aux_loss)``. scan_layers=True: one compiled
+    block iterated L times (compile-time constant in depth); False: unrolled python
+    loop (better for very shallow nets / per-layer sharding experiments)."""
     if cfg.sequence_parallel:
         raise NotImplementedError(
             "sequence_parallel requires ring attention (parallel/ring_attention.py); "
@@ -262,22 +295,26 @@ def stack_apply(cfg, stacked_params, x, mask=None, rope=None, alibi=None,
     if cfg.remat:
         body = jax.checkpoint(body, policy=_remat_policy(cfg), static_argnums=())
 
+    aux = jnp.zeros((), jnp.float32)
     if not cfg.scan_layers:
         for i in range(cfg.n_layers):
             p_i = jax.tree_util.tree_map(lambda a: a[i], stacked_params)
             rng_i = jax.random.fold_in(dropout_rng, i) if dropout_rng is not None else None
-            x = body(p_i, x, rng_i)
-        return x
+            x, aux_i = body(p_i, x, rng_i)
+            aux = aux + aux_i
+        return x, aux
 
     def scan_fn(carry, xs):
-        h, i = carry
+        h, i, aux = carry
         p = xs
         rng_i = jax.random.fold_in(dropout_rng, i) if dropout_rng is not None else None
-        h = body(p, h, rng_i)
-        return (h, i + 1), None
+        h, aux_i = body(p, h, rng_i)
+        return (h, i + 1, aux + aux_i), None
 
-    (x, _), _ = jax.lax.scan(scan_fn, (x, jnp.zeros((), jnp.int32)), stacked_params)
-    return x
+    (x, _, aux), _ = jax.lax.scan(
+        scan_fn, (x, jnp.zeros((), jnp.int32), aux), stacked_params
+    )
+    return x, aux
 
 
 def _pipeline_stack(cfg, stacked_params, x, mask, rope, alibi, deterministic,
@@ -358,8 +395,9 @@ class CausalLM:
 
     # -- forward ------------------------------------------------------------------
     def apply(self, params, input_ids, positions=None, attention_mask=None,
-              deterministic=True, dropout_rng=None):
-        """input_ids: [batch, seq] int32 -> logits [batch, seq, vocab] (compute dtype)."""
+              deterministic=True, dropout_rng=None, return_aux=False):
+        """input_ids: [batch, seq] int32 -> logits [batch, seq, vocab] (compute
+        dtype); with ``return_aux`` also the MoE auxiliary loss."""
         cfg = self.config
         b, s = input_ids.shape
         if positions is None:
@@ -382,15 +420,16 @@ class CausalLM:
         if cfg.position_embedding == "alibi":
             alibi = L.alibi_bias(cfg.n_heads, s, s)
 
-        x = stack_apply(cfg, params["blocks"], x, mask=mask, rope=rope, alibi=alibi,
-                        deterministic=deterministic, dropout_rng=dropout_rng)
+        x, aux = stack_apply(cfg, params["blocks"], x, mask=mask, rope=rope,
+                             alibi=alibi, deterministic=deterministic,
+                             dropout_rng=dropout_rng)
         x = _norm_apply(cfg, params["ln_f"], x)
 
         if cfg.tie_embeddings:
             logits = L.embedding_attend(params["wte"], x)
         else:
             logits = L.linear_apply(params["lm_head"], x)
-        return logits
+        return (logits, aux) if return_aux else logits
 
     # -- loss ---------------------------------------------------------------------
     def loss(self, params, batch, deterministic=True, dropout_rng=None):
@@ -402,12 +441,12 @@ class CausalLM:
             labels = jnp.concatenate(
                 [input_ids[:, 1:], jnp.full_like(input_ids[:, :1], -100)], axis=1
             )
-        logits = self.apply(
+        logits, aux = self.apply(
             params, input_ids, attention_mask=batch.get("attention_mask"),
             positions=batch.get("position_ids"), deterministic=deterministic,
-            dropout_rng=dropout_rng,
+            dropout_rng=dropout_rng, return_aux=True,
         )
-        return cross_entropy_loss(logits, labels)
+        return cross_entropy_loss(logits, labels) + aux
 
 
 def cross_entropy_loss(logits, labels, ignore_index=-100):

@@ -1,0 +1,8 @@
+from .sharded_moe import (
+    top_k_gating,
+    moe_mlp_init,
+    moe_mlp_apply,
+    expert_capacity,
+)
+
+__all__ = ["top_k_gating", "moe_mlp_init", "moe_mlp_apply", "expert_capacity"]

@@ -1,0 +1,154 @@
+"""Mixture-of-Experts: top-k gating with capacity + expert-parallel dispatch.
+
+TPU-native equivalent of the reference's ``deepspeed/moe/sharded_moe.py``:
+``TopKGate`` (reference ``:420``), ``top1gating``/``top2gating`` (``:179``/``:277``)
+and the ``_AllToAll`` autograd function (``:90``). The reference dispatches tokens
+with an explicit ``dist.all_to_all_single`` between expert-parallel ranks; here the
+dispatch/combine are einsums in the GShard formulation and XLA's SPMD partitioner
+emits the all_to_all when token groups are sharded over ``data`` and the expert
+dim over the ``expert`` mesh axis.
+
+Formulation (GShard / Switch):
+- tokens keep their [batch, seq] layout; each batch row is a dispatch *group* with
+  its own capacity (capacity is per-group, so dispatch is local math — no global
+  sort, no dynamic shapes);
+- ``dispatch`` [b, s, E, C] (bool) routes token s of group b to slot c of expert e;
+  ``combine`` [b, s, E, C] carries the gate weights for the weighted sum back;
+- expert compute runs on [E, b, C, m] — sharded (expert, data) — so the
+  data->expert resharding before/after is exactly the reference's all_to_all pair;
+- the load-balancing aux loss is the Switch/GShard ``E * sum(f_e * p_e)`` term
+  (reference ``sharded_moe.py:229``), returned to be added to the model loss.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from ..models.layers import Param, normal_init
+
+
+def expert_capacity(seq_len, n_experts, top_k, capacity_factor, min_capacity=4):
+    """Per-group expert capacity (reference ``sharded_moe.py:179`` capacity calc)."""
+    cap = int(capacity_factor * seq_len * top_k / n_experts)
+    return max(cap, min_capacity)
+
+
+def top_k_gating(logits, top_k, capacity, *, rng=None, noise_std=0.0):
+    """Top-k gating with per-group capacity.
+
+    Args:
+      logits: [b, s, E] router logits (fp32).
+      top_k: 1 or 2 (reference supports k in {1, 2}; we allow any k < E).
+      capacity: C slots per expert per group.
+      rng: optional rng for gating noise (reference's ``noisy_gate_policy``).
+      noise_std: stddev of the jitter noise added to logits before top-k.
+
+    Returns:
+      dispatch: [b, s, E, C] bool — token -> (expert, slot) routing.
+      combine: [b, s, E, C] float32 — gate weights for the return combine.
+      aux_loss: scalar load-balancing loss (Switch: E * sum(f_e * p_e)).
+    """
+    b, s, E = logits.shape
+    logits = logits.astype(jnp.float32)
+    gates = jax.nn.softmax(logits, axis=-1)  # [b, s, E]
+
+    select_logits = logits
+    if noise_std > 0.0 and rng is not None:
+        select_logits = logits + jax.random.normal(rng, logits.shape) * noise_std
+
+    # iteratively pick k experts per token, masking previous picks
+    masked = select_logits
+    expert_masks = []   # k x [b, s, E] one-hot
+    expert_gates = []   # k x [b, s]
+    for _ in range(top_k):
+        idx = jnp.argmax(masked, axis=-1)                      # [b, s]
+        onehot = jax.nn.one_hot(idx, E, dtype=jnp.float32)     # [b, s, E]
+        expert_masks.append(onehot)
+        expert_gates.append(jnp.sum(gates * onehot, axis=-1))  # [b, s]
+        masked = jnp.where(onehot > 0, -jnp.inf, masked)
+
+    # aux loss from the top-1 assignment (reference top1gating:229 / top2gating:303)
+    me = jnp.mean(gates, axis=(0, 1))           # [E] mean router prob
+    ce = jnp.mean(expert_masks[0], axis=(0, 1)) # [E] fraction of tokens -> expert
+    aux_loss = E * jnp.sum(me * ce)
+
+    # position of each token within its expert's queue, counted across the k
+    # choices in priority order (choice 0 gets slots first, as in top2gating where
+    # locations2 += sum(mask1))
+    dispatch = jnp.zeros((b, s, E, capacity), jnp.bool_)
+    combine = jnp.zeros((b, s, E, capacity), jnp.float32)
+    prior_counts = jnp.zeros((b, E), jnp.float32)  # slots consumed by higher choices
+    denom = jnp.zeros((b, s), jnp.float32)
+    kept_masks = []
+    for choice, (mask, gate) in enumerate(zip(expert_masks, expert_gates)):
+        # cumulative position of this token in expert's queue within its group
+        pos_in_expert = jnp.cumsum(mask, axis=1) - mask        # [b, s, E]
+        pos = pos_in_expert + prior_counts[:, None, :]
+        keep = mask * (pos < capacity)                         # drop overflow tokens
+        kept_masks.append((keep, gate))
+        prior_counts = prior_counts + jnp.sum(keep, axis=1)
+        slot = jnp.sum(pos * keep, axis=-1)                    # [b, s]
+        slot_oh = jax.nn.one_hot(slot, capacity, dtype=jnp.float32)  # [b, s, C]
+        routed = keep[..., None] * slot_oh[:, :, None, :]      # [b, s, E, C]
+        dispatch = dispatch | (routed > 0)
+        combine = combine + routed * gate[..., None, None]
+        denom = denom + gate * jnp.sum(keep, axis=-1)
+
+    # normalize combine weights over the kept choices (top2gating:321 renormalize)
+    combine = combine / jnp.maximum(denom, 1e-9)[..., None, None]
+    return dispatch, combine, aux_loss
+
+
+def moe_mlp_init(rng, cfg):
+    """Expert-stacked MLP params: leading "expert" logical axis (sharded over the
+    ``expert`` mesh axis) + router. Mirrors the reference's ``Experts`` module
+    (``moe/experts.py``) holding E copies of the FFN."""
+    E = cfg.n_experts
+    k_router, k1, k2 = jax.random.split(rng, 3)
+    std = cfg.initializer_range
+    out_std = std / (2.0 * cfg.n_layers) ** 0.5
+    return {
+        "router": {
+            "kernel": Param(normal_init(k_router, (cfg.d_model, E), std),
+                            ("embed", "expert_logits"))
+        },
+        "wi": Param(normal_init(k1, (E, cfg.d_model, cfg.d_ff), std),
+                    ("expert", "embed", "mlp")),
+        "wo": Param(normal_init(k2, (E, cfg.d_ff, cfg.d_model), out_std),
+                    ("expert", "mlp", "embed")),
+    }
+
+
+def moe_mlp_apply(cfg, p, x, *, deterministic=True, rng=None):
+    """MoE FFN. x: [b, s, m] -> (y [b, s, m], aux_loss scalar).
+
+    The two big einsums below are the all_to_all pair: ``expert_in`` reshards from
+    token-sharded (data) to expert-sharded layout and ``y`` back again.
+    """
+    from ..models import layers as L
+
+    b, s, m = x.shape
+    E = cfg.n_experts
+    cap_factor = (cfg.moe_eval_capacity_factor if deterministic
+                  else cfg.moe_capacity_factor)
+    capacity = expert_capacity(s, E, cfg.moe_top_k, cap_factor, cfg.moe_min_capacity)
+
+    router_logits = jnp.einsum(
+        "bsm,me->bse", x.astype(jnp.float32), p["router"]["kernel"].astype(jnp.float32)
+    )
+    noise = cfg.moe_noise_std if not deterministic else 0.0
+    dispatch, combine, aux = top_k_gating(
+        router_logits, cfg.moe_top_k, capacity, rng=rng, noise_std=noise
+    )
+    dispatch_f = dispatch.astype(x.dtype)
+    combine = combine.astype(x.dtype)
+
+    # data-sharded [b,s,..] -> expert-sharded [E,b,C,..]: the all_to_all
+    expert_in = jnp.einsum("bsec,bsm->ebcm", dispatch_f, x)
+    w_i = p["wi"].astype(x.dtype)
+    w_o = p["wo"].astype(x.dtype)
+    act = L.ACTIVATIONS[cfg.activation if cfg.activation != "swiglu" else "gelu"]
+    h = act(jnp.einsum("ebcm,emf->ebcf", expert_in, w_i))
+    expert_out = jnp.einsum("ebcf,efm->ebcm", h, w_o)
+    # expert-sharded -> data-sharded: the return all_to_all
+    y = jnp.einsum("bsec,ebcm->bsm", combine, expert_out)
+    return y, aux * cfg.moe_aux_loss_weight

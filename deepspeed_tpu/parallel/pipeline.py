@@ -51,15 +51,17 @@ def pipeline_stack_apply(cfg, stacked_params, x, *, mesh, n_microbatches,
       x: [batch, seq, d_model] activations (batch sharded over ``data``).
       mesh: the device mesh; must contain a ``pipe`` axis of size S > 1.
       n_microbatches: M; batch must be divisible by M.
-      block_fn: ``block_fn(params_i, h, side_mb, layer_idx, mb_idx) -> h`` — one
-        transformer block (already remat-wrapped by the caller). ``side_mb`` is the
-        per-microbatch slice of ``side``; ``mb_idx`` identifies the in-flight
-        microbatch (for per-microbatch rng folding).
+      block_fn: ``block_fn(params_i, h, side_mb, layer_idx, mb_idx) -> (h, aux)`` —
+        one transformer block (already remat-wrapped by the caller). ``side_mb`` is
+        the per-microbatch slice of ``side``; ``mb_idx`` identifies the in-flight
+        microbatch (for per-microbatch rng folding); ``aux`` is a scalar auxiliary
+        loss (MoE load balancing), summed over layers and microbatches.
       side: optional pytree of per-row side inputs with leading dim == batch
         (padding mask, rope cos/sin). Unbatched side inputs should be closed over
         in ``block_fn`` instead.
 
-    Returns: [batch, seq, d_model] transformed activations (pipe-replicated).
+    Returns: ``(y, aux)`` — [batch, seq, d_model] transformed activations and the
+    summed auxiliary loss, both pipe-replicated.
     """
     S = mesh.shape[PIPE_AXIS]
     M = int(n_microbatches)
@@ -81,40 +83,58 @@ def pipeline_stack_apply(cfg, stacked_params, x, *, mesh, n_microbatches,
         spec = P(*((None, DATA_AXIS) + (None,) * (a.ndim - 2)))
         return jax.lax.with_sharding_constraint(a, jax.sharding.NamedSharding(mesh, spec))
 
-    xs = to_microbatches(x)
-    side_ms = jax.tree_util.tree_map(to_microbatches, side)
+    # Cross the shard_map boundary in f32: for replicated (P()) inputs, reverse-mode
+    # AD inserts a psum over ``pipe`` of the cotangent, and XLA's partial-manual
+    # partitioner miscompiles bf16/f16 all-reduces ("Invalid binary instruction
+    # opcode copy"). Activations are cast back to the compute dtype inside.
+    compute_dtype = x.dtype
+    boundary_f32 = compute_dtype in (jnp.bfloat16, jnp.float16)
+
+    def to_boundary(a):
+        return a.astype(jnp.float32) if boundary_f32 and a.dtype == compute_dtype else a
+
+    xs = to_microbatches(to_boundary(x))
+    side_ms = jax.tree_util.tree_map(
+        lambda a: to_microbatches(to_boundary(a)), side)
 
     def local_layers(w, h, side_mb, stage, mb_idx):
         def body(carry, w_i):
-            h, i = carry
-            h = block_fn(w_i, h, side_mb, stage * layers_per_stage + i, mb_idx)
-            return (h, i + 1), None
+            h, i, aux = carry
+            h, aux_i = block_fn(w_i, h, side_mb, stage * layers_per_stage + i, mb_idx)
+            return (h, i + 1, aux + aux_i), None
 
-        (h, _), _ = jax.lax.scan(body, (h, jnp.zeros((), jnp.int32)), w)
-        return h
+        (h, _, aux), _ = jax.lax.scan(
+            body, (h, jnp.zeros((), jnp.int32), jnp.zeros((), jnp.float32)), w
+        )
+        return h, aux
 
     perm = [(i, (i + 1) % S) for i in range(S)]
 
     def pipe_fn(w, xs, side_ms):
         stage = jax.lax.axis_index(PIPE_AXIS)
         T = M + S - 1
-        state = {"h": jnp.zeros_like(xs[0]),
+        state = {"h": jnp.zeros(xs.shape[1:], compute_dtype),
                  "side": jax.tree_util.tree_map(lambda a: jnp.zeros_like(a[0]), side_ms),
                  "mb": jnp.zeros((), jnp.int32)}
-        outs = jnp.zeros_like(xs)
+        outs = jnp.zeros(xs.shape, compute_dtype)
+        aux0 = jnp.zeros((), jnp.float32)
 
         def tick(carry, t):
-            state, outs = carry
+            state, outs, aux_acc = carry
             # stage 0 injects microbatch t (LoadMicroBatch, pipe/engine.py:748)
             tm = jnp.clip(t, 0, M - 1)
-            inj = {"h": jax.lax.dynamic_index_in_dim(xs, tm, 0, keepdims=False),
+            inj = {"h": jax.lax.dynamic_index_in_dim(xs, tm, 0,
+                                                     keepdims=False).astype(compute_dtype),
                    "side": jax.tree_util.tree_map(
                        lambda a: jax.lax.dynamic_index_in_dim(a, tm, 0, keepdims=False),
                        side_ms),
                    "mb": tm}
             state = jax.tree_util.tree_map(
                 lambda new, old: jnp.where(stage == 0, new, old), inj, state)
-            h = local_layers(w, state["h"], state["side"], stage, state["mb"])
+            h, aux_i = local_layers(w, state["h"], state["side"], stage, state["mb"])
+            # bubble ticks compute on garbage; only in-window ticks contribute aux
+            valid = (t >= stage) & (t < stage + M)
+            aux_acc = aux_acc + jnp.where(valid, aux_i, 0.0)
             # last stage collects microbatch t-(S-1)
             idx = t - (S - 1)
             sel = (stage == S - 1) & (idx >= 0)
@@ -128,14 +148,25 @@ def pipeline_stack_apply(cfg, stacked_params, x, *, mesh, n_microbatches,
             nxt = jax.tree_util.tree_map(
                 lambda a: jax.lax.ppermute(a, PIPE_AXIS, perm),
                 {"h": h, "side": state["side"], "mb": state["mb"]})
-            return (nxt, outs), None
+            return (nxt, outs, aux_acc), None
 
-        (state, outs), _ = jax.lax.scan(tick, (state, outs), jnp.arange(T))
-        # make the last stage's outputs pipe-replicated for the head/loss
+        (state, outs, aux_acc), _ = jax.lax.scan(tick, (state, outs, aux0),
+                                                 jnp.arange(T))
+        # make the last stage's outputs pipe-replicated for the head/loss; aux is
+        # summed across stages (each stage contributed its own layers' aux).
+        # psum in f32: XLA's partial-manual partitioner builds an invalid bf16
+        # all-reduce combiner ("Invalid binary instruction opcode copy")
+        out_dtype = outs.dtype
         outs = jax.lax.psum(
-            jnp.where(stage == S - 1, outs, jnp.zeros((), outs.dtype)), PIPE_AXIS
-        )
-        return outs
+            jnp.where(stage == S - 1, outs, jnp.zeros((), outs.dtype))
+            .astype(jnp.float32),
+            PIPE_AXIS,
+        ).astype(out_dtype)
+        # mean over microbatches (each microbatch computes aux over its own
+        # tokens, like the reference's per-micro-step accumulation; the mean keeps
+        # the scale equal to a single full-batch aux term)
+        aux = jax.lax.psum(aux_acc, PIPE_AXIS) / M
+        return outs, aux
 
     param_specs = jax.tree_util.tree_map(lambda _: P(PIPE_AXIS), stacked_params)
     side_specs = jax.tree_util.tree_map(lambda _: P(), side_ms)
@@ -143,9 +174,9 @@ def pipeline_stack_apply(cfg, stacked_params, x, *, mesh, n_microbatches,
         pipe_fn,
         mesh=mesh,
         in_specs=(param_specs, P(), side_specs),
-        out_specs=P(),
+        out_specs=(P(), P()),
         axis_names={PIPE_AXIS},
         check_vma=False,
     )
-    outs = sm(stacked_params, xs, side_ms)
-    return outs.reshape(b, s, d)
+    outs, aux = sm(stacked_params, xs, side_ms)
+    return outs.reshape(b, s, d), aux

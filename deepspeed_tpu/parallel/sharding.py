@@ -26,7 +26,7 @@ import numpy as np
 import jax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from .topology import DATA_AXIS, MODEL_AXIS, SEQ_AXIS, PIPE_AXIS
+from .topology import DATA_AXIS, MODEL_AXIS, SEQ_AXIS, PIPE_AXIS, EXPERT_AXIS
 from ..utils.logging import logger
 
 # Tensor-parallel rule table: logical axis name -> mesh axis (None = replicated).
@@ -38,7 +38,8 @@ DEFAULT_TP_RULES = {
     "embed": None,
     "layers": PIPE_AXIS,  # scan dim; sharded iff the mesh has a pipe axis > 1
     "seq_table": None,   # learned position table
-    "expert": None,      # expert dim handled by the MoE layer itself
+    "expert": EXPERT_AXIS,  # expert-stacked FFN weights; all_to_all dispatch
+    "expert_logits": None,  # router output dim (small; replicated)
 }
 
 
