@@ -157,7 +157,7 @@ def block_init(rng, cfg):
 
 
 def block_apply(cfg, p, x, mask=None, rope=None, alibi=None, deterministic=True,
-                dropout_rng=None):
+                dropout_rng=None, kv_mask=None):
     """One transformer block. x: [batch, seq, d_model] in compute dtype.
     Returns ``(x, aux_loss)`` — aux is the MoE load-balancing term (0 for dense).
 
@@ -190,6 +190,12 @@ def block_apply(cfg, p, x, mask=None, rope=None, alibi=None, deterministic=True,
         n_rep = cfg.n_heads // cfg.kv_heads
         k = L._repeat_kv(k, n_rep)
         v = L._repeat_kv(v, n_rep)
+        if cfg.sequence_parallel:
+            from ..parallel.ring_attention import ring_attention
+
+            out = ring_attention(q, k, v, cfg.mesh, kv_mask=kv_mask, causal=True)
+            out = checkpoint_name(out, "attn_out")
+            return L.linear_apply(p["attn"]["o"], out.reshape(b, s, d))
         # flash path: plain causal attention, no padding mask / alibi / dropout
         flash_ok = (
             cfg.attention_impl == "flash" and alibi is None and mask is None
@@ -275,22 +281,28 @@ def stack_init(rng, cfg):
 
 
 def stack_apply(cfg, stacked_params, x, mask=None, rope=None, alibi=None,
-                deterministic=True, dropout_rng=None):
+                deterministic=True, dropout_rng=None, kv_mask=None):
     """Run the L blocks; returns ``(x, aux_loss)``. scan_layers=True: one compiled
     block iterated L times (compile-time constant in depth); False: unrolled python
     loop (better for very shallow nets / per-layer sharding experiments)."""
     if cfg.sequence_parallel:
-        raise NotImplementedError(
-            "sequence_parallel requires ring attention (parallel/ring_attention.py); "
-            "not wired into the dense stack yet"
-        )
+        if cfg.mesh is None:
+            raise ValueError("sequence_parallel requires cfg.mesh to be set")
+        if cfg.pipeline_stages > 1:
+            raise NotImplementedError(
+                "sequence_parallel + pipeline_stages > 1 not supported yet"
+            )
+        if cfg.position_embedding == "alibi":
+            raise NotImplementedError("alibi bias not supported with ring attention")
+        if cfg.attn_dropout > 0 and not deterministic:
+            raise NotImplementedError("attention dropout not supported with ring attention")
     if cfg.pipeline_stages > 1:
         return _pipeline_stack(cfg, stacked_params, x, mask, rope, alibi,
                                deterministic, dropout_rng)
 
     body = lambda p, h, rng: block_apply(
         cfg, p, h, mask=mask, rope=rope, alibi=alibi,
-        deterministic=deterministic, dropout_rng=rng,
+        deterministic=deterministic, dropout_rng=rng, kv_mask=kv_mask,
     )
     if cfg.remat:
         body = jax.checkpoint(body, policy=_remat_policy(cfg), static_argnums=())
@@ -408,10 +420,15 @@ class CausalLM:
             x = x + jnp.take(params["wpe"]["weight"].astype(cfg.compute_dtype), positions, axis=0)
 
         # mask=None means "plain causal" — lets the flash kernel run; an explicit
-        # padding mask forces the dense path.
+        # padding mask forces the dense path. Under sequence parallelism the
+        # padding mask stays in [b, s] form and rides the ring with K/V.
         mask = None
+        kv_mask = None
         if attention_mask is not None:
-            mask = L.causal_mask(s, s) & attention_mask[:, None, None, :].astype(bool)
+            if cfg.sequence_parallel:
+                kv_mask = attention_mask.astype(bool)
+            else:
+                mask = L.causal_mask(s, s) & attention_mask[:, None, None, :].astype(bool)
 
         rope = None
         if cfg.position_embedding == "rope":
@@ -422,7 +439,7 @@ class CausalLM:
 
         x, aux = stack_apply(cfg, params["blocks"], x, mask=mask, rope=rope,
                              alibi=alibi, deterministic=deterministic,
-                             dropout_rng=dropout_rng)
+                             dropout_rng=dropout_rng, kv_mask=kv_mask)
         x = _norm_apply(cfg, params["ln_f"], x)
 
         if cfg.tie_embeddings:

@@ -1,0 +1,122 @@
+"""Ring attention: exact causal attention over a sequence-sharded mesh axis.
+
+First-class sequence/context parallelism — the capability the reference lacks in
+0.9.1 (SURVEY §5: no Ulysses/ring/context-parallel; its long-sequence story is
+block-sparse attention + activation partitioning, ``deepspeed/ops/sparse_attention``,
+``activation_checkpointing/checkpointing.py:366``). Here long context is a mesh
+axis: activations shard the sequence dim over ``seq``, and attention runs as a ring
+(Liu et al., Ring Attention; see PAPERS.md):
+
+- each device holds its local Q block and a rotating K/V block;
+- ``S`` ring steps: compute one attention tile with flash-style online-softmax
+  accumulators (m, l, o), then ``ppermute`` the K/V block to the next device —
+  compute and ICI transfer overlap, peak memory is O(s_local^2 / S) per tile;
+- causal masking uses global block offsets; the ring starts on the device's own
+  diagonal block so row maxima are real before any fully-masked tile arrives;
+- the whole loop is differentiable (scan + ppermute transpose), giving the
+  backward ring for free.
+
+Implemented with ``jax.shard_map(axis_names={'seq'})`` — manual over ``seq`` only,
+so data/model/pipe sharding still compose via the SPMD partitioner.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from .topology import SEQ_AXIS
+
+_NEG = -1e30
+
+
+def _ring_attention_local(q, k, v, kv_mask, *, scale, causal, remat_steps):
+    """Per-device body. q/k/v: [b, sl, h, dh] local blocks; kv_mask: [b, sl] bool
+    for the local K/V block (True = attend) or None."""
+    S = jax.lax.axis_size(SEQ_AXIS)
+    my_idx = jax.lax.axis_index(SEQ_AXIS)
+    b, sl, h, dh = q.shape
+
+    qf = q.astype(jnp.float32)
+    o = jnp.zeros((b, sl, h, dh), jnp.float32)
+    m = jnp.full((b, h, sl), _NEG, jnp.float32)
+    l = jnp.zeros((b, h, sl), jnp.float32)
+
+    # rotate kv around the ring: at step r we hold the block of device
+    # (my_idx - r) mod S; sending to the next device advances everyone's r.
+    perm = [(i, (i + 1) % S) for i in range(S)]
+    q_pos = my_idx * sl + jnp.arange(sl)
+
+    def step(carry, r):
+        o, m, l, k_blk, v_blk, mask_blk = carry
+        kv_idx = (my_idx - r) % S
+        kv_pos = kv_idx * sl + jnp.arange(sl)
+
+        scores = jnp.einsum("bqhd,bkhd->bhqk", qf, k_blk.astype(jnp.float32)) * scale
+        allowed = jnp.ones((sl, sl), bool)
+        if causal:
+            allowed = q_pos[:, None] >= kv_pos[None, :]
+        if mask_blk is not None:
+            allowed = allowed & mask_blk[:, None, None, :]
+        scores = jnp.where(allowed, scores, _NEG)
+
+        blk_max = jnp.max(scores, axis=-1)            # [b, h, q]
+        new_m = jnp.maximum(m, blk_max)
+        correction = jnp.exp(m - new_m)
+        p = jnp.exp(scores - new_m[..., None])        # [b, h, q, k]
+        new_l = l * correction + jnp.sum(p, axis=-1)
+        pv = jnp.einsum("bhqk,bkhd->bqhd", p, v_blk.astype(jnp.float32))
+        new_o = o * correction.transpose(0, 2, 1)[..., None] + pv
+
+        k_nxt = jax.lax.ppermute(k_blk, SEQ_AXIS, perm)
+        v_nxt = jax.lax.ppermute(v_blk, SEQ_AXIS, perm)
+        mask_nxt = (jax.lax.ppermute(mask_blk, SEQ_AXIS, perm)
+                    if mask_blk is not None else None)
+        return (new_o, new_m, new_l, k_nxt, v_nxt, mask_nxt), None
+
+    if remat_steps:
+        step = jax.checkpoint(step)
+    (o, m, l, *_), _ = jax.lax.scan(step, (o, m, l, k, v, kv_mask), jnp.arange(S))
+    out = o / jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def ring_attention(q, k, v, mesh, *, kv_mask=None, causal=True, scale=None,
+                   remat_steps=True):
+    """Exact attention with the sequence dim sharded over the ``seq`` mesh axis.
+
+    Args:
+      q, k, v: [batch, seq, heads, head_dim] (seq GLOBAL; sharded over ``seq``
+        by the surrounding program — in_specs reshard if needed).
+      mesh: device mesh containing a ``seq`` axis.
+      kv_mask: optional [batch, seq] bool, True = key position attendable
+        (padding masks; rotates around the ring with K/V).
+      causal: apply causal masking on global positions.
+      remat_steps: recompute each ring tile in backward (O(s_local) memory).
+
+    Returns [batch, seq, heads, head_dim], same dtype as q.
+    """
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    S = mesh.shape[SEQ_AXIS]
+    if q.shape[1] % S:
+        raise ValueError(f"seq len {q.shape[1]} not divisible by seq axis {S}")
+
+    fn = functools.partial(_ring_attention_local, scale=scale, causal=causal,
+                           remat_steps=remat_steps)
+    qkv_spec = P(None, SEQ_AXIS, None, None)
+    mask_spec = P(None, SEQ_AXIS)
+    if kv_mask is None:
+        body = lambda q, k, v: fn(q, k, v, None)
+        in_specs = (qkv_spec, qkv_spec, qkv_spec)
+        args = (q, k, v)
+    else:
+        body = fn
+        in_specs = (qkv_spec, qkv_spec, qkv_spec, mask_spec)
+        args = (q, k, v, kv_mask)
+    sm = jax.shard_map(
+        body, mesh=mesh, in_specs=in_specs, out_specs=qkv_spec,
+        axis_names={SEQ_AXIS}, check_vma=False,
+    )
+    return sm(*args)

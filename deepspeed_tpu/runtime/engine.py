@@ -98,6 +98,18 @@ class DeepSpeedEngine:
         # schedule the same way, pipe/engine.py:285 train_batch).
         self.pipe_stages = self.mesh.shape.get(PIPE_AXIS, 1)
         self._pipe_microbatches = 1
+
+        # -- sequence parallelism (ring attention over the seq axis) -----------------
+        self.seq_parallel_size = self.mesh.shape.get("seq", 1)
+        if self.seq_parallel_size > 1:
+            if not (hasattr(self.module, "config")
+                    and hasattr(self.module.config, "sequence_parallel")):
+                raise ConfigError(
+                    "sequence parallelism (mesh seq > 1) requires a model whose "
+                    "config supports sequence_parallel (the transformer backbone)"
+                )
+            self.module.config.sequence_parallel = True
+            self.module.config.mesh = self.mesh
         if self.pipe_stages > 1:
             if not (hasattr(self.module, "config")
                     and hasattr(self.module.config, "pipeline_stages")):
