@@ -1,0 +1,46 @@
+"""Inference config (reference ``inference/config.py`` DeepSpeedInferenceConfig).
+
+Same key surface where it maps to TPU: dtype, tensor_parallel, max_tokens,
+quantization; CUDA-graph flags disappear (a jitted decode step IS the captured
+graph), kernel-injection flags disappear (XLA fuses the inference kernels).
+"""
+
+import typing
+
+from ..config.base import ConfigModel
+
+
+class TensorParallelConfig(ConfigModel):
+    """Reference ``inference/config.py`` DeepSpeedTPConfig."""
+
+    enabled: bool = True
+    tp_size: int = 1
+
+
+class QuantizationConfig(ConfigModel):
+    """Weight quantization (reference ``replace_module.py:140`` GroupQuantizer)."""
+
+    enabled: bool = False
+    bits: int = 8
+    group_size: int = 64
+
+
+class DeepSpeedInferenceConfig(ConfigModel):
+    dtype: str = "bfloat16"
+    tensor_parallel: TensorParallelConfig = None
+    max_tokens: int = 1024          # reference max_out_tokens
+    min_tokens: int = 1
+    max_batch_size: int = 8
+    quant: QuantizationConfig = None
+    replace_with_kernel_inject: bool = False  # accepted for config compat; no-op
+    seed: int = 0
+
+    def _validate(self):
+        if self.tensor_parallel is None:
+            self.tensor_parallel = TensorParallelConfig()
+        if self.quant is None:
+            self.quant = QuantizationConfig()
+        if self.dtype not in ("float16", "bfloat16", "float32"):
+            from ..config.base import ConfigError
+
+            raise ConfigError(f"inference dtype must be fp16/bf16/fp32, got {self.dtype}")

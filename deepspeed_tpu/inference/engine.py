@@ -1,0 +1,220 @@
+"""Inference engine (reference ``inference/engine.py:89`` InferenceEngine).
+
+The reference wraps a HF torch model, surgically replaces blocks with fused CUDA
+containers (``module_inject/replace_module.py:276``), slices weights per TP rank
+(``ReplaceWithTensorSlicing:28``) and captures CUDA graphs (``:500``). TPU-native:
+
+- TP = weight PartitionSpecs over the ``model`` mesh axis (the same logical-axis
+  rules as training — auto-TP is the default, not a fallback);
+- kernel injection = XLA fusion + the jitted decode step (a compiled program IS
+  the captured graph — replay is free);
+- KV-cache attention = ``models/decoding.py`` (the "softmax_context" kernel);
+- checkpoint loading reuses the sharded npz checkpoint engine; TP resharding
+  happens by construction (specs place each shard, the ``SDLoaderFactory``
+  merge/split logic disappears).
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..config.base import ConfigError
+from ..config.config import MeshConfig
+from ..models.layers import split_params_axes, Param
+from ..models.decoding import init_cache, forward_with_cache, sample_token
+from ..parallel import build_mesh, DATA_AXIS, MODEL_AXIS
+from ..parallel.sharding import param_partition_specs, named
+from ..utils.logging import log_dist
+
+DTYPES = {"float16": jnp.float16, "bfloat16": jnp.bfloat16, "float32": jnp.float32}
+
+
+class InferenceEngine:
+    def __init__(self, model, config, mesh=None, model_parameters=None):
+        if model is None:
+            raise ConfigError("init_inference: model is required")
+        self.module = model
+        self._config = config
+        self.dtype = DTYPES[config.dtype]
+        if hasattr(model, "config") and hasattr(model.config, "compute_dtype"):
+            model.config.compute_dtype = self.dtype
+
+        tp = config.tensor_parallel.tp_size if config.tensor_parallel.enabled else 1
+        self.mesh = mesh if mesh is not None else build_mesh(MeshConfig(model=tp))
+        self.mp_world_size = self.mesh.shape.get(MODEL_AXIS, 1)
+
+        self._rng = jax.random.PRNGKey(config.seed)
+        self._init_parameters(model_parameters)
+
+        self._prefill_fn = None   # keyed by prompt length
+        self._decode_fn = None
+        self._prefill_cache = {}
+
+        log_dist(
+            f"InferenceEngine: mesh={dict(self.mesh.shape)} dtype={config.dtype} "
+            f"max_tokens={config.max_tokens}",
+            ranks=[0],
+        )
+
+    # ------------------------------------------------------------------------------
+    def _init_parameters(self, model_parameters):
+        if model_parameters is not None:
+            if isinstance(model_parameters, tuple) and len(model_parameters) == 2:
+                values, axes = model_parameters
+            else:
+                values, axes = split_params_axes(model_parameters)
+        else:
+            params_shape = jax.eval_shape(self.module.init, self._rng)
+            axes = jax.tree_util.tree_map(
+                lambda p: p.axes, params_shape, is_leaf=lambda x: isinstance(x, Param))
+            values = None
+
+        if values is not None:
+            shapes = jax.tree_util.tree_map(lambda v: tuple(v.shape), values)
+        else:
+            shapes = jax.tree_util.tree_map(
+                lambda p: tuple(p.value.shape), params_shape,
+                is_leaf=lambda x: isinstance(x, Param))
+
+        # inference keeps params in the serving dtype (no fp32 masters) and TP-only
+        # sharding (zero_stage=0: no data-sharded params)
+        self.param_specs = param_partition_specs(axes, shapes, self.mesh, zero_stage=0)
+        self.param_shardings = named(self.mesh, self.param_specs)
+
+        if values is None:
+            init_fn = lambda rng: jax.tree_util.tree_map(
+                lambda a: a.astype(self.dtype),
+                split_params_axes(self.module.init(rng))[0])
+            with self.mesh:
+                self.params = jax.jit(init_fn, out_shardings=self.param_shardings)(self._rng)
+        else:
+            self.params = jax.tree_util.tree_map(
+                lambda v, s: jax.device_put(jnp.asarray(v, self.dtype), s),
+                values, self.param_shardings)
+
+    def load_checkpoint(self, load_dir, tag=None):
+        """Load trained weights (npz layout from the training engine); TP
+        resharding is just placement per the inference specs."""
+        import os
+
+        from ..checkpoint.engine import NpzCheckpointEngine
+
+        if tag is None:
+            latest = os.path.join(load_dir, "latest")
+            tag = open(latest).read().strip() if os.path.exists(latest) else None
+        path = os.path.join(load_dir, tag) if tag else load_dir
+        state, _ = NpzCheckpointEngine().load(
+            path, template={"params": self.params},
+            shardings={"params": self.param_shardings})
+        self.params = jax.tree_util.tree_map(
+            lambda v, s: jax.device_put(jnp.asarray(v, self.dtype), s),
+            state["params"], self.param_shardings)
+        return path
+
+    # ------------------------------------------------------------------------------
+    # forward / generate (reference engine.forward :560, patched _generate :588)
+    # ------------------------------------------------------------------------------
+    def forward(self, input_ids):
+        """Full-sequence logits (no cache) — scoring/perplexity path."""
+        input_ids = jnp.asarray(input_ids)
+        if self._prefill_fn is None:
+            with self.mesh:
+                self._prefill_fn = jax.jit(
+                    lambda p, ids: self.module.apply(p, ids))
+        return self._prefill_fn(self.params, input_ids)
+
+    def __call__(self, input_ids):
+        return self.forward(input_ids)
+
+    def generate(self, input_ids, max_new_tokens=32, temperature=1.0, top_k=0,
+                 greedy=True, eos_token_id=None, rng=None):
+        """Autoregressive generation with a jitted prefill + decode loop.
+
+        input_ids: [b, prompt_len] (uniform length; pad+mask generation is the
+        serving layer's job, as in the reference's simple generate patching).
+        Returns [b, prompt_len + max_new_tokens] int32.
+        """
+        input_ids = jnp.asarray(input_ids, jnp.int32)
+        b, prompt_len = input_ids.shape
+        max_len = prompt_len + max_new_tokens
+        if max_len > self._config.max_tokens:
+            raise ConfigError(
+                f"generate: prompt {prompt_len} + max_new_tokens {max_new_tokens} "
+                f"exceeds max_tokens {self._config.max_tokens}")
+        rng = rng if rng is not None else jax.random.fold_in(self._rng, prompt_len)
+
+        # cache [L, b, max_len, kvh, dh]: batch over data, kv heads over model
+        # (only when divisible — MQA/GQA may have fewer kv heads than tp)
+        kvh = self.module.config.kv_heads
+        kv_axis = MODEL_AXIS if kvh % max(self.mp_world_size, 1) == 0 else None
+        batch_axis = DATA_AXIS if b % max(self.mesh.shape.get(DATA_AXIS, 1), 1) == 0 else None
+        cache_sharding = NamedSharding(
+            self.mesh, P(None, batch_axis, None, kv_axis, None))
+        token_sharding = NamedSharding(self.mesh, P(batch_axis))
+
+        key = (b, prompt_len, max_new_tokens, bool(greedy), float(temperature),
+               int(top_k))
+        if key not in self._prefill_cache:
+            model = self.module
+
+            def prefill(params, ids, rng):
+                cache = init_cache(model.config, b, max_len, self.dtype)
+                logits, cache = forward_with_cache(
+                    model, params, ids, cache, 0, max_len)
+                tok = sample_token(logits[:, prompt_len - 1], rng,
+                                   temperature=temperature, top_k=top_k,
+                                   greedy=greedy)
+                return tok, cache
+
+            def decode(params, cache, tok, rng):
+                def step(carry, i):
+                    cache, tok, rng = carry
+                    rng, step_rng = jax.random.split(rng)
+                    logits, cache = forward_with_cache(
+                        model, params, tok[:, None], cache,
+                        prompt_len + i, max_len)
+                    nxt = sample_token(logits[:, 0], step_rng,
+                                       temperature=temperature, top_k=top_k,
+                                       greedy=greedy)
+                    return (cache, nxt, rng), nxt
+
+                (cache, _, _), toks = jax.lax.scan(
+                    step, (cache, tok, rng), jnp.arange(max_new_tokens - 1))
+                return toks  # [steps, b]
+
+            with self.mesh:
+                self._prefill_cache[key] = (
+                    jax.jit(prefill,
+                            out_shardings=(token_sharding,
+                                           {"k": cache_sharding, "v": cache_sharding})),
+                    jax.jit(decode, donate_argnums=(1,)),
+                )
+
+        prefill_fn, decode_fn = self._prefill_cache[key]
+        rng, r1, r2 = jax.random.split(rng, 3)
+        first, cache = prefill_fn(self.params, input_ids, r1)
+        out = [input_ids, first[:, None]]
+        if max_new_tokens > 1:
+            toks = decode_fn(self.params, cache, first, r2)  # [steps, b]
+            out.append(jnp.transpose(toks))
+        result = jnp.concatenate(out, axis=1)
+        if eos_token_id is not None:
+            result = _truncate_after_eos(np.asarray(result), prompt_len, eos_token_id)
+        return result
+
+    @property
+    def config(self):
+        return self._config
+
+
+def _truncate_after_eos(tokens, prompt_len, eos):
+    """Replace everything after the first EOS (per row) with EOS."""
+    tokens = tokens.copy()
+    gen = tokens[:, prompt_len:]
+    for row in range(gen.shape[0]):
+        hits = np.where(gen[row] == eos)[0]
+        if hits.size:
+            gen[row, hits[0]:] = eos
+    tokens[:, prompt_len:] = gen
+    return tokens

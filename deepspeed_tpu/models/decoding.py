@@ -1,0 +1,169 @@
+"""KV-cache decoding path for the transformer backbone.
+
+TPU-native equivalent of the reference's inference kernels
+(``csrc/transformer/inference/csrc/`` — fused "softmax_context" attention with
+KV-cache, ``apply_rotary_pos_emb.cu``) and the ``DeepSpeedTransformerInference``
+module (``model_implementations/transformers/ds_transformer.py:19``). The CUDA
+version hand-manages a contiguous KV workspace; here the cache is a pytree of
+``[layers, batch, max_len, kv_heads, head_dim]`` arrays updated with
+``dynamic_update_slice`` inside a jitted decode step — XLA keeps the update
+in-place through buffer donation.
+
+Kept separate from the training path (``transformer.block_apply``) like the
+reference keeps training vs inference kernels separate; a parity test pins
+prefill logits == training-forward logits.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from . import layers as L
+from .transformer import _norm_apply
+
+
+def init_cache(cfg, batch_size, max_len, dtype=None):
+    """Allocate the KV cache: k/v stacked over layers (matches the stacked block
+    params, so layer scan indexes both together)."""
+    dtype = dtype or cfg.compute_dtype
+    kvh = cfg.kv_heads
+    shape = (cfg.n_layers, batch_size, max_len, kvh, cfg.head_dim)
+    return {
+        "k": jnp.zeros(shape, dtype),
+        "v": jnp.zeros(shape, dtype),
+    }
+
+
+def _attn_with_cache(cfg, p_attn, h, k_cache, v_cache, pos, kv_len, rope=None):
+    """Attention for q block [b, q, d] against cache[:, :kv_len] after writing the
+    new k/v at ``pos``. Returns (out [b, q, d], new k_cache, new v_cache).
+
+    k_cache/v_cache: [b, max_len, kvh, dh]; pos: scalar write offset;
+    kv_len: static upper bound on valid cache length (mask handles the rest).
+    """
+    b, q_len, d = h.shape
+    q = L.linear_apply(p_attn["q"], h).reshape(b, q_len, cfg.n_heads, cfg.head_dim)
+    k = L.linear_apply(p_attn["k"], h).reshape(b, q_len, cfg.kv_heads, cfg.head_dim)
+    v = L.linear_apply(p_attn["v"], h).reshape(b, q_len, cfg.kv_heads, cfg.head_dim)
+    if rope is not None:
+        cos, sin = rope
+        q = L.apply_rotary(q, cos, sin)
+        k = L.apply_rotary(k, cos, sin)
+
+    k_cache = jax.lax.dynamic_update_slice(k_cache, k.astype(k_cache.dtype),
+                                           (0, pos, 0, 0))
+    v_cache = jax.lax.dynamic_update_slice(v_cache, v.astype(v_cache.dtype),
+                                           (0, pos, 0, 0))
+
+    k_full = L._repeat_kv(k_cache[:, :kv_len], cfg.n_heads // cfg.kv_heads)
+    v_full = L._repeat_kv(v_cache[:, :kv_len], cfg.n_heads // cfg.kv_heads)
+
+    # causal vs the cache: query i (global pos+i) sees cache slots <= pos+i
+    kv_idx = jnp.arange(kv_len)[None, :]
+    q_idx = pos + jnp.arange(q_len)[:, None]
+    mask = (kv_idx <= q_idx)[None, None, :, :]  # [1, 1, q, kv]
+
+    alibi = None
+    if cfg.position_embedding == "alibi":
+        alibi = _alibi_slice(cfg, q_len, kv_len, pos)
+
+    out = L.dot_product_attention(q, k_full, v_full, mask=mask, alibi_bias=alibi)
+    out = L.linear_apply(p_attn["o"], out.reshape(b, q_len, d))
+    return out, k_cache, v_cache
+
+
+def _alibi_slice(cfg, q_len, kv_len, pos):
+    """ALiBi bias for queries at global positions [pos, pos+q) vs keys [0, kv)."""
+    full = L.alibi_bias(cfg.n_heads, kv_len, kv_len)  # [h|1xh, kv, kv] layout
+    # L.alibi_bias returns [1, heads, q, kv]; slice the query rows
+    return jax.lax.dynamic_slice_in_dim(full, pos, q_len, axis=2)
+
+
+def _mlp(cfg, p, h):
+    if cfg.n_experts > 0:
+        from ..moe import moe_mlp_apply
+
+        out, _ = moe_mlp_apply(cfg, p["mlp"], h, deterministic=True)
+        return out
+    act = L.ACTIVATIONS[cfg.activation] if cfg.activation != "swiglu" else None
+    mp = jax.tree_util.tree_map(lambda a: a.astype(h.dtype), p["mlp"])
+    if cfg.activation == "swiglu":
+        gate = L.linear_apply(mp["gate"], h)
+        up = L.linear_apply(mp["up"], h)
+        return L.linear_apply(mp["down"], jax.nn.silu(gate) * up)
+    return L.linear_apply(mp["proj"], act(L.linear_apply(mp["fc"], h)))
+
+
+def _block_cached(cfg, p, x, k_cache, v_cache, pos, kv_len, rope=None):
+    """One block with cache. x: [b, q, d] compute dtype."""
+    p_cast = {
+        "ln_1": p["ln_1"],
+        "ln_2": p["ln_2"],
+        "attn": jax.tree_util.tree_map(lambda a: a.astype(cfg.compute_dtype), p["attn"]),
+        "mlp": p["mlp"],
+    }
+
+    def attn(h):
+        return _attn_with_cache(cfg, p_cast["attn"], h, k_cache, v_cache, pos,
+                                kv_len, rope=rope)
+
+    if cfg.parallel_attn_mlp:
+        h = _norm_apply(cfg, p_cast["ln_1"], x)
+        a, kc, vc = attn(h)
+        return x + a + _mlp(cfg, p_cast, h), kc, vc
+    if cfg.prenorm:
+        a, kc, vc = attn(_norm_apply(cfg, p_cast["ln_1"], x))
+        x = x + a
+        x = x + _mlp(cfg, p_cast, _norm_apply(cfg, p_cast["ln_2"], x))
+        return x, kc, vc
+    a, kc, vc = attn(x)
+    x = _norm_apply(cfg, p_cast["ln_1"], x + a)
+    x = _norm_apply(cfg, p_cast["ln_2"], x + _mlp(cfg, p_cast, x))
+    return x, kc, vc
+
+
+def forward_with_cache(model, params, input_ids, cache, pos, kv_len):
+    """Run the model on ``input_ids`` [b, q] writing k/v into ``cache`` at ``pos``.
+
+    Used for both prefill (q = prompt length, pos = 0) and decode (q = 1,
+    pos = cursor). Returns (logits [b, q, vocab], new_cache).
+    """
+    cfg = model.config
+    b, q_len = input_ids.shape
+    positions = pos + jnp.arange(q_len)[None, :]
+    positions = jnp.broadcast_to(positions, (b, q_len))
+
+    x = L.embedding_apply(params["wte"], input_ids, cfg.compute_dtype)
+    if cfg.position_embedding == "learned":
+        x = x + jnp.take(params["wpe"]["weight"].astype(cfg.compute_dtype),
+                         positions, axis=0)
+    rope = None
+    if cfg.position_embedding == "rope":
+        rope = L.rotary_embedding(positions, cfg.head_dim, cfg.rope_base)
+
+    def scan_fn(carry, layer):
+        h = carry
+        p_i, kc, vc = layer
+        h, kc, vc = _block_cached(cfg, p_i, h, kc, vc, pos, kv_len, rope=rope)
+        return h, (kc, vc)
+
+    h, (k_new, v_new) = jax.lax.scan(
+        scan_fn, x, (params["blocks"], cache["k"], cache["v"])
+    )
+    h = _norm_apply(cfg, params["ln_f"], h)
+    if cfg.tie_embeddings:
+        logits = L.embedding_attend(params["wte"], h)
+    else:
+        logits = L.linear_apply(params["lm_head"], h)
+    return logits, {"k": k_new, "v": v_new}
+
+
+def sample_token(logits, rng, *, temperature=1.0, top_k=0, greedy=False):
+    """logits: [b, vocab] -> [b] int32."""
+    logits = logits.astype(jnp.float32)
+    if greedy or temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits / jnp.maximum(temperature, 1e-6)
+    if top_k and top_k > 0:
+        kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
+        logits = jnp.where(logits < kth, -1e30, logits)
+    return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)

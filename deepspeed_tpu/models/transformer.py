@@ -63,7 +63,7 @@ class TransformerConfig:
     n_experts: int = 0            # 0 = dense FFN
     moe_top_k: int = 1
     moe_capacity_factor: float = 1.25
-    moe_eval_capacity_factor: float = 2.0
+    moe_eval_capacity_factor: float = 0.0  # <=0: drop-free eval (capacity = seq len)
     moe_min_capacity: int = 4
     moe_aux_loss_weight: float = 0.01
     moe_noise_std: float = 0.0

@@ -128,9 +128,19 @@ def moe_mlp_apply(cfg, p, x, *, deterministic=True, rng=None):
 
     b, s, m = x.shape
     E = cfg.n_experts
-    cap_factor = (cfg.moe_eval_capacity_factor if deterministic
-                  else cfg.moe_capacity_factor)
-    capacity = expert_capacity(s, E, cfg.moe_top_k, cap_factor, cfg.moe_min_capacity)
+    if deterministic:
+        # Eval/decode: default to drop-free capacity (C = s covers the worst-case
+        # all-tokens-to-one-expert) so KV-cache decode is exactly consistent with
+        # the full forward; an explicit eval factor trades memory for drops.
+        if cfg.moe_eval_capacity_factor and cfg.moe_eval_capacity_factor > 0:
+            capacity = expert_capacity(s, E, cfg.moe_top_k,
+                                       cfg.moe_eval_capacity_factor,
+                                       cfg.moe_min_capacity)
+        else:
+            capacity = s
+    else:
+        capacity = expert_capacity(s, E, cfg.moe_top_k, cfg.moe_capacity_factor,
+                                   cfg.moe_min_capacity)
 
     router_logits = jnp.einsum(
         "bsm,me->bse", x.astype(jnp.float32), p["router"]["kernel"].astype(jnp.float32)
