@@ -1,0 +1,5 @@
+from .compress import init_compression, redundancy_clean, CompressionScheduler
+from .config import CompressionConfig
+
+__all__ = ["init_compression", "redundancy_clean", "CompressionScheduler",
+           "CompressionConfig"]

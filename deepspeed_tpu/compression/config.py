@@ -1,0 +1,42 @@
+"""Compression config (reference ``deepspeed/compression/config.py`` shape)."""
+
+from ..config.base import ConfigModel
+
+
+class WeightQuantizeConfig(ConfigModel):
+    enabled: bool = False
+    target_bits: int = 8
+    start_bits: int = 16
+    quantize_period: int = 100        # steps between bit reductions (MoQ schedule)
+    quantize_groups: int = 64         # group size
+    schedule_offset: int = 0          # step at which quantization starts
+    modules: list = ["*"]             # glob patterns on param paths
+
+
+class ActivationQuantizeConfig(ConfigModel):
+    enabled: bool = False
+    bits: int = 8
+    range_calibration: str = "dynamic"  # dynamic | static
+    schedule_offset: int = 0
+
+
+class SparsePruningConfig(ConfigModel):
+    enabled: bool = False
+    method: str = "l1"                # l1 | topk
+    ratio: float = 0.5
+    schedule_offset: int = 0
+    modules: list = ["*"]
+
+
+class RowPruningConfig(ConfigModel):
+    enabled: bool = False
+    ratio: float = 0.5
+    schedule_offset: int = 0
+    modules: list = ["*"]
+
+
+class CompressionConfig(ConfigModel):
+    weight_quantization: WeightQuantizeConfig = WeightQuantizeConfig
+    activation_quantization: ActivationQuantizeConfig = ActivationQuantizeConfig
+    sparse_pruning: SparsePruningConfig = SparsePruningConfig
+    row_pruning: RowPruningConfig = RowPruningConfig

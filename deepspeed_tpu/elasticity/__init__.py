@@ -1,0 +1,15 @@
+from .elasticity import (
+    compute_elastic_config,
+    get_compatible_gpus_v01,
+    get_compatible_gpus_v02,
+    ElasticityError,
+    ElasticityConfig,
+)
+
+__all__ = [
+    "compute_elastic_config",
+    "get_compatible_gpus_v01",
+    "get_compatible_gpus_v02",
+    "ElasticityError",
+    "ElasticityConfig",
+]

@@ -1,0 +1,164 @@
+"""Elastic batch configuration (reference ``deepspeed/elasticity/elasticity.py``).
+
+Pure scheduling math, ported by behavior: given candidate micro-batch sizes and a
+min/max device range, find a total train batch size compatible with as many world
+sizes as possible (``compute_elastic_config``, reference ``:233``), so a job can
+restart at a different scale (TPU-pod preemption / slice resize) without changing
+the effective batch. v0.1 (``:83``) = data-parallel only; v0.2 (``:126``) adds a
+model-parallel divisor. Recovery itself is checkpoint-based restart, as in the
+reference (``DSElasticAgent`` maps to pod rescheduling + ``jax.distributed``
+re-init + checkpoint resume).
+"""
+
+import math
+
+
+class ElasticityError(Exception):
+    """Reference ``elasticity/constants.py`` error family."""
+
+
+class ElasticityConfig:
+    """Reference ``elasticity/config.py`` ElasticityConfig (dict-driven)."""
+
+    def __init__(self, param_dict):
+        self.enabled = param_dict.get("enabled", False)
+        if "max_train_batch_size" not in param_dict:
+            raise ElasticityError("Elasticity config missing 'max_train_batch_size'")
+        self.max_acceptable_batch_size = int(param_dict["max_train_batch_size"])
+        self.micro_batches = [int(m) for m in param_dict.get(
+            "micro_batch_sizes", [2, 4, 6])]
+        if any(m <= 0 for m in self.micro_batches):
+            raise ElasticityError(
+                f"micro_batch_sizes must be positive, got {self.micro_batches}")
+        self.min_gpus = int(param_dict.get("min_gpus", 1))
+        self.max_gpus = int(param_dict.get("max_gpus", 10000))
+        if self.min_gpus < 1 or self.max_gpus < self.min_gpus:
+            raise ElasticityError(
+                f"invalid gpu range [{self.min_gpus}, {self.max_gpus}]")
+        self.model_parallel_size = int(param_dict.get("model_parallel_size", 1))
+        self.num_gpus_per_node = int(param_dict.get("num_gpus_per_node", 1))
+        self.min_time = param_dict.get("min_time", 0)
+        self.version = float(param_dict.get("version", 0.1))
+        self.prefer_larger_batch_size = param_dict.get("prefer_larger_batch", True)
+        self.ignore_non_elastic_batch_info = param_dict.get(
+            "ignore_non_elastic_batch_info", False)
+
+
+def _get_candidate_batch_sizes(base_list, max_acceptable_batch_size):
+    """All micro-batch * power-of-two accumulations <= cap (reference :33)."""
+    candidates = set()
+    for base in base_list:
+        if base > max_acceptable_batch_size:
+            continue
+        p = int(math.floor(math.log2(max_acceptable_batch_size / base)))
+        for i in range(p + 1):
+            candidates.add(base * (2 ** i))
+    return sorted(candidates)
+
+
+def _get_valid_gpus(batch_size, micro_batches, min_valid_gpus, max_valid_gpus):
+    """World sizes w for which some micro-batch divides batch/w (reference :48)."""
+    valid = set()
+    for mb in micro_batches:
+        if batch_size % mb:
+            continue
+        total_micro = batch_size // mb
+        for w in range(1, total_micro + 1):
+            if total_micro % w == 0 and min_valid_gpus <= w <= max_valid_gpus:
+                valid.add(w)
+    return sorted(valid)
+
+
+def get_compatible_gpus_v01(micro_batches, max_acceptable_batch_size, min_gpus=None,
+                            max_gpus=None, prefer_larger=True):
+    """Pick (final_batch_size, valid_gpus) maximizing compatibility (reference :83)."""
+    min_gpus = min_gpus or 1
+    max_gpus = max_gpus or max_acceptable_batch_size // min(micro_batches)
+
+    candidates = _get_candidate_batch_sizes(micro_batches, max_acceptable_batch_size)
+    best = (None, [])
+    for bs in candidates:
+        valid = _get_valid_gpus(bs, micro_batches, min_gpus, max_gpus)
+        better = False
+        if len(valid) > len(best[1]):
+            better = True
+        elif len(valid) == len(best[1]) and best[0] is not None:
+            better = (bs > best[0]) if prefer_larger else (bs < best[0])
+        if better:
+            best = (bs, valid)
+    if best[0] is None:
+        raise ElasticityError(
+            f"No valid batch size found for micro-batches {micro_batches} under "
+            f"cap {max_acceptable_batch_size} with gpus in [{min_gpus}, {max_gpus}]")
+    return best
+
+
+def get_compatible_gpus_v02(micro_batches, max_acceptable_batch_size, current_num_gpus,
+                            min_gpus=None, max_gpus=None, prefer_larger=True,
+                            num_gpus_per_node=1, model_parallel_size=1):
+    """v0.2 (reference :126): model parallelism divides the device pool; batch math
+    runs over data-parallel groups."""
+    if model_parallel_size > 1:
+        group_size = model_parallel_size
+        if current_num_gpus % group_size:
+            raise ElasticityError(
+                f"model parallel size {model_parallel_size} must divide device "
+                f"count {current_num_gpus}")
+        dp = current_num_gpus // group_size
+        batch, valid = get_compatible_gpus_v01(
+            micro_batches, max_acceptable_batch_size,
+            min_gpus=max(1, (min_gpus or 1) // group_size),
+            max_gpus=max(1, (max_gpus or current_num_gpus) // group_size),
+            prefer_larger=prefer_larger)
+        if dp not in valid:
+            raise ElasticityError(
+                f"current dp world {dp} not in the compatible set {valid}")
+        return batch, [v * group_size for v in valid]
+    return get_compatible_gpus_v01(micro_batches, max_acceptable_batch_size,
+                                   min_gpus=min_gpus, max_gpus=max_gpus,
+                                   prefer_larger=prefer_larger)
+
+
+def compute_elastic_config(ds_config, target_deepspeed_version=None, world_size=0,
+                           return_microbatch=False):
+    """Reference ``elasticity.py:233``: resolve the elastic section of a config into
+    (final_batch_size, valid_gpus[, micro_batch]). With ``world_size`` given, also
+    checks compatibility and computes the per-device micro batch."""
+    if "elasticity" not in ds_config:
+        raise ElasticityError("config is missing the 'elasticity' section")
+    cfg = ElasticityConfig(ds_config["elasticity"])
+    if not cfg.enabled:
+        raise ElasticityError("elasticity section present but not enabled")
+
+    if cfg.version >= 0.2 and cfg.model_parallel_size > 1 and world_size > 0:
+        final_batch, valid_gpus = get_compatible_gpus_v02(
+            cfg.micro_batches, cfg.max_acceptable_batch_size, world_size,
+            min_gpus=cfg.min_gpus, max_gpus=cfg.max_gpus,
+            prefer_larger=cfg.prefer_larger_batch_size,
+            num_gpus_per_node=cfg.num_gpus_per_node,
+            model_parallel_size=cfg.model_parallel_size)
+    else:
+        final_batch, valid_gpus = get_compatible_gpus_v01(
+            cfg.micro_batches, cfg.max_acceptable_batch_size,
+            min_gpus=cfg.min_gpus, max_gpus=cfg.max_gpus,
+            prefer_larger=cfg.prefer_larger_batch_size)
+
+    if world_size > 0:
+        dp = world_size // cfg.model_parallel_size if cfg.version >= 0.2 else world_size
+        pool = valid_gpus if cfg.version < 0.2 or cfg.model_parallel_size == 1 else [
+            v // cfg.model_parallel_size for v in valid_gpus]
+        if dp not in pool:
+            raise ElasticityError(
+                f"world size {world_size} is not compatible with batch "
+                f"{final_batch} (valid: {valid_gpus})")
+        if return_microbatch:
+            per_dev = final_batch // dp
+            micro = next((m for m in sorted(cfg.micro_batches, reverse=True)
+                          if per_dev % m == 0), None)
+            if micro is None:
+                raise ElasticityError(
+                    f"no configured micro batch divides {per_dev}")
+            return final_batch, valid_gpus, micro
+    if return_microbatch:
+        return final_batch, valid_gpus, min(cfg.micro_batches)
+    return final_batch, valid_gpus

@@ -1,0 +1,77 @@
+"""Python surface of the async-IO native op (reference ``deepspeed/ops/aio`` +
+``csrc/aio/py_lib/deepspeed_py_aio_handle.cpp`` AsyncIOHandle).
+
+``AsyncIOHandle`` submits numpy-buffer reads/writes to the C++ thread pool and
+returns request handles; ``wait``/``wait_all`` block on completion. Powers the
+NVMe optimizer/param swappers (``runtime/offload.py``).
+"""
+
+import ctypes
+
+import numpy as np
+
+from .op_builder import AsyncIOBuilder
+
+
+class AsyncIOHandle:
+    def __init__(self, n_threads=4):
+        self._lib = AsyncIOBuilder().load()
+        self._lib.ds_aio_create.restype = ctypes.c_void_p
+        self._lib.ds_aio_create.argtypes = [ctypes.c_int]
+        self._lib.ds_aio_destroy.argtypes = [ctypes.c_void_p]
+        self._lib.ds_aio_submit_write.restype = ctypes.c_int64
+        self._lib.ds_aio_submit_write.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_void_p,
+            ctypes.c_uint64, ctypes.c_uint64]
+        self._lib.ds_aio_submit_read.restype = ctypes.c_int64
+        self._lib.ds_aio_submit_read.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_void_p,
+            ctypes.c_uint64, ctypes.c_uint64]
+        self._lib.ds_aio_wait.restype = ctypes.c_int
+        self._lib.ds_aio_wait.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        self._lib.ds_aio_wait_all.restype = ctypes.c_int
+        self._lib.ds_aio_wait_all.argtypes = [ctypes.c_void_p]
+        self._h = self._lib.ds_aio_create(int(n_threads))
+        # keep buffers alive until their request completes
+        self._pinned = {}
+
+    def write(self, path, array, offset=0):
+        """Submit an async write of a C-contiguous numpy array; returns request id."""
+        arr = np.ascontiguousarray(array)
+        req = self._lib.ds_aio_submit_write(
+            self._h, str(path).encode(), arr.ctypes.data_as(ctypes.c_void_p),
+            arr.nbytes, int(offset))
+        self._pinned[req] = arr
+        return req
+
+    def read(self, path, array, offset=0):
+        """Submit an async read into a preallocated C-contiguous numpy array."""
+        if not array.flags["C_CONTIGUOUS"] or not array.flags["WRITEABLE"]:
+            raise ValueError("read target must be a writable C-contiguous array")
+        req = self._lib.ds_aio_submit_read(
+            self._h, str(path).encode(), array.ctypes.data_as(ctypes.c_void_p),
+            array.nbytes, int(offset))
+        self._pinned[req] = array
+        return req
+
+    def wait(self, req):
+        rc = self._lib.ds_aio_wait(self._h, int(req))
+        self._pinned.pop(req, None)
+        if rc != 0:
+            raise OSError(-rc, f"async io request {req} failed")
+        return rc
+
+    def wait_all(self):
+        rc = self._lib.ds_aio_wait_all(self._h)
+        self._pinned.clear()
+        if rc != 0:
+            raise OSError(-rc, "async io batch failed")
+        return rc
+
+    def __del__(self):
+        try:
+            if getattr(self, "_h", None):
+                self._lib.ds_aio_destroy(self._h)
+                self._h = None
+        except Exception:
+            pass

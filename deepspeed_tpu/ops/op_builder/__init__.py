@@ -1,0 +1,3 @@
+from .builder import OpBuilder, AsyncIOBuilder
+
+__all__ = ["OpBuilder", "AsyncIOBuilder"]

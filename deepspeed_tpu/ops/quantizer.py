@@ -1,0 +1,62 @@
+"""Groupwise quantization ops (reference ``csrc/quantization/`` via
+``QuantizerBuilder``, ``op_builder/quantizer.py:9``).
+
+Symmetric groupwise int8/int4 (de)quantization as jittable XLA functions — the
+CUDA kernels' job (memory-bound elementwise + per-group reductions) is exactly
+what XLA fuses well on TPU. Used by the compression package (MoQ-style weight
+quantization) and the inference engine's weight-quant path.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def _group_reshape(x, group_size):
+    flat = x.reshape(-1)
+    n = flat.shape[0]
+    if group_size <= 0 or n % group_size:
+        # one group per row-ish fallback: single group
+        group_size = n
+    return flat.reshape(n // group_size, group_size), x.shape, group_size
+
+
+def quantize(x, bits=8, group_size=64):
+    """Symmetric groupwise quantization.
+
+    Returns (q int8, scale f32 per group, meta) with
+    ``dequantize(q, scale, meta)`` restoring the original shape.
+    """
+    grouped, shape, group_size = _group_reshape(jnp.asarray(x, jnp.float32), group_size)
+    qmax = float(2 ** (bits - 1) - 1)
+    absmax = jnp.max(jnp.abs(grouped), axis=1, keepdims=True)
+    scale = absmax / qmax
+    safe = jnp.where(scale > 0, scale, 1.0)
+    q = jnp.clip(jnp.round(grouped / safe), -qmax - 1, qmax).astype(jnp.int8)
+    meta = {"shape": shape, "bits": bits, "group_size": group_size}
+    return q, scale.astype(jnp.float32), meta
+
+
+def dequantize(q, scale, meta):
+    out = q.astype(jnp.float32) * scale
+    return out.reshape(meta["shape"])
+
+
+def fake_quantize(x, bits=8, group_size=64):
+    """Quantize-dequantize in one jittable op (reference ``fake_quantizer.cu``) —
+    the training-time MoQ forward. Straight-through estimator for gradients."""
+    def fwd(x):
+        q, scale, meta = quantize(x, bits=bits, group_size=group_size)
+        return dequantize(q, scale, meta).astype(x.dtype)
+
+    @jax.custom_vjp
+    def ste(x):
+        return fwd(x)
+
+    ste.defvjp(lambda x: (fwd(x), None), lambda _, g: (g,))
+    return ste(x)
+
+
+def quantization_error(x, bits=8, group_size=64):
+    """Mean squared quantization error (used by the MoQ eigenvalue-driven schedule)."""
+    q, scale, meta = quantize(x, bits=bits, group_size=group_size)
+    return jnp.mean((dequantize(q, scale, meta) - jnp.asarray(x, jnp.float32)) ** 2)

@@ -1,0 +1,4 @@
+from .curriculum_scheduler import CurriculumScheduler
+from .data_sampler import DeepSpeedDataSampler
+
+__all__ = ["CurriculumScheduler", "DeepSpeedDataSampler"]

@@ -1,0 +1,111 @@
+"""Curriculum learning scheduler (reference
+``runtime/data_pipeline/curriculum_scheduler.py`` — full file).
+
+Schedules a difficulty value (canonically: sequence length) over training steps.
+Same schedule types as the reference: ``fixed_linear``, ``fixed_root``,
+``fixed_discrete``, ``custom``. The engine truncates each batch to the current
+difficulty (the reference's seqlen curriculum hook, ``engine.py:1675``).
+"""
+
+import math
+
+from ...config.base import ConfigError
+
+
+class CurriculumScheduler:
+    def __init__(self, config):
+        config = dict(config or {})
+        self.state = {}
+        for key in ("curriculum_type", "min_difficulty", "max_difficulty",
+                    "schedule_type"):
+            if key not in config:
+                raise ConfigError(f"Curriculum learning requires the config '{key}'")
+        self.state["min_difficulty"] = config["min_difficulty"]
+        self.state["max_difficulty"] = config["max_difficulty"]
+        self.state["current_difficulty"] = config["min_difficulty"]
+        self.state["schedule_type"] = config["schedule_type"]
+        schedule_config = dict(config.get("schedule_config", {}))
+
+        if self.state["schedule_type"] == "fixed_discrete":
+            # {"difficulty": [1,2,3], "max_step": [5,10]}
+            if "difficulty" not in schedule_config:
+                raise ConfigError("fixed_discrete schedule requires 'difficulty'")
+            if "max_step" not in schedule_config:
+                raise ConfigError("fixed_discrete schedule requires 'max_step'")
+            if len(schedule_config["max_step"]) > 0:
+                if len(schedule_config["difficulty"]) != len(schedule_config["max_step"]) + 1:
+                    raise ConfigError("len(difficulty) must be len(max_step) + 1")
+        elif self.state["schedule_type"] in ("fixed_linear", "fixed_root"):
+            for key in ("total_curriculum_step", "difficulty_step"):
+                if key not in schedule_config:
+                    raise ConfigError(f"{self.state['schedule_type']} requires '{key}'")
+            if schedule_config["difficulty_step"] % 8:
+                # the reference warns: seqlen not multiple of 8 hurts tensor cores;
+                # on TPU the MXU lane width makes multiples of 128 ideal, 8 minimum
+                from ...utils.logging import logger
+
+                logger.warning(
+                    "difficulty_step not a multiple of 8 can underutilize the MXU")
+            if self.state["schedule_type"] == "fixed_root" \
+                    and "root_degree" not in schedule_config:
+                raise ConfigError("fixed_root requires 'root_degree'")
+        elif self.state["schedule_type"] != "custom":
+            raise ConfigError(
+                f"Unsupported curriculum schedule type {self.state['schedule_type']}")
+        self.state["schedule"] = schedule_config
+        self.custom_get_difficulty = None
+
+    # ----------------------------------------------------------------------------
+    def get_current_difficulty(self):
+        return self.state["current_difficulty"]
+
+    def set_custom_get_difficulty(self, fn):
+        self.custom_get_difficulty = fn
+
+    def get_state(self):
+        return dict(self.state)
+
+    def set_state(self, state):
+        self.state.update(state)
+
+    def _fixed_linear(self, global_steps):
+        s = self.state["schedule"]
+        frac = min(1.0, global_steps / s["total_curriculum_step"])
+        diff = self.state["min_difficulty"] + frac * (
+            self.state["max_difficulty"] - self.state["min_difficulty"])
+        step = s["difficulty_step"]
+        return min(self.state["max_difficulty"],
+                   int(diff // step) * step if diff >= step else int(diff))
+
+    def _fixed_root(self, global_steps):
+        s = self.state["schedule"]
+        frac = min(1.0, global_steps / s["total_curriculum_step"])
+        frac = frac ** (1.0 / s["root_degree"])
+        diff = self.state["min_difficulty"] + frac * (
+            self.state["max_difficulty"] - self.state["min_difficulty"])
+        step = s["difficulty_step"]
+        return min(self.state["max_difficulty"],
+                   int(diff // step) * step if diff >= step else int(diff))
+
+    def _fixed_discrete(self, global_steps):
+        s = self.state["schedule"]
+        for i, max_step in enumerate(s["max_step"]):
+            if global_steps <= max_step:
+                return s["difficulty"][i]
+        return s["difficulty"][-1]
+
+    def update_difficulty(self, global_steps):
+        t = self.state["schedule_type"]
+        if t == "fixed_linear":
+            d = self._fixed_linear(global_steps)
+        elif t == "fixed_root":
+            d = self._fixed_root(global_steps)
+        elif t == "fixed_discrete":
+            d = self._fixed_discrete(global_steps)
+        else:
+            if self.custom_get_difficulty is None:
+                raise ConfigError("custom schedule requires set_custom_get_difficulty")
+            d = self.custom_get_difficulty(global_steps)
+        self.state["current_difficulty"] = max(self.state["min_difficulty"],
+                                               min(self.state["max_difficulty"], d))
+        return self.state["current_difficulty"]

@@ -1,0 +1,72 @@
+"""Deterministic resumable distributed sampler (reference
+``runtime/data_pipeline/data_sampling/data_sampler.py:36`` DeepSpeedDataSampler).
+
+Yields per-rank index batches for a dataset, deterministically from (seed, epoch,
+consumed_samples) so training can resume mid-epoch after preemption — the core of
+the reference's data-efficiency sampling (random-LTD / curriculum build on it).
+"""
+
+import numpy as np
+
+
+class DeepSpeedDataSampler:
+    def __init__(self, total_samples, micro_batch_size, data_parallel_rank,
+                 data_parallel_size, *, drop_last=True, shuffle=True, seed=1234,
+                 consumed_samples=0, gradient_accumulation_steps=1):
+        self.total_samples = int(total_samples)
+        self.micro_batch_size = int(micro_batch_size)
+        self.dp_rank = int(data_parallel_rank)
+        self.dp_size = int(data_parallel_size)
+        self.drop_last = drop_last
+        self.shuffle = shuffle
+        self.seed = seed
+        self.consumed_samples = int(consumed_samples)
+        self.gas = int(gradient_accumulation_steps)
+        if self.dp_rank >= self.dp_size:
+            raise ValueError(
+                f"rank {self.dp_rank} out of range for dp size {self.dp_size}")
+        self.micro_batch_times_dp = self.micro_batch_size * self.dp_size
+
+    def __len__(self):
+        n = self.total_samples - (self.consumed_samples % self.total_samples)
+        if self.drop_last:
+            return n // self.micro_batch_times_dp
+        return (n + self.micro_batch_times_dp - 1) // self.micro_batch_times_dp
+
+    def _epoch_order(self, epoch):
+        if not self.shuffle:
+            return np.arange(self.total_samples)
+        rng = np.random.RandomState(self.seed + epoch)
+        return rng.permutation(self.total_samples)
+
+    def __iter__(self):
+        """Yield [micro_batch_size] index lists for THIS dp rank, resuming at
+        consumed_samples."""
+        while True:
+            epoch = self.consumed_samples // self.total_samples
+            offset = self.consumed_samples % self.total_samples
+            order = self._epoch_order(epoch)
+            avail = self.total_samples - offset
+            n_batches = avail // self.micro_batch_times_dp
+            if n_batches == 0:
+                if self.drop_last:
+                    # skip the ragged tail into the next epoch
+                    self.consumed_samples += avail
+                    continue
+                n_batches = 1
+            for b in range(n_batches):
+                start = offset + b * self.micro_batch_times_dp
+                window = order[start:start + self.micro_batch_times_dp]
+                shard = window[self.dp_rank * self.micro_batch_size:
+                               (self.dp_rank + 1) * self.micro_batch_size]
+                self.consumed_samples += self.micro_batch_times_dp
+                yield shard.tolist()
+            return
+
+    # resume support (reference sampler state_dict pattern)
+    def state_dict(self):
+        return {"consumed_samples": self.consumed_samples, "seed": self.seed}
+
+    def load_state_dict(self, state):
+        self.consumed_samples = int(state["consumed_samples"])
+        self.seed = state.get("seed", self.seed)

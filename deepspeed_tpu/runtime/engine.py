@@ -245,7 +245,8 @@ class DeepSpeedEngine:
 
     def _configure_optimizer(self):
         """Reference ``engine.py:1157`` _configure_optimizer: client optimizer wins,
-        else build from config; then "wrap" = attach sharded state specs."""
+        else build from config; then "wrap" = attach sharded state specs (or hand
+        masters+state to the host/NVMe offload manager, the ZeRO-Offload path)."""
         if self.client_optimizer is not None:
             self.optimizer = self.client_optimizer
         else:
@@ -256,6 +257,29 @@ class DeepSpeedEngine:
         # the reference expresses via param_groups.
         self._wd_mask = jax.tree_util.tree_map(lambda s: len(s) > 1, self._shapes,
                                                is_leaf=lambda x: isinstance(x, tuple))
+
+        offload_cfg = self._config.zero_optimization.offload_optimizer
+        self._offloaded = None
+        if offload_cfg.device.value != "none":
+            from .offload import OffloadedOptimizer
+
+            self._offloaded = OffloadedOptimizer(
+                self.optimizer, self.params, self._wd_mask,
+                compute_dtype=self.compute_dtype,
+                param_shardings=self.param_shardings,
+                device=offload_cfg.device.value,
+                nvme_path=offload_cfg.nvme_path,
+                clip=self._config.gradient_clipping,
+            )
+            # device keeps compute-dtype params only; fp32 masters live on host
+            self.params = self._offloaded._device_params()
+            self.optimizer_state = None
+            log_dist(
+                f"Optimizer offload to {offload_cfg.device.value}: device params "
+                f"in {self._config.mixed_precision_dtype}, masters on host",
+                ranks=[0],
+            )
+            return
 
         state_shape = jax.eval_shape(self.optimizer.init, self.params)
         opt_state_specs = self._opt_state_specs(state_shape)
@@ -457,6 +481,8 @@ class DeepSpeedEngine:
             raise RuntimeError("step() called with no accumulated gradients")
         if self._wall_clock_breakdown:
             self.timers(STEP_GLOBAL_TIMER).start()
+        if self._offloaded is not None:
+            return self._offloaded_step()
         if self._apply_fn is None:
             self._build_apply()
         lr = self._current_lr()
@@ -471,6 +497,47 @@ class DeepSpeedEngine:
             self.skipped_steps += 1
             log_dist(
                 f"step {self.global_steps}: fp16 overflow, skipping update "
+                f"(loss scale -> {float(self._scale)})",
+                ranks=[0],
+            )
+        elif self.lr_scheduler is not None:
+            self.lr_scheduler.step()
+        if self._wall_clock_breakdown:
+            self.timers(STEP_GLOBAL_TIMER).stop()
+            self.timers.log(
+                [FORWARD_GLOBAL_TIMER, BACKWARD_GLOBAL_TIMER, STEP_GLOBAL_TIMER]
+            )
+        if self.global_steps % self._config.steps_per_print == 0:
+            self.monitor.write_events(
+                [("Train/lr", lr, self.global_steps),
+                 ("Train/grad_norm", float(grad_norm), self.global_steps)]
+            )
+        return grad_norm
+
+    def _offloaded_step(self):
+        """ZeRO-Offload step: grads -> host, host optimizer on fp32 masters,
+        compute-dtype params -> device (reference stage_1_and_2.py CPU-offload
+        path :1031-1113 + cpu_adam kernels)."""
+        from ..ops import update_scale
+
+        lr = self._current_lr()
+        scale_inv = 1.0 / float(self._scale)
+        self.params, grad_norm, overflow = self._offloaded.step(
+            self._acc_grads, lr, scale_inv)
+        self._acc_grads = None
+        self.global_steps += 1
+        if self.fp16_enabled:
+            dynamic = (self._scaler_meta or {}).get("_dynamic", False)
+            if dynamic:
+                self._scale, self._good_steps = update_scale(
+                    self._scale, self._good_steps, jnp.asarray(overflow),
+                    loss_scale_window=self._config.fp16.loss_scale_window,
+                    min_scale=self._config.fp16.min_loss_scale,
+                )
+        if overflow:
+            self.skipped_steps += 1
+            log_dist(
+                f"step {self.global_steps}: overflow, skipping update "
                 f"(loss scale -> {float(self._scale)})",
                 ranks=[0],
             )
@@ -574,10 +641,16 @@ class DeepSpeedEngine:
     # ------------------------------------------------------------------------------
     def save_checkpoint(self, save_dir, tag=None, client_state=None):
         tag = tag or f"global_step{self.global_steps}"
-        state = {
-            "params": self.params,
-            "optimizer_state": self.optimizer_state,
-        }
+        if self._offloaded is not None:
+            state = {
+                "params": self._offloaded.masters,  # fp32 masters, not bf16 copies
+                "optimizer_state": self._offloaded.state_for_checkpoint(),
+            }
+        else:
+            state = {
+                "params": self.params,
+                "optimizer_state": self.optimizer_state,
+            }
         meta = {
             "global_steps": self.global_steps,
             "micro_steps": self.micro_steps,
@@ -607,12 +680,24 @@ class DeepSpeedEngine:
                     return None, {}
                 tag = tags[-1]
         path = os.path.join(load_dir, tag)
-        template = {"params": self.params, "optimizer_state": self.optimizer_state}
-        shardings = {"params": self.param_shardings, "optimizer_state": self._opt_shardings}
-        state, meta = self.checkpoint_engine.load(path, template=template, shardings=shardings)
-        self.params = state["params"]
-        if load_optimizer_states:
-            self.optimizer_state = state["optimizer_state"]
+        if self._offloaded is not None:
+            template = {"params": self._offloaded.masters,
+                        "optimizer_state": self._offloaded.state_for_checkpoint()}
+            state, meta = self.checkpoint_engine.load(path, template=template,
+                                                      shardings=None)
+            self._offloaded.load_masters(state["params"])
+            if load_optimizer_states:
+                self._offloaded.load_state(state["optimizer_state"])
+            self.params = self._offloaded._device_params()
+        else:
+            template = {"params": self.params, "optimizer_state": self.optimizer_state}
+            shardings = {"params": self.param_shardings,
+                         "optimizer_state": self._opt_shardings}
+            state, meta = self.checkpoint_engine.load(path, template=template,
+                                                      shardings=shardings)
+            self.params = state["params"]
+            if load_optimizer_states:
+                self.optimizer_state = state["optimizer_state"]
         self.global_steps = meta["global_steps"]
         self.micro_steps = meta["micro_steps"]
         self.skipped_steps = meta["skipped_steps"]

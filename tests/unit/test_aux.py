@@ -1,0 +1,194 @@
+"""Aux subsystem tests: elasticity, flops profiler, quantizer/compression,
+curriculum scheduler, data sampler. Mirrors reference tests
+(``tests/unit/elasticity/test_elastic.py``, ``tests/unit/ops/quantizer``,
+``tests/unit/runtime/test_data_efficiency.py``)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from deepspeed_tpu.elasticity import (
+    compute_elastic_config, get_compatible_gpus_v01, ElasticityError)
+from deepspeed_tpu.ops.quantizer import (
+    quantize, dequantize, fake_quantize, quantization_error)
+from deepspeed_tpu.compression import init_compression, redundancy_clean
+from deepspeed_tpu.runtime.data_pipeline import (
+    CurriculumScheduler, DeepSpeedDataSampler)
+from deepspeed_tpu.profiling import FlopsProfiler, transformer_train_flops
+
+
+# ---------------------------------------------------------------------------------
+# elasticity (reference tests/unit/elasticity/test_elastic.py)
+# ---------------------------------------------------------------------------------
+def test_elastic_v01_basic():
+    batch, valid = get_compatible_gpus_v01([2, 4, 6], max_acceptable_batch_size=10000)
+    # every valid world size must actually divide batch with some micro batch
+    for w in valid[:50]:
+        assert any(batch % (m * w) == 0 for m in [2, 4, 6])
+    assert batch <= 10000
+
+
+def test_elastic_compute_config():
+    ds_config = {"elasticity": {
+        "enabled": True, "max_train_batch_size": 2000,
+        "micro_batch_sizes": [2, 4, 6], "min_gpus": 1, "max_gpus": 10000,
+        "version": 0.1}}
+    batch, valid = compute_elastic_config(ds_config)
+    assert batch > 0 and len(valid) > 0
+    # world-size compatibility check + micro batch resolution
+    w = valid[len(valid) // 2]
+    b2, v2, micro = compute_elastic_config(ds_config, world_size=w,
+                                           return_microbatch=True)
+    assert b2 == batch
+    assert (batch // w) % micro == 0
+
+
+def test_elastic_incompatible_world_size():
+    ds_config = {"elasticity": {
+        "enabled": True, "max_train_batch_size": 4,
+        "micro_batch_sizes": [4], "min_gpus": 1, "max_gpus": 1}}
+    with pytest.raises(ElasticityError):
+        compute_elastic_config(ds_config, world_size=3)
+
+
+def test_elastic_disabled_raises():
+    with pytest.raises(ElasticityError):
+        compute_elastic_config({"elasticity": {"enabled": False,
+                                               "max_train_batch_size": 100}})
+
+
+# ---------------------------------------------------------------------------------
+# quantizer (reference tests/unit/ops/quantizer)
+# ---------------------------------------------------------------------------------
+def test_quantize_roundtrip_error_small():
+    x = np.random.RandomState(0).randn(128, 64).astype(np.float32)
+    q, scale, meta = quantize(x, bits=8, group_size=64)
+    assert q.dtype == jnp.int8
+    y = dequantize(q, scale, meta)
+    assert y.shape == x.shape
+    rel = float(jnp.sqrt(jnp.mean((y - x) ** 2)) / jnp.sqrt(jnp.mean(x ** 2)))
+    assert rel < 0.01  # int8 groupwise ~0.3% rms error
+
+
+def test_quantize_int4_coarser_than_int8():
+    x = np.random.RandomState(1).randn(64, 64).astype(np.float32)
+    e8 = float(quantization_error(x, bits=8))
+    e4 = float(quantization_error(x, bits=4))
+    assert e4 > e8 > 0
+
+
+def test_fake_quantize_straight_through_grad():
+    x = jnp.asarray(np.random.RandomState(2).randn(32, 32), jnp.float32)
+    g = jax.grad(lambda x: jnp.sum(fake_quantize(x, bits=8) * 2.0))(x)
+    np.testing.assert_allclose(np.asarray(g), 2.0)  # STE passes grads through
+
+
+def test_compression_schedule_and_clean():
+    params = {"w": jnp.asarray(np.random.RandomState(3).randn(64, 64), jnp.float32),
+              "b": jnp.zeros((64,), jnp.float32)}
+    runtime = init_compression({"weight_quantization": {
+        "enabled": True, "start_bits": 16, "target_bits": 8,
+        "quantize_period": 10, "schedule_offset": 5}})
+    assert runtime.bits_at(0) is None         # before offset
+    assert runtime.bits_at(5) == 16
+    assert runtime.bits_at(15) == 8
+    assert runtime.bits_at(500) == 8          # floors at target
+
+    out = runtime.compress_params(params, step=25)
+    assert out["w"].shape == (64, 64)
+    # 1-D params (biases) are never quantized
+    np.testing.assert_array_equal(np.asarray(out["b"]), np.asarray(params["b"]))
+
+    cleaned, packed = redundancy_clean(params, {"weight_quantization": {
+        "enabled": True, "target_bits": 8}})
+    assert "w" in packed and packed["w"]["q"].dtype == np.int8
+
+
+# ---------------------------------------------------------------------------------
+# curriculum (reference tests/unit/runtime/test_data_efficiency.py)
+# ---------------------------------------------------------------------------------
+def test_curriculum_fixed_linear():
+    sched = CurriculumScheduler({
+        "curriculum_type": "seqlen", "min_difficulty": 8, "max_difficulty": 64,
+        "schedule_type": "fixed_linear",
+        "schedule_config": {"total_curriculum_step": 100, "difficulty_step": 8}})
+    assert sched.get_current_difficulty() == 8
+    d50 = sched.update_difficulty(50)
+    assert 8 <= d50 <= 64 and d50 % 8 == 0
+    assert sched.update_difficulty(100) == 64
+    assert sched.update_difficulty(1000) == 64
+
+
+def test_curriculum_fixed_discrete():
+    sched = CurriculumScheduler({
+        "curriculum_type": "seqlen", "min_difficulty": 2, "max_difficulty": 10,
+        "schedule_type": "fixed_discrete",
+        "schedule_config": {"difficulty": [2, 5, 10], "max_step": [3, 6]}})
+    assert sched.update_difficulty(2) == 2
+    assert sched.update_difficulty(5) == 5
+    assert sched.update_difficulty(100) == 10
+
+
+def test_curriculum_state_roundtrip():
+    cfg = {"curriculum_type": "seqlen", "min_difficulty": 8, "max_difficulty": 64,
+           "schedule_type": "fixed_root",
+           "schedule_config": {"total_curriculum_step": 100, "difficulty_step": 8,
+                               "root_degree": 2}}
+    a = CurriculumScheduler(cfg)
+    a.update_difficulty(30)
+    b = CurriculumScheduler(cfg)
+    b.set_state(a.get_state())
+    assert b.get_current_difficulty() == a.get_current_difficulty()
+
+
+# ---------------------------------------------------------------------------------
+# data sampler
+# ---------------------------------------------------------------------------------
+def test_sampler_shards_disjoint_and_deterministic():
+    samplers = [DeepSpeedDataSampler(100, micro_batch_size=5, data_parallel_rank=r,
+                                     data_parallel_size=2, seed=7) for r in range(2)]
+    batches = [list(s) for s in samplers]
+    assert len(batches[0]) == len(batches[1]) == 10
+    for b0, b1 in zip(*batches):
+        assert len(b0) == len(b1) == 5
+        assert not (set(b0) & set(b1))  # disjoint shards
+    # deterministic given the same seed
+    again = list(DeepSpeedDataSampler(100, 5, 0, 2, seed=7))
+    assert again == batches[0]
+
+
+def test_sampler_resume_mid_epoch():
+    full = list(DeepSpeedDataSampler(64, 4, 0, 2, seed=3))
+    half = DeepSpeedDataSampler(64, 4, 0, 2, seed=3)
+    it = iter(half)
+    first = [next(it) for _ in range(4)]
+    resumed = DeepSpeedDataSampler(64, 4, 0, 2, seed=3,
+                                   consumed_samples=half.consumed_samples)
+    rest = list(resumed)
+    assert first + rest == full
+
+
+# ---------------------------------------------------------------------------------
+# flops profiler
+# ---------------------------------------------------------------------------------
+def test_flops_profiler_matmul():
+    a = jnp.ones((128, 256), jnp.float32)
+    b = jnp.ones((256, 512), jnp.float32)
+    prof = FlopsProfiler(lambda a, b: a @ b).compile(a, b)
+    expected = 2 * 128 * 256 * 512
+    assert prof.flops == pytest.approx(expected, rel=0.1)
+    stats = prof.measure(a, b, n_iters=3)
+    assert stats["latency_s"] > 0 and stats["flops_per_s"] > 0
+
+
+def test_transformer_flops_formula():
+    from deepspeed_tpu.models import TransformerConfig
+
+    cfg = TransformerConfig(vocab_size=1000, n_layers=2, n_heads=4, d_model=64,
+                            d_ff=256, max_seq_len=128)
+    f_fwd_only = transformer_train_flops(cfg, 4, 128, include_backward=False)
+    f_train = transformer_train_flops(cfg, 4, 128)
+    f_remat = transformer_train_flops(cfg, 4, 128, checkpoint_activations=True)
+    assert f_train == 3 * f_fwd_only
+    assert f_remat == 4 * f_fwd_only
