@@ -91,6 +91,19 @@ class _CompressionRuntime(CompressionScheduler):
 
 
 def _prune(x, method, ratio):
+    """Sparse pruning (reference ``compression/basic_layer.py`` SparsePruning):
+    ``l1`` zeroes the globally smallest-|w| fraction; ``topk`` keeps the top
+    (1-ratio) fraction per output row (structured along the last axis)."""
+    if method == "topk":
+        # index-based mask: exactly k survivors per row even with tied magnitudes
+        k = max(1, int(x.shape[-1] * (1.0 - ratio)))
+        idx = jnp.argsort(jnp.abs(x), axis=-1)[..., -k:]
+        mask = jnp.put_along_axis(jnp.zeros_like(x), idx, 1.0, axis=-1,
+                                  inplace=False)
+        return x * mask
+    if method not in (None, "l1"):
+        raise ValueError(f"unknown sparse_pruning method {method!r}; "
+                         "expected 'l1' or 'topk'")
     flat = jnp.abs(x).reshape(-1)
     k = int(flat.shape[0] * ratio)
     if k == 0:

@@ -101,12 +101,14 @@ def top_k_gating(logits, top_k, capacity, *, rng=None, noise_std=0.0):
 def moe_mlp_init(rng, cfg):
     """Expert-stacked MLP params: leading "expert" logical axis (sharded over the
     ``expert`` mesh axis) + router. Mirrors the reference's ``Experts`` module
-    (``moe/experts.py``) holding E copies of the FFN."""
+    (``moe/experts.py``) holding E copies of the FFN. For ``swiglu`` models the
+    experts are gated — silu(x @ wi_gate) ⊙ (x @ wi) — matching the dense FFN's
+    silu(gate) * up convention (models/transformer.py)."""
     E = cfg.n_experts
-    k_router, k1, k2 = jax.random.split(rng, 3)
+    k_router, k1, k2, k3 = jax.random.split(rng, 4)
     std = cfg.initializer_range
     out_std = std / (2.0 * cfg.n_layers) ** 0.5
-    return {
+    params = {
         "router": {
             "kernel": Param(normal_init(k_router, (cfg.d_model, E), std),
                             ("embed", "expert_logits"))
@@ -116,6 +118,10 @@ def moe_mlp_init(rng, cfg):
         "wo": Param(normal_init(k2, (E, cfg.d_ff, cfg.d_model), out_std),
                     ("expert", "mlp", "embed")),
     }
+    if cfg.activation == "swiglu":
+        params["wi_gate"] = Param(normal_init(k3, (E, cfg.d_model, cfg.d_ff), std),
+                                  ("expert", "embed", "mlp"))
+    return params
 
 
 def moe_mlp_apply(cfg, p, x, *, deterministic=True, rng=None):
@@ -152,13 +158,63 @@ def moe_mlp_apply(cfg, p, x, *, deterministic=True, rng=None):
     dispatch_f = dispatch.astype(x.dtype)
     combine = combine.astype(x.dtype)
 
-    # data-sharded [b,s,..] -> expert-sharded [E,b,C,..]: the all_to_all
+    # data-sharded [b,s,..] -> expert-sharded [E,b,C,..]: the all_to_all.
+    # Without an explicit constraint XLA is free to keep the [E,b,C,m]
+    # intermediates replicated-E / sharded-b (turning the resharding pair into
+    # all_reduces); pinning E over ``expert`` and b over ``data`` forces the
+    # partitioner to emit the true all_to_all of the reference's ``_AllToAll``
+    # autograd fn (``deepspeed/moe/sharded_moe.py:90``).
     expert_in = jnp.einsum("bsec,bsm->ebcm", dispatch_f, x)
+    expert_in = _expert_a2a(expert_in, getattr(cfg, "mesh", None), to_expert=True)
     w_i = p["wi"].astype(x.dtype)
     w_o = p["wo"].astype(x.dtype)
-    act = L.ACTIVATIONS[cfg.activation if cfg.activation != "swiglu" else "gelu"]
-    h = act(jnp.einsum("ebcm,emf->ebcf", expert_in, w_i))
+    if cfg.activation == "swiglu":
+        # same convention as the dense MLP (models/transformer.py): silu on the
+        # projection named "gate", elementwise with the ungated up-projection wi
+        w_g = p["wi_gate"].astype(x.dtype)
+        h = (jax.nn.silu(jnp.einsum("ebcm,emf->ebcf", expert_in, w_g))
+             * jnp.einsum("ebcm,emf->ebcf", expert_in, w_i))
+    else:
+        act = L.ACTIVATIONS[cfg.activation]
+        h = act(jnp.einsum("ebcm,emf->ebcf", expert_in, w_i))
     expert_out = jnp.einsum("ebcf,efm->ebcm", h, w_o)
+    expert_out = _expert_a2a(expert_out, getattr(cfg, "mesh", None), to_expert=False)
     # expert-sharded -> data-sharded: the return all_to_all
     y = jnp.einsum("bsec,ebcm->bsm", combine, expert_out)
     return y, aux * cfg.moe_aux_loss_weight
+
+
+def _expert_a2a(x, mesh, *, to_expert):
+    """Force the data<->expert reshard of an [E, b, C, m] intermediate to compile
+    to a true all_to_all.
+
+    A single target constraint lets XLA's partitioner fold the reshard into its
+    einsum strategy (which it resolves with all-gathers, replicating the E dim —
+    O(tokens*E) traffic). Pinning BOTH endpoint layouts makes the reshard an
+    explicit tensor-resharding step — the "expert" mesh axis moves between dim 0
+    (E) and dim 1 (b) — which the partitioner lowers to the all_to_all of the
+    reference's ``_AllToAll`` (``deepspeed/moe/sharded_moe.py:90``). Verified in
+    tests/unit/test_moe.py::test_moe_dispatch_emits_all_to_all against HLO.
+
+    No-op when there is no mesh / no expert axis / indivisible shapes — single
+    -device tests and dense paths compile unchanged.
+    """
+    if mesh is None:
+        return x
+    from ..parallel.topology import DATA_AXIS, EXPERT_AXIS
+
+    P = jax.sharding.PartitionSpec
+    ep = mesh.shape.get(EXPERT_AXIS, 1)
+    dp = mesh.shape.get(DATA_AXIS, 1)
+    E, b = x.shape[0], x.shape[1]
+    if ep <= 1 or E % ep or b % (dp * ep):
+        return x
+    rest = [None] * (x.ndim - 2)
+    # tokens-local layout: E replicated, b sharded over the full dp*ep world
+    token_spec = P(None, (DATA_AXIS, EXPERT_AXIS), *rest)
+    # expert-local layout: E over expert, b over data
+    expert_spec = P(EXPERT_AXIS, DATA_AXIS if dp > 1 else None, *rest)
+    first, second = ((token_spec, expert_spec) if to_expert
+                     else (expert_spec, token_spec))
+    x = jax.lax.with_sharding_constraint(x, jax.sharding.NamedSharding(mesh, first))
+    return jax.lax.with_sharding_constraint(x, jax.sharding.NamedSharding(mesh, second))

@@ -54,11 +54,18 @@ class OpBuilder:
         if os.path.exists(path):
             return path
         os.makedirs(self.build_dir, exist_ok=True)
+        # per-process temp name so concurrent builders never interleave writes;
+        # os.replace makes the final publish atomic
+        tmp = f"{path}.{os.getpid()}.tmp"
         cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
-               *self.EXTRA_FLAGS, *self.sources(), "-o", path + ".tmp"]
+               *self.EXTRA_FLAGS, *self.sources(), "-o", tmp]
         logger.info(f"Building native op {self.NAME}: {' '.join(cmd)}")
-        subprocess.run(cmd, check=True, capture_output=True)
-        os.replace(path + ".tmp", path)  # atomic: concurrent builders race safely
+        try:
+            subprocess.run(cmd, check=True, capture_output=True)
+            os.replace(tmp, path)
+        finally:
+            if os.path.exists(tmp):
+                os.remove(tmp)
         return path
 
     def load(self):
@@ -67,7 +74,18 @@ class OpBuilder:
             if not self.is_compatible():
                 raise RuntimeError(
                     f"Native op {self.NAME} requires g++, which is unavailable")
-            self._lib = ctypes.CDLL(self.build())
+            path = self.build()
+            try:
+                self._lib = ctypes.CDLL(path)
+            except OSError:
+                # stale/foreign-arch cached .so (e.g. built on another platform):
+                # rebuild from source once
+                logger.warning(f"cached {path} failed to dlopen; rebuilding")
+                try:
+                    os.remove(path)
+                except FileNotFoundError:
+                    pass  # a concurrent process already cleaned it up
+                self._lib = ctypes.CDLL(self.build())
         return self._lib
 
 

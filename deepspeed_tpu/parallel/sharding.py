@@ -122,9 +122,14 @@ def batch_partition_specs(batch_shapes, mesh):
     reference's expert-data-parallel layout, ``utils/groups.py:108``); sequence dim
     over seq if present."""
     seq_size = _axis_size(mesh, SEQ_AXIS)
+    expert_size = _axis_size(mesh, EXPERT_AXIS)
+    data_size = _axis_size(mesh, DATA_AXIS)
 
     def leaf_spec(shape):
-        spec = [DATA_AXIS]
+        if expert_size > 1 and shape and shape[0] % (data_size * expert_size) == 0:
+            spec = [(DATA_AXIS, EXPERT_AXIS)]
+        else:
+            spec = [DATA_AXIS]
         if len(shape) >= 2 and seq_size > 1 and shape[1] % seq_size == 0:
             spec.append(SEQ_AXIS)
         return P(*spec)

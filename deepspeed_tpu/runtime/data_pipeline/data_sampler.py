@@ -26,6 +26,11 @@ class DeepSpeedDataSampler:
             raise ValueError(
                 f"rank {self.dp_rank} out of range for dp size {self.dp_size}")
         self.micro_batch_times_dp = self.micro_batch_size * self.dp_size
+        if self.drop_last and self.total_samples < self.micro_batch_times_dp:
+            raise ValueError(
+                f"total_samples={self.total_samples} < micro_batch*dp="
+                f"{self.micro_batch_times_dp} with drop_last: no batch can ever "
+                "be formed")
 
     def __len__(self):
         n = self.total_samples - (self.consumed_samples % self.total_samples)

@@ -109,7 +109,6 @@ class DeepSpeedEngine:
                     "config supports sequence_parallel (the transformer backbone)"
                 )
             self.module.config.sequence_parallel = True
-            self.module.config.mesh = self.mesh
         if self.pipe_stages > 1:
             if not (hasattr(self.module, "config")
                     and hasattr(self.module.config, "pipeline_stages")):
@@ -121,7 +120,16 @@ class DeepSpeedEngine:
             self.gradient_accumulation_steps_ = 1
             self.module.config.pipeline_stages = self.pipe_stages
             self.module.config.pipeline_microbatches = self._pipe_microbatches
+        # Hand the mesh to the model whenever its config can carry it: ring
+        # attention (seq), the pipeline loop (pipe), and the MoE dispatch
+        # constraints (expert; moe/sharded_moe.py _expert_a2a) all need it.
+        if hasattr(self.module, "config") and hasattr(self.module.config, "mesh"):
             self.module.config.mesh = self.mesh
+        elif self.mesh.shape.get(EXPERT_AXIS, 1) > 1:
+            logger.warning(
+                "mesh has expert>1 but the model config has no `mesh` field: MoE "
+                "dispatch cannot be constrained to all_to_all and will compile "
+                "to a degraded replicated layout")
 
         # -- parameters (sharded at init = zero.Init) --------------------------------
         self._rng = jax.random.PRNGKey(self._config.seed)
