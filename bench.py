@@ -53,8 +53,10 @@ def main():
         d_ff=4096,
         compute_dtype=jnp.bfloat16,
         attention_impl=os.environ.get("BENCH_ATTN", "xla"),
-        remat=True,
+        remat=os.environ.get("BENCH_NOREMAT", "") != "1",
         remat_policy=os.environ.get("BENCH_REMAT", "minimal"),
+        scan_layers=os.environ.get("BENCH_SCAN", "1") == "1",
+        fused_ce=os.environ.get("BENCH_FUSED_CE", "1") == "1",
     )
     model = CausalLM(cfg)
 

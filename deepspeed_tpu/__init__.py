@@ -52,7 +52,13 @@ def initialize(args=None, model=None, optimizer=None, model_parameters=None,
 
 
 def init_inference(model=None, config=None, **kwargs):
-    """Build an inference engine (reference ``deepspeed/__init__.py:251``)."""
+    """Build an inference engine (reference ``deepspeed/__init__.py:251``).
+
+    ``model`` may be a live zoo model OR a path to a HuggingFace checkpoint
+    directory (the reference's ``init_inference(model, checkpoint=...)`` +
+    module_inject flow): the checkpoint is mapped into the zoo's pytree and
+    served with auto-TP placement (``module_inject/hf.py``).
+    """
     from .inference.engine import InferenceEngine
     from .inference.config import DeepSpeedInferenceConfig
 
@@ -62,6 +68,17 @@ def init_inference(model=None, config=None, **kwargs):
         merged = dict(config or {})
         merged.update(kwargs)
         ds_config = DeepSpeedInferenceConfig.from_dict(merged)
+
+    if isinstance(model, str):
+        import jax
+
+        from .module_inject import hf_model_from_pretrained
+        from .models.layers import split_params_axes
+
+        model, values = hf_model_from_pretrained(model)
+        axes = split_params_axes(
+            jax.eval_shape(model.init, jax.random.PRNGKey(0)))[1]
+        return InferenceEngine(model, ds_config, model_parameters=(values, axes))
     return InferenceEngine(model, ds_config)
 
 

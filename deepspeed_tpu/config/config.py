@@ -158,6 +158,14 @@ class MeshConfig(ConfigModel):
     expert: int = 1
 
 
+class PipelineConfig(ConfigModel):
+    """Pipeline-parallel schedule selection (reference ``runtime/pipe/schedule.py``:
+    ``TrainSchedule`` is 1F1B, the in-flight-bounded default; "gpipe" keeps the
+    AD-through-scan path whose activation footprint grows with microbatch count)."""
+
+    schedule: str = "1f1b"  # 1f1b | gpipe
+
+
 class TensorBoardConfig(ConfigModel):
     enabled: bool = False
     output_path: str = ""
@@ -229,6 +237,7 @@ class DeepSpeedConfig(ConfigModel):
     sparse_gradients: bool = False
     activation_checkpointing: ActivationCheckpointingConfig = ActivationCheckpointingConfig
     mesh: MeshConfig = MeshConfig
+    pipeline: PipelineConfig = PipelineConfig
     tensorboard: TensorBoardConfig = TensorBoardConfig
     wandb: WandbConfig = WandbConfig
     csv_monitor: CSVConfig = CSVConfig

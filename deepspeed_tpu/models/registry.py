@@ -57,6 +57,7 @@ def bloom_config(size="560m", **overrides):
     base = dict(
         vocab_size=250880, max_seq_len=2048, activation="gelu", norm="layernorm",
         position_embedding="alibi", tie_embeddings=True, use_bias=True, prenorm=True,
+        embed_layernorm=True,
     )
     base.update(presets[size])
     base.update(overrides)

@@ -40,6 +40,7 @@ class TransformerConfig:
     position_embedding: str = "learned"  # learned | rope | alibi | none
     rope_base: float = 10000.0
     tie_embeddings: bool = True
+    embed_layernorm: bool = False  # LN right after the embedding (BLOOM)
     use_bias: bool = True
     prenorm: bool = True
     parallel_attn_mlp: bool = False
@@ -48,6 +49,10 @@ class TransformerConfig:
     layernorm_eps: float = 1e-5
     initializer_range: float = 0.02
     scan_layers: bool = True
+    # Fused vocab-chunked cross entropy (ops/cross_entropy.py): the LM-head matmul
+    # and softmax-CE as one streaming op — the [tokens, vocab] logit matrix is
+    # never materialized (fwd or bwd). Big memory + bandwidth win at LLM vocabs.
+    fused_ce: bool = True
     remat: bool = False
     remat_policy: str = "nothing_saveable"  # nothing_saveable | dots_with_no_batch_dims
     compute_dtype: typing.Any = jnp.bfloat16
@@ -398,6 +403,8 @@ class CausalLM:
                     ("seq_table", "embed"),
                 )
             }
+        if cfg.embed_layernorm:
+            params["ln_emb"] = _norm_init(cfg)
         if not cfg.tie_embeddings:
             params["lm_head"] = L.linear_init(
                 k_head, cfg.d_model, cfg.vocab_size, ("embed", "vocab"), bias=False,
@@ -406,10 +413,9 @@ class CausalLM:
         return params
 
     # -- forward ------------------------------------------------------------------
-    def apply(self, params, input_ids, positions=None, attention_mask=None,
-              deterministic=True, dropout_rng=None, return_aux=False):
-        """input_ids: [batch, seq] int32 -> logits [batch, seq, vocab] (compute
-        dtype); with ``return_aux`` also the MoE auxiliary loss."""
+    def backbone(self, params, input_ids, positions=None, attention_mask=None,
+                 deterministic=True, dropout_rng=None):
+        """Embedding + blocks + final norm -> ([batch, seq, d_model], aux)."""
         cfg = self.config
         b, s = input_ids.shape
         if positions is None:
@@ -418,6 +424,8 @@ class CausalLM:
         x = L.embedding_apply(params["wte"], input_ids, cfg.compute_dtype)
         if cfg.position_embedding == "learned":
             x = x + jnp.take(params["wpe"]["weight"].astype(cfg.compute_dtype), positions, axis=0)
+        if cfg.embed_layernorm:
+            x = _norm_apply(cfg, params["ln_emb"], x)
 
         # mask=None means "plain causal" — lets the flash kernel run; an explicit
         # padding mask forces the dense path. Under sequence parallelism the
@@ -441,29 +449,56 @@ class CausalLM:
                              alibi=alibi, deterministic=deterministic,
                              dropout_rng=dropout_rng, kv_mask=kv_mask)
         x = _norm_apply(cfg, params["ln_f"], x)
+        return x, aux
 
-        if cfg.tie_embeddings:
-            logits = L.embedding_attend(params["wte"], x)
-        else:
-            logits = L.linear_apply(params["lm_head"], x)
+    def head(self, params, x):
+        """Hidden states -> logits [batch, seq, vocab] (compute dtype)."""
+        if self.config.tie_embeddings:
+            return L.embedding_attend(params["wte"], x)
+        return L.linear_apply(params["lm_head"], x)
+
+    def head_ce(self, params, x, labels):
+        """Cross entropy from post-final-norm hidden states; picks the fused
+        vocab-chunked path or the materialized-logits path per config. ``params``
+        needs only the head leaves (wte / lm_head), so pipeline stages can pass
+        a head-only subtree."""
+        cfg = self.config
+        if cfg.fused_ce:
+            from ..ops.cross_entropy import fused_cross_entropy
+
+            emb = params["wte"]["weight"] if cfg.tie_embeddings \
+                else params["lm_head"]["kernel"].T
+            return fused_cross_entropy(
+                x.reshape(-1, cfg.d_model), emb, labels.reshape(-1))
+        return cross_entropy_loss(self.head(params, x), labels)
+
+    def apply(self, params, input_ids, positions=None, attention_mask=None,
+              deterministic=True, dropout_rng=None, return_aux=False):
+        """input_ids: [batch, seq] int32 -> logits [batch, seq, vocab] (compute
+        dtype); with ``return_aux`` also the MoE auxiliary loss."""
+        x, aux = self.backbone(params, input_ids, positions=positions,
+                               attention_mask=attention_mask,
+                               deterministic=deterministic, dropout_rng=dropout_rng)
+        logits = self.head(params, x)
         return (logits, aux) if return_aux else logits
 
     # -- loss ---------------------------------------------------------------------
     def loss(self, params, batch, deterministic=True, dropout_rng=None):
         """Next-token cross entropy. batch: {input_ids, labels?, attention_mask?};
         labels default to input_ids shifted; label -100 = ignored (HF convention)."""
+        cfg = self.config
         input_ids = batch["input_ids"]
         labels = batch.get("labels")
         if labels is None:
             labels = jnp.concatenate(
                 [input_ids[:, 1:], jnp.full_like(input_ids[:, :1], -100)], axis=1
             )
-        logits, aux = self.apply(
+        x, aux = self.backbone(
             params, input_ids, attention_mask=batch.get("attention_mask"),
             positions=batch.get("position_ids"), deterministic=deterministic,
-            dropout_rng=dropout_rng, return_aux=True,
+            dropout_rng=dropout_rng,
         )
-        return cross_entropy_loss(logits, labels) + aux
+        return self.head_ce(params, x, labels) + aux
 
 
 def cross_entropy_loss(logits, labels, ignore_index=-100):

@@ -1,0 +1,16 @@
+"""External-model import + auto-TP serving.
+
+TPU-native counterpart of the reference's ``module_inject/`` (3.6k LoC:
+``replace_module.py:276`` walks a torch module tree and surgically swaps HF
+blocks for fused containers, slicing weights per TP rank). Here the same
+capability is data, not surgery: an HF checkpoint is *mapped* into the zoo's
+parameter pytree (``hf.py``), and TP placement falls out of the logical-axis
+sharding specs — the ``ReplaceWithTensorSlicing`` machinery disappears.
+"""
+
+from .hf import (  # noqa: F401
+    config_from_hf,
+    detect_family,
+    load_hf_checkpoint,
+    hf_model_from_pretrained,
+)

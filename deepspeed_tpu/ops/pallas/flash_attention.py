@@ -1,14 +1,26 @@
-"""Pallas TPU flash-attention (forward) kernel.
+"""Pallas TPU flash-attention: tiled forward AND backward kernels.
 
 The TPU-native replacement for the reference's fused attention CUDA kernels
-(``csrc/transformer/softmax_kernels.cu``, inference ``softmax.cu`` "softmax_context"):
-online-softmax attention tiled over query blocks (grid) and key/value blocks
-(in-kernel fori_loop), fp32 accumulators in VMEM scratch, causal blocks skipped
-entirely.
+(``csrc/transformer/softmax_kernels.cu`` for training, the inference
+"softmax_context" kernels in ``csrc/transformer/inference/csrc/softmax.cu``):
+online-softmax attention tiled over query blocks x key/value blocks, fp32
+accumulators in VMEM scratch, causally-skippable kv blocks.
 
-Training backward uses the chunked-XLA recompute path via ``custom_vjp`` (memory-safe
-and differentiable everywhere); the forward kernel is the latency/throughput-critical
-piece for both training fwd and inference prefill.
+Layout notes (the TPU way):
+- grid = (batch*heads, q_blocks, kv_blocks) with the kv dimension innermost and
+  "arbitrary" semantics: the (m, l, acc) running triple lives in VMEM scratch and
+  persists across the kv iterations of one q block; K/V HBM->VMEM streaming is
+  handled by the BlockSpec pipeline (double-buffered by Pallas), so VMEM holds
+  only one K/V block at a time — long sequences never blow VMEM.
+- the row statistics (m/l/lse/delta) are kept broadcast across a 128-lane minor
+  dim: TPU vregs are (8, 128), so a [block_q, 1] column would relayout on every
+  use; [block_q, 128] broadcast is the idiomatic layout (same trick as the
+  reference's warp-level row reductions, just vectorized).
+- backward = two kernels, the standard FlashAttention-2 split: dKV (grid over kv
+  blocks, loop over q) and dQ (grid over q blocks, loop over kv), each
+  recomputing the probability tile from (q, k, lse) so nothing O(s^2) is ever
+  materialized. ``delta = rowsum(dO * O)`` is computed in-kernel at the first
+  visit instead of as a separate XLA pass.
 """
 
 import functools
@@ -20,55 +32,94 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
+LANES = 128
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, block_kv, kv_len,
-                q_offset, block_q):
-    """One (batch*head, q_block) program; loops over kv blocks.
+def _fit_block(requested, seq):
+    """Largest block <= requested that divides seq (backward clamps block sizes,
+    which must never silently truncate the grid)."""
+    b = min(requested, seq)
+    while seq % b:
+        b -= 1
+    return b
 
-    Block shapes: q_ref/o_ref [1, block_q, d]; k_ref/v_ref [1, kv_len, d].
-    """
-    qb = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32) * scale  # [bq, d]
-    d = q.shape[-1]
 
-    n_kv_total = kv_len // block_kv
-    if causal:
-        # last kv position any row in this q block may attend to (global index)
-        last_kv = qb * block_q + (block_q - 1) + q_offset
-        n_kv = jnp.minimum((last_kv // block_kv) + 1, n_kv_total)
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *rest, scale, causal, block_q,
+                block_kv, q_offset, n_kvb, emit_lse):
+    if emit_lse:
+        lse_ref, m_scr, l_scr, acc_scr = rest
     else:
-        n_kv = n_kv_total
+        lse_ref = None
+        m_scr, l_scr, acc_scr = rest
+    j = pl.program_id(1)
+    kb = pl.program_id(2)
 
-    def body(i, carry):
-        m, l, acc = carry
-        k = k_ref[0, pl.ds(i * block_kv, block_kv), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(i * block_kv, block_kv), :].astype(jnp.float32)
-        s_ij = jax.lax.dot_general(
+    @pl.when(kb == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    if causal:
+        # last kv block any row of this q block attends to; diagonal blocks mask
+        limit = (j * block_q + block_q - 1 + q_offset) // block_kv
+        last = jnp.minimum(limit, n_kvb - 1)
+        on_diag = kb * block_kv + block_kv - 1 > j * block_q + q_offset
+        run_full = jnp.logical_and(kb <= limit, jnp.logical_not(on_diag))
+        run_diag = jnp.logical_and(kb <= limit, on_diag)
+    else:
+        last = n_kvb - 1
+        run_full = jnp.asarray(True)
+        run_diag = jnp.asarray(False)
+
+    def step(masked):
+        q = q_ref[0].astype(jnp.float32) * scale
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )  # [bq, bkv]
-        if causal:
+        if masked:
             row = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_kv), 0)
             col = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_kv), 1)
-            q_pos = qb * block_q + row + q_offset
-            kv_pos = i * block_kv + col
-            s_ij = jnp.where(kv_pos <= q_pos, s_ij, NEG_INF)
-        m_new = jnp.maximum(m, jnp.max(s_ij, axis=-1, keepdims=True))
-        p = jnp.exp(s_ij - m_new)
-        corr = jnp.exp(m - m_new)
-        l_new = l * corr + jnp.sum(p, axis=-1, keepdims=True)
-        acc_new = acc * corr + jnp.dot(p, v, preferred_element_type=jnp.float32)
-        return m_new, l_new, acc_new
+            s = jnp.where(kb * block_kv + col <= j * block_q + row + q_offset,
+                          s, NEG_INF)
+        m_prev = m_scr[:, :1]
+        l_prev = l_scr[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m_prev - m_new)
+        l_new = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc_scr[...] = acc_scr[...] * corr + jnp.dot(
+            p, v, preferred_element_type=jnp.float32
+        )
+        m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
 
-    m0 = jnp.full((block_q, 1), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((block_q, 1), jnp.float32)
-    acc0 = jnp.zeros((block_q, d), jnp.float32)
-    m, l, acc = jax.lax.fori_loop(0, n_kv, body, (m0, l0, acc0))
-    o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+    @pl.when(run_full)
+    def _full():
+        step(False)
+
+    if causal:
+        @pl.when(run_diag)
+        def _diag():
+            step(True)
+
+    @pl.when(kb == last)
+    def _finalize():
+        l = jnp.maximum(l_scr[:, :1], 1e-30)
+        o_ref[0] = (acc_scr[...] / l).astype(o_ref.dtype)
+        if emit_lse:
+            lse_ref[0] = jnp.broadcast_to(m_scr[:, :1] + jnp.log(l),
+                                          lse_ref.shape[1:])
 
 
-def _flash_fwd(q, k, v, causal, scale, block_q, block_kv, interpret):
-    """q,k,v: [b, s, h, d] -> out [b, s, h, d]."""
+def _flash_fwd(q, k, v, causal, scale, block_q, block_kv, interpret,
+               need_lse=False):
+    """q,k,v: [b, s, h, d] -> out [b, s, h, d] (+ lse [b*h, s_q, 128] fp32)."""
     b, s_q, h, d = q.shape
     s_kv = k.shape[1]
     scale = scale if scale is not None else 1.0 / math.sqrt(d)
@@ -77,6 +128,7 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_kv, interpret):
     bkv = min(block_kv, s_kv)
     if s_q % bq or s_kv % bkv:
         raise ValueError(f"seq lengths ({s_q},{s_kv}) must divide blocks ({bq},{bkv})")
+    n_kvb = s_kv // bkv
 
     # [b, s, h, d] -> [b*h, s, d]
     qr = q.transpose(0, 2, 1, 3).reshape(b * h, s_q, d)
@@ -84,46 +136,267 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_kv, interpret):
     vr = v.transpose(0, 2, 1, 3).reshape(b * h, s_kv, d)
 
     kernel = functools.partial(
-        _fwd_kernel, scale=scale, causal=causal, block_kv=bkv, kv_len=s_kv,
-        q_offset=s_kv - s_q, block_q=bq,
+        _fwd_kernel, scale=scale, causal=causal, block_q=bq, block_kv=bkv,
+        q_offset=s_kv - s_q, n_kvb=n_kvb, emit_lse=need_lse,
     )
-    out = pl.pallas_call(
+    out_specs = [pl.BlockSpec((1, bq, d), lambda i, j, kb: (i, j, 0),
+                              memory_space=pltpu.VMEM)]
+    out_shape = [jax.ShapeDtypeStruct((b * h, s_q, d), q.dtype)]
+    if need_lse:
+        out_specs.append(pl.BlockSpec((1, bq, LANES), lambda i, j, kb: (i, j, 0),
+                                      memory_space=pltpu.VMEM))
+        out_shape.append(jax.ShapeDtypeStruct((b * h, s_q, LANES), jnp.float32))
+    res = pl.pallas_call(
         kernel,
-        grid=(b * h, s_q // bq),
+        grid=(b * h, s_q // bq, n_kvb),
         in_specs=[
-            pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, s_kv, d), lambda i, j: (i, 0, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, s_kv, d), lambda i, j: (i, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bq, d), lambda i, j, kb: (i, j, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bkv, d), lambda i, j, kb: (i, kb, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bkv, d), lambda i, j, kb: (i, kb, 0), memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0), memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((b * h, s_q, d), q.dtype),
+        out_specs=out_specs,
+        out_shape=out_shape,
+        scratch_shapes=[
+            pltpu.VMEM((bq, LANES), jnp.float32),
+            pltpu.VMEM((bq, LANES), jnp.float32),
+            pltpu.VMEM((bq, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")
+        ),
         interpret=interpret,
     )(qr, kr, vr)
-    return out.reshape(b, h, s_q, d).transpose(0, 2, 1, 3)
+    out = res[0].reshape(b, h, s_q, d).transpose(0, 2, 1, 3)
+    if need_lse:
+        # keep only one lane as the residual: the [.., LANES] broadcast is the
+        # in-kernel layout, not worth 128x the HBM between fwd and bwd
+        return out, res[1][..., :1]
+    return out
 
 
+# ---------------------------------------------------------------------------
+# backward: dQ kernel — grid (b*h, q_blocks, kv_blocks)
+# ---------------------------------------------------------------------------
+def _dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref,
+               dq_scr, delta_scr, *, scale, causal, block_q, block_kv,
+               q_offset, n_kvb):
+    j = pl.program_id(1)
+    kb = pl.program_id(2)
+
+    @pl.when(kb == 0)
+    def _init():
+        dq_scr[...] = jnp.zeros_like(dq_scr)
+        o = o_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        delta = jnp.sum(o * do, axis=-1, keepdims=True)  # [bq, 1]
+        delta_scr[...] = jnp.broadcast_to(delta, delta_scr.shape)
+
+    if causal:
+        limit = (j * block_q + block_q - 1 + q_offset) // block_kv
+        last = jnp.minimum(limit, n_kvb - 1)
+        on_diag = kb * block_kv + block_kv - 1 > j * block_q + q_offset
+        run_full = jnp.logical_and(kb <= limit, jnp.logical_not(on_diag))
+        run_diag = jnp.logical_and(kb <= limit, on_diag)
+    else:
+        last = n_kvb - 1
+        run_full = jnp.asarray(True)
+        run_diag = jnp.asarray(False)
+
+    def step(masked):
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        s = scale * jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [bq, bkv]
+        if masked:
+            row = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_kv), 0)
+            col = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_kv), 1)
+            s = jnp.where(kb * block_kv + col <= j * block_q + row + q_offset,
+                          s, NEG_INF)
+        p = jnp.exp(s - lse_ref[0][:, :1])
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [bq, bkv]
+        ds = p * (dp - delta_scr[:, :1]) * scale
+        dq_scr[...] += jnp.dot(ds, k, preferred_element_type=jnp.float32)
+
+    @pl.when(run_full)
+    def _full():
+        step(False)
+
+    if causal:
+        @pl.when(run_diag)
+        def _diag():
+            step(True)
+
+    @pl.when(kb == last)
+    def _finalize():
+        dq_ref[0] = dq_scr[...].astype(dq_ref.dtype)
+
+
+# ---------------------------------------------------------------------------
+# backward: dK/dV kernel — grid (b*h, kv_blocks, q_blocks)
+# ---------------------------------------------------------------------------
+def _dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dk_ref, dv_ref,
+                dk_scr, dv_scr, *, scale, causal, block_q, block_kv,
+                q_offset, n_qb):
+    jkv = pl.program_id(1)
+    qb = pl.program_id(2)
+
+    @pl.when(qb == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+
+    if causal:
+        # q block contributes iff its last row reaches this kv block's start
+        contrib = qb * block_q + block_q - 1 + q_offset >= jkv * block_kv
+        # diagonal iff the kv block's end passes the q block's first row
+        on_diag = jkv * block_kv + block_kv - 1 > qb * block_q + q_offset
+        run_full = jnp.logical_and(contrib, jnp.logical_not(on_diag))
+        run_diag = jnp.logical_and(contrib, on_diag)
+    else:
+        run_full = jnp.asarray(True)
+        run_diag = jnp.asarray(False)
+
+    def step(masked):
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        o = o_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        delta = jnp.sum(o * do, axis=-1, keepdims=True)  # [bq, 1]
+        s = scale * jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [bq, bkv]
+        if masked:
+            row = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_kv), 0)
+            col = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_kv), 1)
+            s = jnp.where(jkv * block_kv + col <= qb * block_q + row + q_offset,
+                          s, NEG_INF)
+        p = jnp.exp(s - lse_ref[0][:, :1])  # [bq, bkv]
+        # dV += P^T dO
+        dv_scr[...] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta) * scale  # [bq, bkv]
+        # dK += dS^T Q
+        dk_scr[...] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    @pl.when(run_full)
+    def _full():
+        step(False)
+
+    if causal:
+        @pl.when(run_diag)
+        def _diag():
+            step(True)
+
+    @pl.when(qb == n_qb - 1)
+    def _finalize():
+        dk_ref[0] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
+
+
+def _flash_bwd(q, k, v, out, lse, g, causal, scale, block_q, block_kv, interpret):
+    b, s_q, h, d = q.shape
+    s_kv = k.shape[1]
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    bq = _fit_block(block_q, s_q)
+    bkv = _fit_block(block_kv, s_kv)
+    n_qb, n_kvb = s_q // bq, s_kv // bkv
+    q_offset = s_kv - s_q
+
+    to3 = lambda x, s: x.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+    qr, kr, vr = to3(q, s_q), to3(k, s_kv), to3(v, s_kv)
+    orr, gr = to3(out, s_q), to3(g, s_q)
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, scale=scale, causal=causal, block_q=bq,
+                          block_kv=bkv, q_offset=q_offset, n_kvb=n_kvb),
+        grid=(b * h, n_qb, n_kvb),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda i, j, kb: (i, j, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bkv, d), lambda i, j, kb: (i, kb, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bkv, d), lambda i, j, kb: (i, kb, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bq, d), lambda i, j, kb: (i, j, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bq, d), lambda i, j, kb: (i, j, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bq, LANES), lambda i, j, kb: (i, j, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, bq, d), lambda i, j, kb: (i, j, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((b * h, s_q, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((bq, d), jnp.float32),
+            pltpu.VMEM((bq, LANES), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")
+        ),
+        interpret=interpret,
+    )(qr, kr, vr, orr, gr, lse)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, scale=scale, causal=causal, block_q=bq,
+                          block_kv=bkv, q_offset=q_offset, n_qb=n_qb),
+        grid=(b * h, n_kvb, n_qb),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda i, j, qb: (i, qb, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bkv, d), lambda i, j, qb: (i, j, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bkv, d), lambda i, j, qb: (i, j, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bq, d), lambda i, j, qb: (i, qb, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bq, d), lambda i, j, qb: (i, qb, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bq, LANES), lambda i, j, qb: (i, qb, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bkv, d), lambda i, j, qb: (i, j, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bkv, d), lambda i, j, qb: (i, j, 0), memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, s_kv, d), k.dtype),
+            jax.ShapeDtypeStruct((b * h, s_kv, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bkv, d), jnp.float32),
+            pltpu.VMEM((bkv, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")
+        ),
+        interpret=interpret,
+    )(qr, kr, vr, orr, gr, lse)
+
+    to4 = lambda x, s: x.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+    return to4(dq, s_q), to4(dk, s_kv), to4(dv, s_kv)
+
+
+# ---------------------------------------------------------------------------
+# public API
+# ---------------------------------------------------------------------------
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def pallas_flash_attention(q, k, v, causal=True, scale=None, block_q=256,
-                           block_kv=256, interpret=False):
+                           block_kv=512, interpret=False):
     return _flash_fwd(q, k, v, causal, scale, block_q, block_kv, interpret)
 
 
 def _vjp_fwd(q, k, v, causal, scale, block_q, block_kv, interpret):
-    out = _flash_fwd(q, k, v, causal, scale, block_q, block_kv, interpret)
-    return out, (q, k, v)
+    out, lse = _flash_fwd(q, k, v, causal, scale, block_q, block_kv, interpret,
+                          need_lse=True)
+    return out, (q, k, v, out, lse)
 
 
 def _vjp_bwd(causal, scale, block_q, block_kv, interpret, residuals, g):
-    """Backward via recompute through the chunked-XLA path (same semantics)."""
-    from ..flash_attention import _chunked_attention
-
-    q, k, v = residuals
-    _, vjp = jax.vjp(
-        lambda q_, k_, v_: _chunked_attention(q_, k_, v_, causal=causal, scale=scale,
-                                              block_size=block_kv),
-        q, k, v,
-    )
-    return vjp(g)
+    q, k, v, out, lse = residuals
+    lse = jnp.broadcast_to(lse, lse.shape[:-1] + (LANES,))
+    return _flash_bwd(q, k, v, out, lse, g, causal, scale,
+                      min(block_q, 256), min(block_kv, 256), interpret)
 
 
 pallas_flash_attention.defvjp(_vjp_fwd, _vjp_bwd)

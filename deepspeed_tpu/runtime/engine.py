@@ -331,6 +331,36 @@ class DeepSpeedEngine:
     def _build_fwd_bwd(self):
         gas = self.gradient_accumulation_steps_
 
+        use_1f1b = (self.pipe_stages > 1
+                    and self._config.pipeline.schedule == "1f1b"
+                    and isinstance(self.params, dict) and "blocks" in self.params)
+        if use_1f1b and self.mp_world_size > 1:
+            # XLA's partial-manual partitioner cannot rendezvous the model-axis
+            # (TP) collectives it inserts inside the 1F1B schedule's
+            # stage-varying lax.cond branches (deadlock at runtime). Until that
+            # is fixed upstream, TP x PP meshes take the GPipe schedule — same
+            # numerics, activation footprint O(microbatches).
+            logger.warning(
+                "pipeline schedule '1f1b' is not supported with tensor "
+                "parallelism (mesh model=%d); falling back to gpipe",
+                self.mp_world_size)
+            use_1f1b = False
+        if use_1f1b:
+            # 1F1B: the whole microbatch window (fwd AND bwd, interleaved) is one
+            # compiled schedule — in-flight activations bounded by stages, not
+            # microbatches (reference runtime/pipe/schedule.py:189 TrainSchedule).
+            from ..parallel.pipeline_1f1b import build_1f1b_train_step
+
+            step = build_1f1b_train_step(self.module, self.mesh,
+                                         self._pipe_microbatches)
+            with self.mesh:
+                self._fwd_bwd_fn = jax.jit(
+                    step,
+                    out_shardings=(NamedSharding(self.mesh, P()),
+                                   self._grad_shardings),
+                )
+            return
+
         def fwd_bwd(params, batch, scale, rng):
             def scaled_loss(p):
                 loss = self.module.loss(p, batch, deterministic=False, dropout_rng=rng)
@@ -387,10 +417,15 @@ class DeepSpeedEngine:
                     )
             return new_params, new_state, scale, good_steps, overflow, norm
 
+        # Donate params + opt state only: grads (arg 2) have the same
+        # shapes/dtypes as the params but there are only len(outputs) buffers to
+        # alias (new_params + new_state), so donating them too makes XLA report
+        # one whole param-tree of "donated buffers were not usable" — the grads
+        # buffer is freed after the step either way (engine drops its reference).
         with self.mesh:
             self._apply_fn = jax.jit(
                 apply_step,
-                donate_argnums=(0, 1, 2),
+                donate_argnums=(0, 1),
                 out_shardings=(
                     self.param_shardings,
                     self._opt_shardings,

@@ -1,0 +1,103 @@
+"""HF checkpoint import: logits parity against the actual transformers models.
+
+The strongest offline check of the module_inject mapping (reference
+``module_inject/containers/*``): build real HF torch models at tiny sizes,
+``save_pretrained``, import with our loader, and compare logits numerically —
+this validates the name mapping, every transpose/de-interleave, the OPT
+position offset, BLOOM's embedding LN + alibi, and LLaMA's rope convention.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+from deepspeed_tpu.module_inject import hf_model_from_pretrained  # noqa: E402
+
+
+def _seed():
+    torch.manual_seed(0)
+
+
+def _save(tmp_path, model):
+    d = str(tmp_path / "ckpt")
+    model.save_pretrained(d, safe_serialization=True)
+    return d
+
+
+def _parity(path, hf_model, ids, atol=2e-4):
+    model, params = hf_model_from_pretrained(path)
+    model.config.compute_dtype = jnp.float32
+    ours = np.asarray(model.apply(params, jnp.asarray(ids)))
+    with torch.no_grad():
+        theirs = hf_model(torch.tensor(ids)).logits.float().numpy()
+    np.testing.assert_allclose(ours, theirs, atol=atol, rtol=1e-3)
+
+
+def test_gpt2_import_parity(tmp_path):
+    cfg = transformers.GPT2Config(n_layer=2, n_head=2, n_embd=32,
+                                  vocab_size=96, n_positions=64)
+    _seed()
+    hf = transformers.GPT2LMHeadModel(cfg).eval()
+    ids = np.random.RandomState(0).randint(0, 96, (2, 12))
+    _parity(_save(tmp_path, hf), hf, ids)
+
+
+def test_opt_import_parity(tmp_path):
+    cfg = transformers.OPTConfig(
+        num_hidden_layers=2, num_attention_heads=2, hidden_size=32, ffn_dim=64,
+        vocab_size=96, max_position_embeddings=64, word_embed_proj_dim=32,
+        activation_function="relu", do_layer_norm_before=True)
+    _seed()
+    hf = transformers.OPTForCausalLM(cfg).eval()
+    ids = np.random.RandomState(1).randint(0, 96, (2, 10))
+    _parity(_save(tmp_path, hf), hf, ids)
+
+
+def test_bloom_import_parity(tmp_path):
+    cfg = transformers.BloomConfig(n_layer=2, n_head=4, hidden_size=32,
+                                   vocab_size=96)
+    _seed()
+    hf = transformers.BloomForCausalLM(cfg).eval()
+    ids = np.random.RandomState(2).randint(0, 96, (2, 8))
+    _parity(_save(tmp_path, hf), hf, ids)
+
+
+def test_llama_import_parity(tmp_path):
+    cfg = transformers.LlamaConfig(
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        hidden_size=32, intermediate_size=64, vocab_size=96,
+        max_position_embeddings=64, tie_word_embeddings=False)
+    _seed()
+    hf = transformers.LlamaForCausalLM(cfg).eval()
+    ids = np.random.RandomState(3).randint(0, 96, (1, 16))
+    _parity(_save(tmp_path, hf), hf, ids)
+
+
+def test_init_inference_from_path_generates(tmp_path, devices8):
+    """The north-star shape: init_inference(path) under TP=2 serves the model;
+    greedy generation matches the TP=1 run token for token."""
+    import deepspeed_tpu
+
+    cfg = transformers.GPT2Config(n_layer=2, n_head=2, n_embd=32,
+                                  vocab_size=96, n_positions=64)
+    _seed()
+    hf = transformers.GPT2LMHeadModel(cfg).eval()
+    path = _save(tmp_path, hf)
+
+    ids = np.random.RandomState(4).randint(0, 96, (2, 6)).astype(np.int32)
+
+    eng1 = deepspeed_tpu.init_inference(path, dtype="float32", max_tokens=64)
+    out1 = np.asarray(eng1.generate(ids, max_new_tokens=8, greedy=True))
+
+    eng2 = deepspeed_tpu.init_inference(
+        path, dtype="float32", max_tokens=64,
+        tensor_parallel={"enabled": True, "tp_size": 2})
+    out2 = np.asarray(eng2.generate(ids, max_new_tokens=8, greedy=True))
+
+    np.testing.assert_array_equal(out1, out2)
+    assert out1.shape == (2, 14)

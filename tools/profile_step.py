@@ -1,0 +1,102 @@
+"""Micro-profile of one training step on the real chip.
+
+Times, separately: a reference GEMM at model shapes (achievable peak), model
+forward, forward+backward, optimizer apply, and the full engine step — so MFU
+losses can be attributed to a phase instead of guessed at.
+"""
+
+import os
+import time
+
+import numpy as np
+
+
+def timeit(fn, *args, n=5, warmup=2):
+    import jax
+
+    for _ in range(warmup):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    # axon tunnel: block_until_ready may not block; host readback is the fence
+    leaf = jax.tree_util.tree_leaves(out)[0]
+    np.asarray(jax.device_get(leaf.ravel()[0]))
+    t0 = time.perf_counter()
+    for _ in range(n):
+        out = fn(*args)
+    leaf = jax.tree_util.tree_leaves(out)[0]
+    np.asarray(jax.device_get(leaf.ravel()[0]))
+    return (time.perf_counter() - t0) / n
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models import CausalLM, TransformerConfig
+
+    cfg = TransformerConfig(
+        vocab_size=50304, max_seq_len=1024, n_layers=24, n_heads=16,
+        d_model=1024, d_ff=4096, compute_dtype=jnp.bfloat16,
+        attention_impl=os.environ.get("BENCH_ATTN", "xla"),
+        remat=os.environ.get("BENCH_NOREMAT", "") != "1",
+        remat_policy=os.environ.get("BENCH_REMAT", "minimal"),
+    )
+    model = CausalLM(cfg)
+    b = int(os.environ.get("BENCH_BATCH", "12"))
+    s = 1024
+    config = {
+        "train_batch_size": b,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-4}},
+        "bf16": {"enabled": True},
+        "zero_optimization": {"stage": 0},
+        "gradient_clipping": 1.0,
+        "steps_per_print": 10 ** 9,
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=config)
+    rng = np.random.RandomState(0)
+    batch = {"input_ids": rng.randint(0, cfg.vocab_size, (b, s)).astype(np.int32)}
+    sharded = engine._shard_batch(batch)
+
+    # reference GEMM: same M as the model's token dim, K=N=4096 (mlp shape)
+    M = b * s
+    x = jnp.zeros((M, 1024), jnp.bfloat16)
+    w1 = jnp.zeros((1024, 4096), jnp.bfloat16)
+    w2 = jnp.zeros((4096, 1024), jnp.bfloat16)
+    gemm = jax.jit(lambda x, w1, w2: (x @ w1) @ w2)
+    t = timeit(gemm, x, w1, w2, n=20)
+    gemm_fl = 2 * M * 1024 * 4096 * 2
+    print(f"ref gemm pair: {t*1e3:.2f} ms -> {gemm_fl/t/1e12:.1f} TFLOP/s")
+
+    # forward only (loss, no grads)
+    step_rng = jax.random.PRNGKey(0)
+    with engine.mesh:
+        fwd = jax.jit(lambda p, bt: model.loss(p, bt, deterministic=False,
+                                               dropout_rng=step_rng))
+    t_fwd = timeit(fwd, engine.params, sharded)
+    print(f"forward:  {t_fwd*1e3:.1f} ms")
+
+    # forward+backward
+    if engine._fwd_bwd_fn is None:
+        engine._build_fwd_bwd()
+    t_fb = timeit(
+        lambda: engine._fwd_bwd_fn(engine.params, sharded, engine._scale, step_rng))
+    print(f"fwd+bwd:  {t_fb*1e3:.1f} ms (bwd+remat ~ {(t_fb-t_fwd)*1e3:.1f} ms)")
+
+    # apply (can't donate repeatedly -> time via full step loop minus fwd_bwd)
+    def full_step():
+        loss = engine.forward(batch)
+        engine.backward(loss)
+        engine.step()
+        return engine.params
+
+    t_step = timeit(full_step, n=5)
+    print(f"full step: {t_step*1e3:.1f} ms (apply+overhead ~ {(t_step-t_fb)*1e3:.1f} ms)")
+
+    n_params = engine.num_parameters
+    mfu = 6.0 * n_params * M / t_step / 1e12 / 197.0
+    print(f"MFU: {mfu:.4f}")
+
+
+if __name__ == "__main__":
+    main()
