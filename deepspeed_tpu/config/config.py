@@ -158,6 +158,16 @@ class MeshConfig(ConfigModel):
     expert: int = 1
 
 
+class CheckpointConfig(ConfigModel):
+    """Checkpoint engine selection (reference ``runtime/checkpoint_engine/`` +
+    ``deepspeed/checkpoint/`` universal layout). "sharded" writes per-process
+    index-range-addressed shards and reshapes on load across mesh changes;
+    "npz" is the legacy single-file gather-to-host engine."""
+
+    engine: str = "sharded"  # sharded | npz
+    async_save: bool = False
+
+
 class PipelineConfig(ConfigModel):
     """Pipeline-parallel schedule selection (reference ``runtime/pipe/schedule.py``:
     ``TrainSchedule`` is 1F1B, the in-flight-bounded default; "gpipe" keeps the
@@ -238,6 +248,7 @@ class DeepSpeedConfig(ConfigModel):
     activation_checkpointing: ActivationCheckpointingConfig = ActivationCheckpointingConfig
     mesh: MeshConfig = MeshConfig
     pipeline: PipelineConfig = PipelineConfig
+    checkpoint: CheckpointConfig = CheckpointConfig
     tensorboard: TensorBoardConfig = TensorBoardConfig
     wandb: WandbConfig = WandbConfig
     csv_monitor: CSVConfig = CSVConfig

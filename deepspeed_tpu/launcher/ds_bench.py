@@ -1,0 +1,96 @@
+"""``ds_tpu_bench`` — collective micro-benchmark CLI.
+
+Reference: ``bin/ds_bench`` -> DeepSpeedExamples communication benchmarks
+(all_reduce/all_gather/all_to_all latency + algorithmic bandwidth sweeps).
+Here the collectives are the framework's own comm facade compiled over the
+local device mesh (real TPU chips or the virtual CPU mesh), which is what a
+user tunes against before scaling out.
+
+Usage: python -m deepspeed_tpu.launcher.ds_bench [--op all_reduce]
+       [--min_mb 1] [--max_mb 64] [--trials 5]
+"""
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+
+def run_sweep(op="all_reduce", min_mb=1, max_mb=64, trials=5, dtype="float32"):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    devs = jax.devices()
+    n = len(devs)
+    mesh = Mesh(np.array(devs), ("data",))
+    dt = {"float32": jnp.float32, "bfloat16": jnp.bfloat16}[dtype]
+    itemsize = np.dtype(np.float32).itemsize if dtype == "float32" else 2
+
+    def make_fn(op):
+        if op == "all_reduce":
+            f = lambda x: jax.lax.psum(x, "data")
+            vol = lambda b: 2 * b * (n - 1) / n  # ring allreduce bytes/device
+        elif op == "all_gather":
+            f = lambda x: jax.lax.all_gather(x, "data")
+            vol = lambda b: b * (n - 1) / n
+        elif op == "reduce_scatter":
+            f = lambda x: jax.lax.psum_scatter(x, "data", tiled=True)
+            vol = lambda b: b * (n - 1) / n
+        elif op == "all_to_all":
+            f = lambda x: jax.lax.all_to_all(
+                x.reshape(n, -1), "data", 0, 0, tiled=False).reshape(-1)
+            vol = lambda b: b * (n - 1) / n
+        else:
+            raise ValueError(op)
+        return f, vol
+
+    f, vol = make_fn(op)
+    sm = jax.jit(jax.shard_map(f, mesh=mesh, in_specs=P("data"),
+                               out_specs=P() if op == "all_reduce" else P("data"),
+                               check_vma=False))
+
+    results = []
+    mb = min_mb
+    while mb <= max_mb:
+        elems = mb * 1024 * 1024 // itemsize
+        elems = max(elems - elems % n, n)
+        x = jax.device_put(
+            jnp.ones((elems,), dt),
+            NamedSharding(mesh, P("data")))
+        out = sm(x)
+        jax.block_until_ready(out)
+        t0 = time.perf_counter()
+        for _ in range(trials):
+            out = sm(x)
+        jax.block_until_ready(out)
+        np.asarray(jax.device_get(jax.tree_util.tree_leaves(out)[0].ravel()[0]))
+        dt_s = (time.perf_counter() - t0) / trials
+        res = {
+            "op": op, "size_mb": mb, "devices": n,
+            "latency_us": round(dt_s * 1e6, 1),
+            "algbw_gbps": round(vol(mb * 1024 * 1024) / dt_s / 1e9, 3),
+        }
+        results.append(res)
+        print(json.dumps(res))
+        mb *= 2
+    return results
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description="deepspeed_tpu collective benchmark")
+    p.add_argument("--op", default="all_reduce",
+                   choices=["all_reduce", "all_gather", "reduce_scatter",
+                            "all_to_all"])
+    p.add_argument("--min_mb", type=int, default=1)
+    p.add_argument("--max_mb", type=int, default=64)
+    p.add_argument("--trials", type=int, default=5)
+    p.add_argument("--dtype", default="float32", choices=["float32", "bfloat16"])
+    a = p.parse_args(argv)
+    run_sweep(a.op, a.min_mb, a.max_mb, a.trials, a.dtype)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

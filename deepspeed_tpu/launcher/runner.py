@@ -39,6 +39,16 @@ def parse_args(args=None):
     parser.add_argument("--master_port", type=int, default=8476)
     parser.add_argument("--node_rank", type=int, default=-1,
                         help="this host's index in the pod (auto from TPU metadata if unset)")
+    parser.add_argument("--num_local_procs", type=int, default=0,
+                        help="spawn N local worker processes (multi-host "
+                             "simulated on this machine; CPU pods / tests)")
+    parser.add_argument("--local_devices_per_proc", type=int, default=0,
+                        help="with --num_local_procs: virtual CPU devices per "
+                             "worker (0 = leave platform env untouched)")
+    parser.add_argument("--ssh", action="store_true",
+                        help="with --hostfile: launch the command on every "
+                             "host over ssh (reference PDSH runner role)")
+    parser.add_argument("--ssh_port", type=int, default=22)
     parser.add_argument("--deepspeed_config", type=str, default=None)
     parser.add_argument("--module", action="store_true",
                         help="run the target as 'python -m <module>'")
@@ -84,6 +94,74 @@ def parse_inclusion_exclusion(resource_pool, inclusion, exclusion):
     return active
 
 
+class SshRunner:
+    """Multi-node command builder+executor over plain ssh — the reference's
+    ``multinode_runner.py`` PDSH role (``:51``) without the pdsh dependency:
+    one ssh per host, rendezvous env inlined into the remote command."""
+
+    def __init__(self, hosts, master, master_port, ssh_port=22):
+        self.hosts = list(hosts)
+        self.master = master
+        self.master_port = master_port
+        self.ssh_port = ssh_port
+
+    def build_cmds(self, cmd, extra_env=None):
+        import shlex
+
+        cmds = []
+        for rank, host in enumerate(self.hosts):
+            env = {
+                "DS_TPU_NUM_PROCESSES": str(len(self.hosts)),
+                "DS_TPU_COORDINATOR": self.master,
+                "DS_TPU_PROCESS_ID": str(rank),
+                "MASTER_PORT": str(self.master_port),
+            }
+            env.update(extra_env or {})
+            exports = " ".join(f"{k}={shlex.quote(str(v))}"
+                               for k, v in sorted(env.items()))
+            remote = (f"cd {shlex.quote(os.getcwd())} && {exports} "
+                      f"{' '.join(shlex.quote(c) for c in cmd)}")
+            cmds.append(["ssh", "-p", str(self.ssh_port),
+                         "-o", "StrictHostKeyChecking=no", host, remote])
+        return cmds
+
+    def run(self, cmd, extra_env=None):
+        procs = [subprocess.Popen(c) for c in self.build_cmds(cmd, extra_env)]
+        rcs = [p.wait() for p in procs]
+        return max(rcs) if rcs else 0
+
+
+def launch_local_procs(cmd, num_procs, env, devices_per_proc=0,
+                       master_port=None):
+    """Spawn ``num_procs`` local workers with the rendezvous env — multi-host
+    simulated on one machine (the reference test-harness pattern,
+    ``tests/unit/common.py:183``), also the real path for CPU pods."""
+    import socket
+
+    if master_port is None:
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        master_port = s.getsockname()[1]
+        s.close()
+    procs = []
+    for rank in range(num_procs):
+        wenv = dict(env)
+        wenv.update({
+            "DS_TPU_NUM_PROCESSES": str(num_procs),
+            "DS_TPU_COORDINATOR": "127.0.0.1",
+            "DS_TPU_PROCESS_ID": str(rank),
+            "MASTER_PORT": str(master_port),
+        })
+        if devices_per_proc:
+            wenv["JAX_PLATFORMS"] = "cpu"
+            wenv["XLA_FLAGS"] = (wenv.get("XLA_FLAGS", "") +
+                                 f" --xla_force_host_platform_device_count="
+                                 f"{devices_per_proc}").strip()
+        procs.append(subprocess.Popen(cmd, env=wenv))
+    rcs = [p.wait() for p in procs]
+    return max(rcs) if rcs else 0
+
+
 def main(args=None):
     args = parse_args(args)
 
@@ -118,6 +196,20 @@ def main(args=None):
         cmd = [sys.executable, "-m", args.user_script] + args.user_args
     else:
         cmd = [sys.executable, args.user_script] + args.user_args
+
+    if args.num_local_procs > 0:
+        logger.info(f"ds_tpu: spawning {args.num_local_procs} local workers")
+        return launch_local_procs(cmd, args.num_local_procs, env,
+                                  devices_per_proc=args.local_devices_per_proc,
+                                  master_port=None)
+    if args.ssh and resource_pool:
+        hosts = sorted(resource_pool)
+        runner = SshRunner(hosts, args.master_addr or hosts[0],
+                           args.master_port, ssh_port=args.ssh_port)
+        extra = {"DS_TPU_CONFIG": args.deepspeed_config} \
+            if args.deepspeed_config else None
+        logger.info(f"ds_tpu: ssh launch on {len(hosts)} hosts")
+        return runner.run(cmd, extra)
     result = subprocess.call(cmd, env=env)
     return result
 

@@ -62,6 +62,7 @@ class _Reader:
             raise FileNotFoundError(
                 f"No model.safetensors[.index.json] or pytorch_model.bin under {path}")
         self._handles = {}
+        self._name_set = None
 
     def _names_of(self, f):
         if self._mode == "safetensors":
@@ -73,22 +74,25 @@ class _Reader:
         return list(self._load_torch(f).keys())
 
     def _load_torch(self, f):
-        if self._torch_maps is None:
-            self._torch_maps = {}
-        if f not in self._torch_maps:
+        # keep ONE file's pickle map alive (shard files are read layer-major,
+        # so LRU-1 avoids holding the whole checkpoint in host RAM)
+        if self._torch_maps is None or f not in self._torch_maps:
             import torch
 
-            self._torch_maps[f] = torch.load(f, map_location="cpu",
-                                             weights_only=True)
+            self._torch_maps = {f: torch.load(f, map_location="cpu",
+                                              weights_only=True)}
         return self._torch_maps[f]
 
     def names(self):
-        if self._where is not None:
-            return list(self._where.keys())
-        out = []
-        for f in self._files:
-            out.extend(self._names_of(f))
-        return out
+        if self._name_set is None:
+            if self._where is not None:
+                out = list(self._where.keys())
+            else:
+                out = []
+                for f in self._files:
+                    out.extend(self._names_of(f))
+            self._name_set = out
+        return self._name_set
 
     def get(self, name):
         """-> np.ndarray float32."""
@@ -107,7 +111,7 @@ class _Reader:
         return t.to(torch.float32).numpy()
 
     def has(self, name):
-        return name in set(self.names())
+        return name in self.names()
 
 
 # ---------------------------------------------------------------------------

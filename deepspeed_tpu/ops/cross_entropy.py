@@ -24,12 +24,20 @@ import jax
 import jax.numpy as jnp
 
 
-def _pick_chunks(vocab, n_chunks):
-    """Largest chunk count <= n_chunks that divides vocab."""
-    for c in range(n_chunks, 0, -1):
-        if vocab % c == 0:
-            return c
-    return 1
+def _chunking(vocab, n_chunks):
+    """(n_chunks, chunk, padded_vocab): uniform chunks via padding — a divisor
+    search would silently fall back to ONE chunk for prime-ish vocabs (GPT-2's
+    50257!) and materialize the full logit matrix, voiding the op entirely."""
+    nc = max(1, min(n_chunks, vocab))
+    chunk = -(-vocab // nc)  # ceil
+    return nc, chunk, nc * chunk
+
+
+def _pad_emb(emb, padded_vocab):
+    vocab = emb.shape[0]
+    if padded_vocab == vocab:
+        return emb
+    return jnp.pad(emb, ((0, padded_vocab - vocab), (0, 0)))
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
@@ -46,9 +54,8 @@ def fused_cross_entropy(x, emb, labels, ignore_index=-100, n_chunks=8):
 def _ce_fwd_impl(x, emb, labels, ignore_index, n_chunks):
     tokens, d = x.shape
     vocab = emb.shape[0]
-    nc = _pick_chunks(vocab, n_chunks)
-    chunk = vocab // nc
-    emb_c = emb.reshape(nc, chunk, d)
+    nc, chunk, padded = _chunking(vocab, n_chunks)
+    emb_c = _pad_emb(emb, padded).reshape(nc, chunk, d)
     starts = jnp.arange(nc, dtype=jnp.int32) * chunk
 
     valid = labels != ignore_index
@@ -61,6 +68,10 @@ def _ce_fwd_impl(x, emb, labels, ignore_index, n_chunks):
             x, e_c.astype(x.dtype), (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )  # [tokens, chunk]
+        if padded != vocab:
+            # padded (fake-vocab) columns must not contribute to the logsumexp
+            col = c0 + jnp.arange(chunk, dtype=jnp.int32)[None, :]
+            logits = jnp.where(col < vocab, logits, -jnp.inf)
         m_new = jnp.maximum(m, jnp.max(logits, axis=-1))
         s = s * jnp.exp(m - m_new) + jnp.sum(
             jnp.exp(logits - m_new[:, None]), axis=-1)
@@ -90,9 +101,8 @@ def _ce_vjp_bwd(ignore_index, n_chunks, residuals, g):
     x, emb, labels, lse, n_valid = residuals
     tokens, d = x.shape
     vocab = emb.shape[0]
-    nc = _pick_chunks(vocab, n_chunks)
-    chunk = vocab // nc
-    emb_c = emb.reshape(nc, chunk, d)
+    nc, chunk, padded = _chunking(vocab, n_chunks)
+    emb_c = _pad_emb(emb, padded).reshape(nc, chunk, d)
     starts = jnp.arange(nc, dtype=jnp.int32) * chunk
 
     valid = labels != ignore_index
@@ -106,6 +116,9 @@ def _ce_vjp_bwd(ignore_index, n_chunks, residuals, g):
             preferred_element_type=jnp.float32,
         )  # [tokens, chunk]
         p = jnp.exp(logits - lse[:, None])
+        if padded != vocab:
+            col = c0 + jnp.arange(chunk, dtype=jnp.int32)[None, :]
+            p = jnp.where(col < vocab, p, 0.0)
         in_chunk = (safe_labels >= c0) & (safe_labels < c0 + chunk)
         idx = jnp.clip(safe_labels - c0, 0, chunk - 1)
         onehot = (jnp.arange(chunk, dtype=jnp.int32)[None, :] == idx[:, None]) \
@@ -124,7 +137,8 @@ def _ce_vjp_bwd(ignore_index, n_chunks, residuals, g):
 
     dx0 = jnp.zeros((tokens, d), jnp.float32)
     dx, de = jax.lax.scan(body, dx0, (emb_c, starts))
-    return dx.astype(x.dtype), de.reshape(vocab, d).astype(emb.dtype), None
+    de = de.reshape(padded, d)[:vocab]
+    return dx.astype(x.dtype), de.astype(emb.dtype), None
 
 
 fused_cross_entropy.defvjp(_ce_vjp_fwd, _ce_vjp_bwd)

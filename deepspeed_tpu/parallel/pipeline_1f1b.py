@@ -142,9 +142,16 @@ def build_1f1b_train_step(model, mesh, n_microbatches):
             for k in head_keys
         }
         labels_ms = _to_microbatches(labels, M, mesh)
+        # Per-microbatch valid-token weights: head_ce returns a mean over each
+        # microbatch's OWN valid tokens, so an unweighted sum/M would give
+        # sparse microbatches (uneven -100 padding) outsized per-token gradient
+        # weight vs the plain full-batch token-mean. Weight each microbatch's
+        # loss (and cotangent seed) by its share of the global valid count.
+        valid_ms = jnp.sum(labels_ms != -100, axis=(1, 2)).astype(jnp.float32)
+        mb_weight = valid_ms / jnp.maximum(jnp.sum(valid_ms), 1.0)  # [M]
 
         # ---- the compiled 1F1B schedule over the pipe axis
-        def pipe_fn(blocks_w, head_w, xs, labels_ms, side_ms):
+        def pipe_fn(blocks_w, head_w, xs, labels_ms, mb_weight, side_ms):
             stage = jax.lax.axis_index(PIPE_AXIS)
             T = 2 * (M + S - 1)
             mb_shape = xs.shape[1:]  # [mb, s, d]
@@ -231,10 +238,12 @@ def build_1f1b_train_step(model, mesh, n_microbatches):
                         ls, h_vjp = jax.vjp(
                             lambda wh, hh: head_loss(wh, hh, labels_ms[m_b]),
                             head_w, h2)
-                        g_wh, g_h2 = h_vjp((scale / M).astype(ls.dtype))
+                        w_m = mb_weight[m_b]
+                        g_wh, g_h2 = h_vjp((scale * w_m).astype(ls.dtype))
                         return (jax.tree_util.tree_map(
                                     lambda a: a.astype(jnp.float32), g_wh),
-                                g_h2.astype(compute_dtype), ls.astype(jnp.float32))
+                                g_h2.astype(compute_dtype),
+                                (ls * w_m).astype(jnp.float32))
 
                     def mid_case(_):
                         return (jax.tree_util.tree_map(
@@ -280,7 +289,8 @@ def build_1f1b_train_step(model, mesh, n_microbatches):
 
             is_last = (stage == S - 1).astype(jnp.float32)
             is_first = (stage == 0).astype(jnp.float32)
-            loss = jax.lax.psum(carry["loss"] * is_last, PIPE_AXIS) / M
+            # per-mb losses arrive pre-weighted by valid-token share -> plain sum
+            loss = jax.lax.psum(carry["loss"] * is_last, PIPE_AXIS)
             aux = jax.lax.psum(carry["aux"], PIPE_AXIS) / M
             g_head = jax.tree_util.tree_map(
                 lambda a: jax.lax.psum(a * is_last, PIPE_AXIS), carry["g_head"])
@@ -294,13 +304,13 @@ def build_1f1b_train_step(model, mesh, n_microbatches):
         sm = jax.shard_map(
             pipe_fn,
             mesh=mesh,
-            in_specs=(blocks_specs, head_specs, P(), P(), side_specs),
+            in_specs=(blocks_specs, head_specs, P(), P(), P(), side_specs),
             out_specs=(P(), P(), blocks_specs, head_specs, P()),
             axis_names={PIPE_AXIS},
             check_vma=False,
         )
         loss, aux_mean, gW, g_head, gx = sm(
-            params["blocks"], head_w, xs, labels_ms, side_ms)
+            params["blocks"], head_w, xs, labels_ms, mb_weight, side_ms)
 
         (g_embed,) = embed_vjp(gx.reshape((B,) + gx.shape[2:]))
 

@@ -188,9 +188,21 @@ class DeepSpeedEngine:
             self.training_dataloader = self.deepspeed_io(training_data, collate_fn=collate_fn)
 
         # -- checkpointing -----------------------------------------------------------
-        from ..checkpoint.engine import NpzCheckpointEngine
+        ckpt_cfg = self._config.checkpoint
+        if ckpt_cfg.engine == "sharded":
+            from ..checkpoint.sharded import (AsyncShardedCheckpointEngine,
+                                              ShardedCheckpointEngine)
 
-        self.checkpoint_engine = NpzCheckpointEngine()
+            self.checkpoint_engine = AsyncShardedCheckpointEngine() \
+                if ckpt_cfg.async_save else ShardedCheckpointEngine()
+        elif ckpt_cfg.async_save:
+            from ..checkpoint.engine import AsyncCheckpointEngine
+
+            self.checkpoint_engine = AsyncCheckpointEngine()
+        else:
+            from ..checkpoint.engine import NpzCheckpointEngine
+
+            self.checkpoint_engine = NpzCheckpointEngine()
 
         # -- compiled functions (built lazily) ---------------------------------------
         self._fwd_bwd_fn = None

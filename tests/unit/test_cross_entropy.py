@@ -65,3 +65,28 @@ def test_fused_ce_vocab_not_divisible():
     ref = cross_entropy_loss(logits, labels[None])
     out = fused_cross_entropy(x, emb, labels, -100, 8)
     np.testing.assert_allclose(float(ref), float(out), rtol=1e-5)
+
+
+def test_fused_ce_prime_vocab_stays_chunked():
+    """GPT-2's vocab (50257) has no small divisors; chunking must pad, not
+    fall back to one full-width chunk."""
+    from deepspeed_tpu.ops.cross_entropy import _chunking
+
+    nc, chunk, padded = _chunking(50257, 8)
+    assert nc == 8 and chunk == 6283 and padded >= 50257
+
+    # numerics at a small prime vocab with padding + grads
+    x, emb, labels = _setup(vocab=97, seed=11)
+    logits = (x @ emb.T)[None]
+    ref = cross_entropy_loss(logits, labels[None])
+    out = fused_cross_entropy(x, emb, labels, -100, 8)
+    np.testing.assert_allclose(float(ref), float(out), rtol=1e-5)
+
+    gx_r, ge_r = jax.grad(
+        lambda x, e: cross_entropy_loss((x @ e.T)[None], labels[None]),
+        argnums=(0, 1))(x, emb)
+    gx_f, ge_f = jax.grad(
+        lambda x, e: fused_cross_entropy(x, e, labels, -100, 8),
+        argnums=(0, 1))(x, emb)
+    np.testing.assert_allclose(np.asarray(gx_r), np.asarray(gx_f), rtol=2e-4, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(ge_r), np.asarray(ge_f), rtol=2e-4, atol=1e-6)

@@ -173,3 +173,31 @@ def test_1f1b_activation_memory_bounded_by_stages(pipe2_mesh):
     assert t16 / t4 < 2.0, (t4, t16)
     assert g16 / g4 > 1.5, (g4, g16)
     assert t16 < g16
+
+
+def test_1f1b_uneven_ignore_labels_matches_plain_ad(pipe2_mesh):
+    """Microbatches with very different valid-token counts (-100 padding) must
+    still reproduce the global token-mean loss/grads, not a mean-of-means."""
+    cfg = _cfg()
+    model = CausalLM(cfg)
+    params, _ = split_params_axes(model.init(jax.random.PRNGKey(0)))
+    rng = np.random.RandomState(9)
+    ids = rng.randint(0, 128, (8, 16))
+    labels = rng.randint(0, 128, (8, 16))
+    labels[2:, :] = -100          # microbatches 1..3 almost empty
+    labels[2:, 0] = 5
+    batch = {"input_ids": jnp.asarray(ids, jnp.int32),
+             "labels": jnp.asarray(labels, jnp.int32)}
+
+    ref_loss, ref_grads = jax.value_and_grad(
+        lambda p: model.loss(p, batch))(params)
+    pipe_model = CausalLM(dataclasses.replace(cfg, mesh=pipe2_mesh))
+    step = build_1f1b_train_step(pipe_model, pipe2_mesh, n_microbatches=4)
+    with pipe2_mesh:
+        loss, grads = jax.jit(step)(params, batch, jnp.asarray(1.0, jnp.float32), None)
+
+    np.testing.assert_allclose(float(ref_loss), float(loss), rtol=2e-5)
+    for a, b_ in zip(jax.tree_util.tree_leaves(ref_grads),
+                     jax.tree_util.tree_leaves(grads)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=5e-4, atol=1e-5)

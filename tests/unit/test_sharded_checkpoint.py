@@ -1,0 +1,136 @@
+"""Sharded checkpoint: per-shard save, universal reshape-on-load, consolidation.
+
+Reference capability: ``deepspeed/checkpoint/universal_checkpoint.py`` +
+``reshape_meg_2d.py`` — checkpoints survive dp/tp/pp resizes; ``zero_to_fp32``
+consolidation; no full-model gather on save.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import deepspeed_tpu
+from deepspeed_tpu.checkpoint.engine import NpzCheckpointEngine
+from deepspeed_tpu.checkpoint.sharded import ShardedCheckpointEngine, consolidate
+from deepspeed_tpu.config import MeshConfig
+from deepspeed_tpu.models import get_model
+from deepspeed_tpu.parallel import build_mesh
+
+
+def _tree_equal(a, b):
+    for x, y in zip(jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def _mk_state(mesh, spec):
+    x = jnp.arange(64 * 48, dtype=jnp.float32).reshape(64, 48)
+    return {"w": jax.device_put(x, NamedSharding(mesh, spec)),
+            "scalar": jnp.asarray(3, jnp.int32)}
+
+
+def test_save_load_same_sharding(tmp_path, devices8):
+    mesh = build_mesh(MeshConfig(data=8), devices=devices8)
+    state = _mk_state(mesh, P("data", None))
+    eng = ShardedCheckpointEngine()
+    eng.save(state, str(tmp_path / "t"), meta={"step": 7})
+    out, meta = eng.load(str(tmp_path / "t"), template=state,
+                         shardings={"w": NamedSharding(mesh, P("data", None)),
+                                    "scalar": NamedSharding(mesh, P())})
+    assert meta["step"] == 7
+    _tree_equal(state, out)
+
+
+@pytest.mark.parametrize("src,dst", [
+    (P("data", None), P(None, "data")),
+    (P("data", "model"), P("model", None)),
+    (P(), P("data", "model")),
+])
+def test_reshape_across_specs(tmp_path, devices8, src, dst):
+    """Save under one layout, load under another — the universal reshape."""
+    mesh = build_mesh(MeshConfig(data=4, model=2), devices=devices8)
+    state = _mk_state(mesh, src)
+    eng = ShardedCheckpointEngine()
+    eng.save(state, str(tmp_path / "t"))
+    out, _ = eng.load(str(tmp_path / "t"), template=state,
+                      shardings={"w": NamedSharding(mesh, dst),
+                                 "scalar": NamedSharding(mesh, P())})
+    _tree_equal(state, out)
+    assert out["w"].sharding.spec == dst
+
+
+def test_no_replica_duplication(tmp_path, devices8):
+    """Replicated leaves are written once, not once per device."""
+    mesh = build_mesh(MeshConfig(data=8), devices=devices8)
+    state = {"w": jax.device_put(jnp.ones((16, 16)), NamedSharding(mesh, P()))}
+    ShardedCheckpointEngine().save(state, str(tmp_path / "t"))
+    blobs = np.load(str(tmp_path / "t" / "shards-0.npz"))
+    assert len(blobs.files) == 1
+
+
+def test_legacy_npz_fallback(tmp_path, devices8):
+    mesh = build_mesh(MeshConfig(data=8), devices=devices8)
+    state = _mk_state(mesh, P("data", None))
+    NpzCheckpointEngine().save(state, str(tmp_path / "t"))
+    out, _ = ShardedCheckpointEngine().load(
+        str(tmp_path / "t"), template=state,
+        shardings={"w": NamedSharding(mesh, P("data", None)),
+                   "scalar": NamedSharding(mesh, P())})
+    _tree_equal(state, out)
+
+
+def test_consolidate(tmp_path, devices8):
+    mesh = build_mesh(MeshConfig(data=4, model=2), devices=devices8)
+    state = _mk_state(mesh, P("data", "model"))
+    ShardedCheckpointEngine().save(state, str(tmp_path / "t"))
+    out_dir = consolidate(str(tmp_path / "t"))
+    arrays = np.load(os.path.join(out_dir, "arrays.npz"))
+    np.testing.assert_array_equal(arrays["w"], np.asarray(state["w"]))
+
+
+def test_incomplete_checkpoint_raises(tmp_path, devices8):
+    mesh = build_mesh(MeshConfig(data=8), devices=devices8)
+    state = _mk_state(mesh, P("data", None))
+    eng = ShardedCheckpointEngine()
+    eng.save(state, str(tmp_path / "t"))
+    # corrupt: claim a piece exists but drop it from the blob file
+    pieces = json.load(open(tmp_path / "t" / "pieces-0.json"))
+    pieces["w"] = pieces["w"][:1]  # forget the rest of the leaf
+    json.dump(pieces, open(tmp_path / "t" / "pieces-0.json", "w"))
+    with pytest.raises(ValueError, match="do not cover"):
+        eng.load(str(tmp_path / "t"), template=state,
+                 shardings={"w": NamedSharding(mesh, P()),
+                            "scalar": NamedSharding(mesh, P())})
+
+
+def test_engine_roundtrip_across_mesh_change(tmp_path, devices8):
+    """Train on dp=8 ZeRO-3, save; rebuild on dp=2 x tp=4, load; same loss —
+    the reference needs universal-checkpoint reshape tooling for this."""
+    rngnp = np.random.RandomState(0)
+    batch = {"input_ids": rngnp.randint(0, 1024, (8, 32)).astype(np.int32)}
+
+    def mk(meshcfg, zero):
+        model = get_model("llama", "tiny", compute_dtype=jnp.float32)
+        eng, _, _, _ = deepspeed_tpu.initialize(model=model, config={
+            "train_batch_size": 8,
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+            "zero_optimization": {"stage": zero}, "mesh": meshcfg,
+            "steps_per_print": 10 ** 9})
+        return eng
+
+    e1 = mk({"data": 8}, 3)
+    loss = e1.forward(batch)
+    e1.backward(loss)
+    e1.step()
+    e1.save_checkpoint(str(tmp_path), tag="t")
+
+    e2 = mk({"data": 2, "model": 4}, 1)
+    e2.load_checkpoint(str(tmp_path), tag="t")
+    l1 = float(e1.eval_batch(batch))
+    l2 = float(e2.eval_batch(batch))
+    assert abs(l1 - l2) < 1e-4, (l1, l2)
