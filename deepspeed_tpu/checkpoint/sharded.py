@@ -91,6 +91,15 @@ class ShardedCheckpointEngine(CheckpointEngine):
             with open(os.path.join(path, "meta.json"), "w") as f:
                 json.dump({"meta": meta or {}, "manifest": manifest,
                            "layout": "sharded"}, f, indent=1)
+
+    def _point_latest(self, path):
+        """Repoint 'latest' — only after EVERY process's shards are durable
+        (the barrier), or a preempted host leaves 'latest' naming a checkpoint
+        whose pieces don't cover the leaves and clobbers the last good one."""
+        from .. import comm as dist
+
+        dist.barrier()
+        if jax.process_index() == 0:
             parent = os.path.dirname(path)
             with open(os.path.join(parent, "latest"), "w") as f:
                 f.write(os.path.basename(path))
@@ -98,6 +107,14 @@ class ShardedCheckpointEngine(CheckpointEngine):
     def save(self, state_tree, path, meta=None):
         blobs, pieces, manifest = self._prepare(state_tree)
         self._write(path, blobs, pieces, manifest, meta)
+        self._last_path = path
+
+    def commit(self, tag):
+        path = getattr(self, "_last_path", None)
+        if path is not None:
+            self._point_latest(path)
+            self._last_path = None
+        return True
 
     # ------------------------------------------------------------------
     def load(self, path, template=None, shardings=None):
@@ -190,28 +207,46 @@ class ShardedCheckpointEngine(CheckpointEngine):
 
 
 class AsyncShardedCheckpointEngine(ShardedCheckpointEngine):
-    """Sharded save with the file IO in a background thread; ``commit`` joins
-    (the Nebula-engine durability contract). The device->host shard pull still
-    happens synchronously so donated buffers are safe."""
+    """Sharded save with the file IO in a background thread; ``commit`` joins,
+    re-raises any background failure, THEN repoints 'latest' (the
+    Nebula-engine durability contract). The device->host shard pull and all
+    collectives stay on the caller thread — donated buffers and multihost sync
+    are both thread-unsafe."""
 
     def __init__(self):
         self._thread = None
+        self._error = None
+
+    def _join(self):
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+        if self._error is not None:
+            err, self._error = self._error, None
+            raise RuntimeError("async checkpoint write failed") from err
 
     def save(self, state_tree, path, meta=None):
         import threading
 
         blobs, pieces, manifest = self._prepare(state_tree)
-        if self._thread is not None:
-            self._thread.join()
-        self._thread = threading.Thread(
-            target=self._write, args=(path, blobs, pieces, manifest, meta),
-            daemon=True)
+        self._join()  # serialize with (and surface errors from) prior save
+
+        def write():
+            try:
+                self._write(path, blobs, pieces, manifest, meta)
+            except BaseException as e:  # surfaced at commit/next save
+                self._error = e
+
+        self._thread = threading.Thread(target=write, daemon=True)
         self._thread.start()
+        self._last_path = path
 
     def commit(self, tag):
-        if self._thread is not None:
-            self._thread.join()
-            self._thread = None
+        self._join()
+        path = getattr(self, "_last_path", None)
+        if path is not None:
+            self._point_latest(path)
+            self._last_path = None
         return True
 
 

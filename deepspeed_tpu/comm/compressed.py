@@ -1,0 +1,105 @@
+"""Compressed (1-bit / int8) all-reduce with error feedback.
+
+TPU-native equivalent of the reference's compressed collectives
+(``runtime/comm/nccl.py:54`` ``compressed_allreduce``: cupy sign-packing, a
+two-phase alltoall+allgather exchange, worker- and server-side error
+compensation — the engine of 1-bit Adam/LAMB, ``runtime/fp16/onebit/``).
+
+The algorithm is the same two-phase scheme, expressed as XLA collectives
+inside ``shard_map`` (EQuARX-style — quantize before the wire, not after):
+
+  phase 1 (reduce-scatter, compressed): each device splits its tensor into
+    world chunks, quantizes ``chunk + worker_error``, ``all_to_all``s the
+    quantized payloads + scales, dequantizes and reduces its own chunk;
+  phase 2 (all-gather, compressed): the reduced chunk is quantized again
+    (``server_error`` feedback), ``all_gather``ed, dequantized everywhere.
+
+Error feedback keeps both quantization residuals locally so the *expected*
+update is unbiased — the property 1-bit Adam's convergence proof needs.
+
+Wire cost per device: 2 x N/world quantized payloads (1 or 8 bits) instead of
+2 x N x 32 bits for a ring allreduce — the same 16-32x compression the
+reference claims for its NCCL backend.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def _quantize(x, bits):
+    """x [..., n] -> (payload int8, scale f32). 1-bit: sign * mean(|x|);
+    8-bit: symmetric linear to int8."""
+    if bits == 1:
+        scale = jnp.mean(jnp.abs(x), axis=-1, keepdims=True)
+        q = jnp.where(x >= 0, jnp.int8(1), jnp.int8(-1))
+        return q, scale
+    scale = jnp.max(jnp.abs(x), axis=-1, keepdims=True) / 127.0
+    safe = jnp.maximum(scale, 1e-30)
+    q = jnp.clip(jnp.round(x / safe), -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def _dequantize(q, scale, bits):
+    return q.astype(jnp.float32) * scale
+
+
+def compressed_allreduce_local(x, worker_error, server_error, axis_name,
+                               bits=1):
+    """Inside shard_map: compressed mean-allreduce of per-device ``x`` [n].
+
+    Returns (mean_reduced [n], new_worker_error, new_server_error). n must be
+    divisible by the axis size.
+    """
+    world = jax.lax.axis_size(axis_name)
+    n = x.shape[-1]
+    if n % world:
+        raise ValueError(f"compressed allreduce length {n} not divisible by "
+                         f"world {world}")
+    chunk = n // world
+
+    # ---- phase 1: compressed reduce-scatter via all_to_all
+    compensated = x + worker_error
+    chunks = compensated.reshape(world, chunk)
+    q, scale = _quantize(chunks, bits)  # [world, chunk], [world, 1]
+    new_worker_error = (compensated
+                        - _dequantize(q, scale, bits).reshape(-1))
+    q_recv = jax.lax.all_to_all(q, axis_name, split_axis=0, concat_axis=0,
+                                tiled=False)
+    s_recv = jax.lax.all_to_all(scale, axis_name, split_axis=0, concat_axis=0,
+                                tiled=False)
+    mine = jnp.sum(_dequantize(q_recv, s_recv, bits), axis=0) / world  # [chunk]
+
+    # ---- phase 2: compressed all-gather of the reduced chunk
+    compensated2 = mine + server_error
+    q2, scale2 = _quantize(compensated2[None, :], bits)
+    new_server_error = compensated2 - _dequantize(q2, scale2, bits)[0]
+    q_all = jax.lax.all_gather(q2[0], axis_name)          # [world, chunk]
+    s_all = jax.lax.all_gather(scale2[0], axis_name)      # [world, 1]
+    out = _dequantize(q_all, s_all, bits).reshape(-1)
+    return out, new_worker_error, new_server_error
+
+
+def make_compressed_allreduce(mesh, axis_name, bits=1):
+    """Eager-friendly wrapper: pytree-of-per-device-values -> compressed mean
+    over ``axis_name``; carries error state pytrees. Built on shard_map so the
+    all_to_all/all_gather appear in the compiled HLO."""
+    from jax.sharding import PartitionSpec as P
+
+    def one(x, we, se):
+        return compressed_allreduce_local(x, we, se, axis_name, bits=bits)
+
+    sm = jax.shard_map(
+        one, mesh=mesh,
+        in_specs=(P(axis_name), P(axis_name), P(axis_name)),
+        out_specs=(P(axis_name), P(axis_name), P(axis_name)),
+        axis_names={axis_name}, check_vma=False)
+    return sm
+
+
+def init_error_state(local_len, world):
+    """Zero worker/server error buffers for one flattened gradient of
+    per-device length ``local_len`` (server error covers one chunk)."""
+    return (jnp.zeros((local_len,), jnp.float32),
+            jnp.zeros((local_len // world,), jnp.float32))

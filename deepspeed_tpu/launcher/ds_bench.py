@@ -28,13 +28,15 @@ def run_sweep(op="all_reduce", min_mb=1, max_mb=64, trials=5, dtype="float32"):
     dt = {"float32": jnp.float32, "bfloat16": jnp.bfloat16}[dtype]
     itemsize = np.dtype(np.float32).itemsize if dtype == "float32" else 2
 
+    # size_mb is the PER-DEVICE payload; vol() = bytes each device moves on a
+    # ring (NCCL-tests-style busbw accounting, so figures compare 1:1)
     def make_fn(op):
         if op == "all_reduce":
             f = lambda x: jax.lax.psum(x, "data")
-            vol = lambda b: 2 * b * (n - 1) / n  # ring allreduce bytes/device
+            vol = lambda b: 2 * b * (n - 1) / n
         elif op == "all_gather":
             f = lambda x: jax.lax.all_gather(x, "data")
-            vol = lambda b: b * (n - 1) / n
+            vol = lambda b: b * (n - 1)  # receives everyone else's payload
         elif op == "reduce_scatter":
             f = lambda x: jax.lax.psum_scatter(x, "data", tiled=True)
             vol = lambda b: b * (n - 1) / n
@@ -54,8 +56,9 @@ def run_sweep(op="all_reduce", min_mb=1, max_mb=64, trials=5, dtype="float32"):
     results = []
     mb = min_mb
     while mb <= max_mb:
-        elems = mb * 1024 * 1024 // itemsize
-        elems = max(elems - elems % n, n)
+        per_dev = max(mb * 1024 * 1024 // itemsize, 1)
+        per_dev = per_dev - per_dev % n if per_dev >= n else n
+        elems = per_dev * n  # global length: each device holds size_mb
         x = jax.device_put(
             jnp.ones((elems,), dt),
             NamedSharding(mesh, P("data")))

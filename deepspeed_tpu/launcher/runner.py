@@ -127,8 +127,7 @@ class SshRunner:
 
     def run(self, cmd, extra_env=None):
         procs = [subprocess.Popen(c) for c in self.build_cmds(cmd, extra_env)]
-        rcs = [p.wait() for p in procs]
-        return max(rcs) if rcs else 0
+        return _wait_kill_on_failure(procs)
 
 
 def launch_local_procs(cmd, num_procs, env, devices_per_proc=0,
@@ -158,8 +157,40 @@ def launch_local_procs(cmd, num_procs, env, devices_per_proc=0,
                                  f" --xla_force_host_platform_device_count="
                                  f"{devices_per_proc}").strip()
         procs.append(subprocess.Popen(cmd, env=wenv))
-    rcs = [p.wait() for p in procs]
-    return max(rcs) if rcs else 0
+    return _wait_kill_on_failure(procs)
+
+
+def _wait_kill_on_failure(procs, poll_s=0.5):
+    """Wait for all workers, but terminate the rest as soon as one fails —
+    a dead rank leaves its peers blocked in a collective forever (XLA has no
+    collective timeout; the reference's launch.py kills siblings the same
+    way, ``launcher/launch.py:119``)."""
+    import time
+
+    try:
+        while True:
+            rcs = [p.poll() for p in procs]
+            if all(rc is not None for rc in rcs):
+                return max(rcs) if rcs else 0
+            if any(rc not in (None, 0) for rc in rcs):
+                bad = next(i for i, rc in enumerate(rcs) if rc not in (None, 0))
+                logger.error(
+                    f"worker {bad} exited rc={rcs[bad]}; terminating the rest")
+                for p in procs:
+                    if p.poll() is None:
+                        p.terminate()
+                deadline = time.time() + 10
+                for p in procs:
+                    while p.poll() is None and time.time() < deadline:
+                        time.sleep(0.1)
+                    if p.poll() is None:
+                        p.kill()
+                return rcs[bad]
+            time.sleep(poll_s)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
 
 
 def main(args=None):

@@ -1,0 +1,115 @@
+"""1-bit optimizers: OnebitAdam / OnebitLamb.
+
+Reference: ``deepspeed/runtime/fp16/onebit/{adam,lamb}.py`` — Adam whose
+gradient all-reduce is sign-compressed after a warmup phase, with error
+compensation (the variance term is FROZEN at the end of warmup, which is what
+makes sign-compression of the *momentum* communication sound — see the 1-bit
+Adam paper's argument mirrored in ``onebit/adam.py:308``'s staged logic).
+
+TPU-native shape: the compressed exchange is a ``shard_map`` collective
+(``comm/compressed.py``), so these classes hold only the *local* update rule +
+staging; the engine (or the test harness) wires the compressed allreduce of
+momentum between ``local_momentum`` and ``apply``:
+
+  warmup (step < freeze_step):  exact allreduce of grads, normal Adam, track v
+  compressed (step >= freeze):  m = beta1 m + (1-beta1) g_local
+                                m <- compressed_allreduce(m)   (1-bit + error)
+                                p -= lr * m / (sqrt(v_frozen) + eps)
+"""
+
+import jax
+import jax.numpy as jnp
+
+from .optimizers import Adam, TPUOptimizer, _tree_zeros_like, _mask_like
+
+
+class OnebitAdam(TPUOptimizer):
+    """Staged Adam for compressed-momentum data parallelism."""
+
+    def __init__(self, lr=1e-3, betas=(0.9, 0.999), eps=1e-8,
+                 weight_decay=0.0, freeze_step=100):
+        super().__init__(lr=lr, weight_decay=weight_decay)
+        self.b1, self.b2 = betas
+        self.eps = eps
+        self.freeze_step = freeze_step
+        self._adam = Adam(lr=lr, betas=betas, eps=eps,
+                          weight_decay=weight_decay)
+
+    def init(self, params):
+        return {
+            "exp_avg": _tree_zeros_like(params),
+            "exp_avg_sq": _tree_zeros_like(params),
+            "step": jnp.zeros((), jnp.int32),
+        }
+
+    def in_warmup(self, state):
+        return state["step"] < self.freeze_step
+
+    def update(self, grads, state, params, lr=None, wd_mask=None):
+        """Warmup path == exact Adam (grads already mean-reduced)."""
+        return self._adam.update(grads, state, params, lr=lr, wd_mask=wd_mask)
+
+    # -- compressed stage (engine calls these around the compressed collective)
+    def local_momentum(self, grads, state):
+        """Update m with the LOCAL gradient; returns the momentum tree to be
+        compressed-allreduced (reference onebit/adam.py: momentum is what goes
+        on the wire after freeze)."""
+        m = jax.tree_util.tree_map(
+            lambda mm, g: self.b1 * mm + (1 - self.b1) * g,
+            state["exp_avg"], grads)
+        return m
+
+    def apply_compressed(self, m_reduced, state, params, lr=None, wd_mask=None):
+        """Apply the update using the reduced momentum and FROZEN variance.
+
+        Bias correction must match the warmup phase: v was frozen at
+        ``freeze_step``, so its correction uses the freeze-time horizon, not
+        the current step — otherwise the denominator is ~(1-b2^freeze) too
+        small and the compressed stage diverges."""
+        lr = self.lr if lr is None else lr
+        step = state["step"] + 1
+        mask = _mask_like(wd_mask, params)
+        c1 = 1.0 - self.b1 ** step.astype(jnp.float32)
+        c2 = 1.0 - self.b2 ** jnp.minimum(
+            step, self.freeze_step).astype(jnp.float32)
+
+        def leaf(p, m, v, decay):
+            denom = jnp.sqrt(v / c2) + self.eps
+            upd = (m / c1) / denom
+            if self.weight_decay:
+                upd = upd + jnp.where(decay, self.weight_decay * p, 0.0)
+            return p - lr * upd
+
+        new_params = jax.tree_util.tree_map(
+            leaf, params, m_reduced, state["exp_avg_sq"], mask)
+        new_state = {"exp_avg": m_reduced, "exp_avg_sq": state["exp_avg_sq"],
+                     "step": step}
+        return new_params, new_state
+
+
+class OnebitLamb(OnebitAdam):
+    """LAMB layerwise trust ratio on top of the compressed-momentum update
+    (reference ``onebit/lamb.py``)."""
+
+    def apply_compressed(self, m_reduced, state, params, lr=None, wd_mask=None):
+        lr = self.lr if lr is None else lr
+        step = state["step"] + 1
+        mask = _mask_like(wd_mask, params)
+        c1 = 1.0 - self.b1 ** step.astype(jnp.float32)
+        c2 = 1.0 - self.b2 ** jnp.minimum(
+            step, self.freeze_step).astype(jnp.float32)
+
+        def leaf(p, m, v, decay):
+            upd = (m / c1) / (jnp.sqrt(v / c2) + self.eps)
+            if self.weight_decay:
+                upd = upd + jnp.where(decay, self.weight_decay * p, 0.0)
+            w_norm = jnp.linalg.norm(p.ravel())
+            u_norm = jnp.linalg.norm(upd.ravel())
+            trust = jnp.where((w_norm > 0) & (u_norm > 0),
+                              w_norm / jnp.maximum(u_norm, 1e-30), 1.0)
+            return p - lr * trust * upd
+
+        new_params = jax.tree_util.tree_map(
+            leaf, params, m_reduced, state["exp_avg_sq"], mask)
+        return new_params, {"exp_avg": m_reduced,
+                            "exp_avg_sq": state["exp_avg_sq"], "step": step}

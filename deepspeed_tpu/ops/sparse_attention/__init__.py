@@ -1,0 +1,12 @@
+"""Block-sparse attention (reference ``deepspeed/ops/sparse_attention/``):
+pattern configs + the splash-style Pallas kernel."""
+
+from .sparsity_config import (  # noqa: F401
+    SparsityConfig,
+    DenseSparsityConfig,
+    FixedSparsityConfig,
+    BigBirdSparsityConfig,
+    BSLongformerSparsityConfig,
+    VariableSparsityConfig,
+)
+from ..pallas.block_sparse_attention import BlockSparseAttention  # noqa: F401

@@ -96,3 +96,28 @@ def test_ds_bench_smoke(capsys):
     res = run_sweep(op="all_reduce", min_mb=1, max_mb=2, trials=2)
     assert len(res) == 2
     assert all(r["algbw_gbps"] > 0 for r in res)
+
+
+@pytest.mark.slow
+def test_launcher_kills_peers_when_one_worker_dies(tmp_path):
+    """A crashing rank must not leave its peers hanging in a collective."""
+    import subprocess
+    import sys
+    import time
+
+    script = tmp_path / "crash.py"
+    script.write_text(
+        "import os, sys, time\n"
+        "if os.environ['DS_TPU_PROCESS_ID'] == '1':\n"
+        "    sys.exit(3)\n"
+        "time.sleep(3600)\n")
+    import os as _os
+    repo = _os.path.dirname(_os.path.dirname(_os.path.dirname(
+        _os.path.abspath(__file__))))
+    t0 = time.time()
+    rc = subprocess.call(
+        [sys.executable, "-m", "deepspeed_tpu.launcher.runner",
+         "--num_local_procs", "2", str(script)],
+        env=dict(_os.environ, PYTHONPATH=repo), cwd=repo, timeout=120)
+    assert rc == 3
+    assert time.time() - t0 < 60  # did not wait for the sleeping peer

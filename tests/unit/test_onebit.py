@@ -1,0 +1,133 @@
+"""Compressed (1-bit / int8) collectives + 1-bit optimizers.
+
+Reference test analog: ``tests/onebit/test_nccl_backend.py`` — numerical
+closeness of the compressed allreduce vs the exact one, error-feedback
+correctness, and convergence of OnebitAdam after the freeze step.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from deepspeed_tpu.comm.compressed import (
+    compressed_allreduce_local,
+    make_compressed_allreduce,
+)
+from deepspeed_tpu.ops.onebit import OnebitAdam
+
+
+def _mesh(devices8):
+    return Mesh(np.array(devices8), ("data",))
+
+
+@pytest.mark.parametrize("bits", [1, 8])
+def test_compressed_allreduce_close_to_exact(devices8, bits):
+    mesh = _mesh(devices8)
+    world = 8
+    n_local = 256
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(world * n_local), jnp.float32)
+    we = jnp.zeros_like(x)
+    se = jnp.zeros(world * (n_local // world), jnp.float32)
+
+    sm = make_compressed_allreduce(mesh, "data", bits=bits)
+    out, we2, se2 = sm(x, we, se)
+    # every device ends with the same (approximately exact-mean) vector
+    exact = np.mean(np.asarray(x).reshape(world, n_local), axis=0)
+    got = np.asarray(out).reshape(world, n_local)
+    for r in range(world):
+        np.testing.assert_array_equal(got[r], got[0])
+    # single-shot 1-bit is crude by design (~0.8 rel err on gaussian data);
+    # the error-feedback test below shows it averages out to exact. int8 is
+    # already tight in one shot.
+    tol = 1.0 if bits == 1 else 0.02
+    assert np.abs(got[0] - exact).mean() < tol * np.abs(exact).mean() + 1e-3
+
+
+@pytest.mark.parametrize("bits", [1, 8])
+def test_error_feedback_is_unbiased_over_steps(devices8, bits):
+    """Repeatedly reducing the SAME tensor with error feedback must converge
+    to the exact mean (the compensation property)."""
+    mesh = _mesh(devices8)
+    world, n_local = 8, 64
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.randn(world * n_local), jnp.float32)
+    exact = np.mean(np.asarray(x).reshape(world, n_local), axis=0)
+
+    sm = make_compressed_allreduce(mesh, "data", bits=bits)
+    we = jnp.zeros_like(x)
+    se = jnp.zeros((world * (n_local // world),), jnp.float32)
+    acc = np.zeros_like(exact)
+    steps = 64
+    for _ in range(steps):
+        out, we, se = sm(x, we, se)
+        acc += np.asarray(out).reshape(world, n_local)[0]
+    # time-average of compensated quantized reductions -> exact mean
+    err = np.abs(acc / steps - exact).mean() / (np.abs(exact).mean() + 1e-9)
+    assert err < 0.05, err
+
+
+def test_compressed_allreduce_hlo_has_all_to_all(devices8):
+    mesh = _mesh(devices8)
+    sm = make_compressed_allreduce(mesh, "data", bits=1)
+    x = jnp.zeros((8 * 64,), jnp.float32)
+    we = jnp.zeros_like(x)
+    se = jnp.zeros((64,), jnp.float32)
+    txt = jax.jit(sm).lower(x, we, se).compile().as_text()
+    assert "all-to-all" in txt
+    assert "all-gather" in txt
+
+
+def test_onebit_adam_converges_after_freeze(devices8):
+    """Data-parallel quadratic: warmup with exact reduction, then compressed
+    momentum; the loss must keep decreasing in the compressed stage."""
+    mesh = _mesh(devices8)
+    world = 8
+    dim = 64
+    rng = np.random.RandomState(2)
+    target = jnp.asarray(rng.randn(dim), jnp.float32)
+    # per-device data shards
+    data = jnp.asarray(rng.randn(world * 16, dim), jnp.float32)
+
+    opt = OnebitAdam(lr=0.05, freeze_step=10)
+    params = {"w": jnp.zeros((dim,), jnp.float32)}
+    state = opt.init(params)
+
+    def local_grads(w, shard):
+        # grad of mean || shard @ diag? simple: mean over rows of (w - target)
+        # weighted by per-row data norm, deterministic per shard
+        err = w - target
+        weight = 1.0 + 0.1 * jnp.mean(jnp.abs(shard), axis=(0, 1))
+        return err * weight
+
+    sm = make_compressed_allreduce(mesh, "data", bits=1)
+    we = jnp.zeros((world * dim,), jnp.float32)
+    se = jnp.zeros((dim,), jnp.float32)
+
+    def loss(w):
+        return float(jnp.mean((w - target) ** 2))
+
+    losses = [loss(params["w"])]
+    shards = data.reshape(world, 16, dim)
+    for step in range(40):
+        g_local = np.stack([np.asarray(local_grads(params["w"], shards[r]))
+                            for r in range(world)])
+        if step < opt.freeze_step:
+            g_mean = {"w": jnp.asarray(g_local.mean(0))}
+            params, state = opt.update(g_mean, state, params)
+        else:
+            # compressed momentum path: each device folds ITS local grad
+            m_locals = np.stack([
+                np.asarray(opt.local_momentum(
+                    {"w": jnp.asarray(g_local[r])}, state)["w"])
+                for r in range(world)])
+            m_red, we, se = sm(jnp.asarray(m_locals.reshape(-1)), we, se)
+            m_tree = {"w": jnp.asarray(np.asarray(m_red).reshape(world, dim)[0])}
+            params, state = opt.apply_compressed(m_tree, state, params)
+        losses.append(loss(params["w"]))
+
+    assert losses[10] < losses[0]          # warmup learns
+    assert losses[-1] < 0.5 * losses[10]   # compressed stage keeps learning

@@ -1,0 +1,124 @@
+"""Block-sparse attention: pattern layouts + kernel parity vs dense-masked
+reference (interpret mode).
+
+Reference: deepspeed/ops/sparse_attention/ — Fixed/BigBird/BSLongformer/
+Variable patterns over a block-sparse attention kernel.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.models.layers import dot_product_attention
+from deepspeed_tpu.ops.sparse_attention import (
+    BigBirdSparsityConfig,
+    BlockSparseAttention,
+    BSLongformerSparsityConfig,
+    DenseSparsityConfig,
+    FixedSparsityConfig,
+    VariableSparsityConfig,
+)
+
+BLK = 16
+SEQ = 128
+
+
+def _qkv(b=1, s=SEQ, h=2, d=16, seed=0):
+    rng = np.random.RandomState(seed)
+    mk = lambda: jnp.asarray(rng.randn(b, s, h, d), jnp.float32)
+    return mk(), mk(), mk()
+
+
+def _dense_mask(layout, s_q, s_kv, blk, causal):
+    """Token-level mask equivalent to (block layout AND causal)."""
+    m = np.kron(layout, np.ones((blk, blk), bool))
+    if causal:
+        off = s_kv - s_q
+        qi = np.arange(s_q)[:, None]
+        ki = np.arange(s_kv)[None, :]
+        m &= ki <= qi + off
+    return jnp.asarray(m[None, None])
+
+
+CONFIGS = [
+    DenseSparsityConfig(block=BLK),
+    FixedSparsityConfig(block=BLK, num_local_blocks=2, num_global_blocks=1),
+    BigBirdSparsityConfig(block=BLK, num_sliding_window_blocks=3,
+                          num_global_blocks=1, num_random_blocks=1),
+    BSLongformerSparsityConfig(block=BLK, num_sliding_window_blocks=3,
+                               global_block_indices=(0,)),
+    VariableSparsityConfig(block=BLK, local_window_blocks=(2, 3),
+                           num_global_blocks=1),
+]
+
+
+@pytest.mark.parametrize("cfg", CONFIGS, ids=lambda c: type(c).__name__)
+@pytest.mark.parametrize("causal", [True, False])
+def test_sparse_fwd_matches_masked_dense(cfg, causal):
+    q, k, v = _qkv()
+    attn = BlockSparseAttention(cfg, SEQ, causal=causal, interpret=True)
+    mask = _dense_mask(attn.layout, SEQ, SEQ, BLK, causal)
+    ref = dot_product_attention(q, k, v, mask=mask)
+    out = attn(q, k, v)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(out),
+                               rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("cfg", [CONFIGS[1], CONFIGS[2]],
+                         ids=lambda c: type(c).__name__)
+def test_sparse_bwd_matches_masked_dense(cfg):
+    q, k, v = _qkv(seed=5)
+    attn = BlockSparseAttention(cfg, SEQ, causal=True, interpret=True)
+    mask = _dense_mask(attn.layout, SEQ, SEQ, BLK, True)
+
+    g_ref = jax.grad(
+        lambda q, k, v: jnp.sum(dot_product_attention(q, k, v, mask=mask) ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    g_sp = jax.grad(
+        lambda q, k, v: jnp.sum(attn(q, k, v) ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(g_ref, g_sp):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=5e-4, atol=5e-4)
+
+
+def test_sparsity_actually_sparse():
+    attn = BlockSparseAttention(
+        FixedSparsityConfig(block=BLK, num_local_blocks=2), SEQ, causal=True,
+        interpret=True)
+    assert attn.density < 0.7  # causal fixed pattern prunes most blocks
+    # active-list preprocessing matches the layout
+    assert attn._fwd_cnt.sum() == attn.layout.sum()
+
+
+def test_empty_query_row_rejected():
+    class NoDiag(DenseSparsityConfig):
+        def make_layout(self, nq, nkv):
+            return np.zeros((nq, nkv), bool)
+
+        def layout_for(self, sq, skv, causal=True):
+            # bypass the diagonal forcing to simulate a broken pattern
+            import numpy as np
+
+            layout = self.make_layout(sq // self.block, skv // self.block)
+            if not layout.any(axis=1).all():
+                raise ValueError("sparsity layout leaves a query block with "
+                                 "no attendable kv block")
+            return layout
+
+    with pytest.raises(ValueError, match="no attendable"):
+        BlockSparseAttention(NoDiag(block=BLK), SEQ, interpret=True)
+
+
+def test_longformer_longer_than_dense_window():
+    """Long-context capability smoke: 1k tokens with a 3-block window stays
+    ~O(window) blocks per row, not O(seq)."""
+    cfg = BSLongformerSparsityConfig(block=BLK, num_sliding_window_blocks=3)
+    attn = BlockSparseAttention(cfg, 1024, causal=True, interpret=True)
+    nq = 1024 // BLK
+    assert attn._max_a <= 5  # window + global + diagonal
+    q, k, v = _qkv(s=1024, h=1, d=8, seed=7)
+    out = attn(q, k, v)
+    assert np.all(np.isfinite(np.asarray(out)))
