@@ -38,7 +38,17 @@ def initialize(args=None, model=None, optimizer=None, model_parameters=None,
     if dist_init_required or dist_init_required is None:
         init_distributed()
 
-    engine = DeepSpeedEngine(
+    # engine class choice (reference deepspeed/__init__.py:141-181): the hybrid
+    # (RLHF) engine when configured, else the plain training engine (pipeline
+    # scheduling lives inside the engine here, not in a subclass).
+    engine_cls = DeepSpeedEngine
+    config = load_config(config)  # parse once; the engine accepts the instance
+    if config.hybrid_engine.enabled:
+        from .runtime.hybrid_engine import DeepSpeedHybridEngine
+
+        engine_cls = DeepSpeedHybridEngine
+
+    engine = engine_cls(
         model=model,
         optimizer=optimizer,
         model_parameters=model_parameters,

@@ -158,6 +158,14 @@ class MeshConfig(ConfigModel):
     expert: int = 1
 
 
+class HybridEngineConfig(ConfigModel):
+    """RLHF hybrid engine (reference ``runtime/hybrid_engine.py:32`` +
+    ``deepspeed/__init__.py:143`` selection)."""
+
+    enabled: bool = False
+    max_out_tokens: int = 512
+
+
 class CheckpointConfig(ConfigModel):
     """Checkpoint engine selection (reference ``runtime/checkpoint_engine/`` +
     ``deepspeed/checkpoint/`` universal layout). "sharded" writes per-process
@@ -249,6 +257,7 @@ class DeepSpeedConfig(ConfigModel):
     mesh: MeshConfig = MeshConfig
     pipeline: PipelineConfig = PipelineConfig
     checkpoint: CheckpointConfig = CheckpointConfig
+    hybrid_engine: HybridEngineConfig = HybridEngineConfig
     tensorboard: TensorBoardConfig = TensorBoardConfig
     wandb: WandbConfig = WandbConfig
     csv_monitor: CSVConfig = CSVConfig

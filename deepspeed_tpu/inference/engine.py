@@ -98,13 +98,15 @@ class InferenceEngine:
         resharding is just placement per the inference specs."""
         import os
 
-        from ..checkpoint.engine import NpzCheckpointEngine
+        from ..checkpoint.sharded import ShardedCheckpointEngine
 
         if tag is None:
             latest = os.path.join(load_dir, "latest")
             tag = open(latest).read().strip() if os.path.exists(latest) else None
         path = os.path.join(load_dir, tag) if tag else load_dir
-        state, _ = NpzCheckpointEngine().load(
+        # sharded engine reads both layouts (per-shard pieces OR legacy npz)
+        # and reshapes to the serving TP specs on load
+        state, _ = ShardedCheckpointEngine().load(
             path, template={"params": self.params},
             shardings={"params": self.param_shardings})
         self.params = jax.tree_util.tree_map(
@@ -153,35 +155,28 @@ class InferenceEngine:
             self.mesh, P(None, batch_axis, None, kv_axis, None))
         token_sharding = NamedSharding(self.mesh, P(batch_axis))
 
-        key = (b, prompt_len, max_new_tokens, bool(greedy), float(temperature),
-               int(top_k))
+        # temperature is a RUNTIME argument (a sampling-knob change must not
+        # recompile — the CUDA reference takes it per call too); greedy/top_k
+        # shape the program and stay in the key. A concrete temperature of 0.0
+        # IS greedy (and must stay exact argmax, not logits/1e-6 + noise).
+        if isinstance(temperature, (int, float)) and temperature == 0.0:
+            greedy = True
+        key = (b, prompt_len, max_new_tokens, bool(greedy), int(top_k))
         if key not in self._prefill_cache:
+            from ..models.decoding import decode_tokens, prefill_and_first_token
+
             model = self.module
 
-            def prefill(params, ids, rng):
-                cache = init_cache(model.config, b, max_len, self.dtype)
-                logits, cache = forward_with_cache(
-                    model, params, ids, cache, 0, max_len)
-                tok = sample_token(logits[:, prompt_len - 1], rng,
-                                   temperature=temperature, top_k=top_k,
-                                   greedy=greedy)
-                return tok, cache
+            def prefill(params, ids, rng, temperature):
+                return prefill_and_first_token(
+                    model, params, ids, rng, temperature, max_len=max_len,
+                    greedy=greedy, top_k=top_k, dtype=self.dtype)
 
-            def decode(params, cache, tok, rng):
-                def step(carry, i):
-                    cache, tok, rng = carry
-                    rng, step_rng = jax.random.split(rng)
-                    logits, cache = forward_with_cache(
-                        model, params, tok[:, None], cache,
-                        prompt_len + i, max_len)
-                    nxt = sample_token(logits[:, 0], step_rng,
-                                       temperature=temperature, top_k=top_k,
-                                       greedy=greedy)
-                    return (cache, nxt, rng), nxt
-
-                (cache, _, _), toks = jax.lax.scan(
-                    step, (cache, tok, rng), jnp.arange(max_new_tokens - 1))
-                return toks  # [steps, b]
+            def decode(params, cache, tok, rng, temperature):
+                return decode_tokens(
+                    model, params, cache, tok, rng, temperature,
+                    prompt_len=prompt_len, max_len=max_len,
+                    steps=max_new_tokens - 1, greedy=greedy, top_k=top_k)
 
             with self.mesh:
                 self._prefill_cache[key] = (
@@ -193,10 +188,11 @@ class InferenceEngine:
 
         prefill_fn, decode_fn = self._prefill_cache[key]
         rng, r1, r2 = jax.random.split(rng, 3)
-        first, cache = prefill_fn(self.params, input_ids, r1)
+        temp = jnp.asarray(temperature, jnp.float32)
+        first, cache = prefill_fn(self.params, input_ids, r1, temp)
         out = [input_ids, first[:, None]]
         if max_new_tokens > 1:
-            toks = decode_fn(self.params, cache, first, r2)  # [steps, b]
+            toks = decode_fn(self.params, cache, first, r2, temp)  # [steps, b]
             out.append(jnp.transpose(toks))
         result = jnp.concatenate(out, axis=1)
         if eos_token_id is not None:

@@ -158,12 +158,50 @@ def forward_with_cache(model, params, input_ids, cache, pos, kv_len):
 
 
 def sample_token(logits, rng, *, temperature=1.0, top_k=0, greedy=False):
-    """logits: [b, vocab] -> [b] int32."""
+    """logits: [b, vocab] -> [b] int32.
+
+    ``greedy`` and ``top_k`` are static (shape the program); ``temperature``
+    may be a TRACED scalar so serving/rollout loops can change it without
+    recompiling (the reference recompiles nothing — CUDA kernels take it as a
+    runtime arg; so do we)."""
     logits = logits.astype(jnp.float32)
-    if greedy or temperature == 0.0:
+    if greedy:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    logits = logits / jnp.maximum(temperature, 1e-6)
+    if isinstance(temperature, (int, float)) and temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits / jnp.maximum(jnp.asarray(temperature, jnp.float32), 1e-6)
     if top_k and top_k > 0:
         kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
         logits = jnp.where(logits < kth, -1e30, logits)
     return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
+
+
+def prefill_and_first_token(model, params, ids, rng, temperature, *, max_len,
+                            greedy, top_k, dtype):
+    """Prefill the KV cache with the prompt and sample the first new token.
+    Shared by the serving engine and the hybrid (RLHF) engine — one
+    implementation of the rollout math, two jit wrappers."""
+    b, prompt_len = ids.shape
+    cache = init_cache(model.config, b, max_len, dtype)
+    logits, cache = forward_with_cache(model, params, ids, cache, 0, max_len)
+    tok = sample_token(logits[:, prompt_len - 1], rng, temperature=temperature,
+                       top_k=top_k, greedy=greedy)
+    return tok, cache
+
+
+def decode_tokens(model, params, cache, tok, rng, temperature, *, prompt_len,
+                  max_len, steps, greedy, top_k):
+    """Scan ``steps`` single-token decode iterations; returns [steps, b]."""
+
+    def step(carry, i):
+        cache, tok, rng = carry
+        rng, r = jax.random.split(rng)
+        logits, cache = forward_with_cache(
+            model, params, tok[:, None], cache, prompt_len + i, max_len)
+        nxt = sample_token(logits[:, 0], r, temperature=temperature,
+                           top_k=top_k, greedy=greedy)
+        return (cache, nxt, rng), nxt
+
+    (cache, _, _), toks = jax.lax.scan(step, (cache, tok, rng),
+                                       jnp.arange(steps))
+    return toks

@@ -1,0 +1,58 @@
+"""LoRA adapters as pytree transforms.
+
+Reference context: the hybrid (RLHF) engine fuses LoRA weights into the base
+matrices before generation and unfuses after (``runtime/hybrid_engine.py:
+120-146`` _fuse_lora/_unfuse_lora) so the inference path runs at full-matrix
+speed. Here adapters are a parallel pytree and fuse/unfuse are pure functions
+— no module surgery, and exact unfuse is trivial because fuse is ``W + s·A@B``
+in fp32 masters.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def _is_target(path, targets):
+    joined = "/".join(str(getattr(p, "key", p)) for p in path)
+    return any(t in joined for t in targets)
+
+
+def lora_init(rng, params, rank=8, targets=("attn/q", "attn/v"), stddev=0.02):
+    """Build {path: {"a": [in, r], "b": [r, out]}} for 2D+ kernels whose path
+    matches ``targets``. b starts at zero so the adapter is a no-op initially
+    (the standard LoRA init)."""
+    flat, _ = jax.tree_util.tree_flatten_with_path(params)
+    adapters = {}
+    key = rng
+    for path, leaf in flat:
+        if leaf.ndim < 2 or not _is_target(path, targets):
+            continue
+        joined = "/".join(str(getattr(p, "key", p)) for p in path)
+        key, k1 = jax.random.split(key)
+        in_dim, out_dim = leaf.shape[-2], leaf.shape[-1]
+        lead = leaf.shape[:-2]  # stacked-layer dims ride along
+        adapters[joined] = {
+            "a": jax.random.normal(k1, lead + (in_dim, rank), jnp.float32) * stddev,
+            "b": jnp.zeros(lead + (rank, out_dim), jnp.float32),
+        }
+    return adapters
+
+
+def lora_delta(adapter, scale):
+    return scale * adapter["a"] @ adapter["b"]
+
+
+def fuse_lora(params, adapters, scale=1.0):
+    """W <- W + s·A@B for every adapted kernel (pure; returns a new tree)."""
+    flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+    out = []
+    for path, leaf in flat:
+        joined = "/".join(str(getattr(p, "key", p)) for p in path)
+        if joined in adapters:
+            leaf = leaf + lora_delta(adapters[joined], scale).astype(leaf.dtype)
+        out.append(leaf)
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def unfuse_lora(params, adapters, scale=1.0):
+    return fuse_lora(params, adapters, -scale)

@@ -59,6 +59,10 @@ class OnebitAdam(TPUOptimizer):
             state["exp_avg"], grads)
         return m
 
+    def _trust_ratio(self, p, upd):
+        """Per-leaf step-size modifier; identity for Adam, layerwise for LAMB."""
+        return 1.0
+
     def apply_compressed(self, m_reduced, state, params, lr=None, wd_mask=None):
         """Apply the update using the reduced momentum and FROZEN variance.
 
@@ -74,11 +78,10 @@ class OnebitAdam(TPUOptimizer):
             step, self.freeze_step).astype(jnp.float32)
 
         def leaf(p, m, v, decay):
-            denom = jnp.sqrt(v / c2) + self.eps
-            upd = (m / c1) / denom
+            upd = (m / c1) / (jnp.sqrt(v / c2) + self.eps)
             if self.weight_decay:
                 upd = upd + jnp.where(decay, self.weight_decay * p, 0.0)
-            return p - lr * upd
+            return p - lr * self._trust_ratio(p, upd) * upd
 
         new_params = jax.tree_util.tree_map(
             leaf, params, m_reduced, state["exp_avg_sq"], mask)
@@ -91,25 +94,8 @@ class OnebitLamb(OnebitAdam):
     """LAMB layerwise trust ratio on top of the compressed-momentum update
     (reference ``onebit/lamb.py``)."""
 
-    def apply_compressed(self, m_reduced, state, params, lr=None, wd_mask=None):
-        lr = self.lr if lr is None else lr
-        step = state["step"] + 1
-        mask = _mask_like(wd_mask, params)
-        c1 = 1.0 - self.b1 ** step.astype(jnp.float32)
-        c2 = 1.0 - self.b2 ** jnp.minimum(
-            step, self.freeze_step).astype(jnp.float32)
-
-        def leaf(p, m, v, decay):
-            upd = (m / c1) / (jnp.sqrt(v / c2) + self.eps)
-            if self.weight_decay:
-                upd = upd + jnp.where(decay, self.weight_decay * p, 0.0)
-            w_norm = jnp.linalg.norm(p.ravel())
-            u_norm = jnp.linalg.norm(upd.ravel())
-            trust = jnp.where((w_norm > 0) & (u_norm > 0),
-                              w_norm / jnp.maximum(u_norm, 1e-30), 1.0)
-            return p - lr * trust * upd
-
-        new_params = jax.tree_util.tree_map(
-            leaf, params, m_reduced, state["exp_avg_sq"], mask)
-        return new_params, {"exp_avg": m_reduced,
-                            "exp_avg_sq": state["exp_avg_sq"], "step": step}
+    def _trust_ratio(self, p, upd):
+        w_norm = jnp.linalg.norm(p.ravel())
+        u_norm = jnp.linalg.norm(upd.ravel())
+        return jnp.where((w_norm > 0) & (u_norm > 0),
+                         w_norm / jnp.maximum(u_norm, 1e-30), 1.0)

@@ -1,0 +1,137 @@
+"""Hybrid (RLHF) engine: inference-path generation inside a training loop.
+
+Reference ``runtime/hybrid_engine.py:32`` ``DeepSpeedHybridEngine``: an RLHF
+actor must interleave fast autoregressive generation (rollouts) with ZeRO-3
+training steps on the SAME weights. The reference rebuilds inference containers
+around the training params and flips between layouts per phase (``generate``
+:168, ``_zero3_forward`` :333). TPU-native, both phases are just different
+compiled programs over one sharded param tree:
+
+- training: the engine's fused fwd+bwd / apply programs (inherited);
+- generation: a jitted prefill + KV-cache decode scan (``models/decoding.py``)
+  reading the SAME fp32 masters, cast to the serving dtype inside the program —
+  the SPMD partitioner inserts whatever gathers the ZeRO/TP layout needs, so
+  there is no layout flip, no weight copy, and nothing to invalidate when the
+  optimizer steps (a new params tree simply feeds the same compiled decode).
+
+LoRA (reference ``:120-146`` fuse/unfuse): adapters fuse into a temporary
+param tree for generation (one jitted tree-add) and never touch the masters —
+"unfuse" is dropping the temporary.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from .engine import DeepSpeedEngine
+from ..config.base import ConfigError
+
+
+class DeepSpeedHybridEngine(DeepSpeedEngine):
+    """Training engine + in-loop generation. Enabled by the
+    ``hybrid_engine.enabled`` config section (reference
+    ``deepspeed/__init__.py:143`` engine selection)."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        if self.pipe_stages > 1:
+            raise ConfigError(
+                "hybrid_engine: generation inside a pipeline-parallel mesh is "
+                "not supported (generate on a dp/tp mesh, train anywhere)")
+        self._gen_cache = {}
+        self._lora = None
+        self._lora_scale = 1.0
+        self._fuse_fn = None
+
+    # -- LoRA (reference hybrid_engine.py:120 _fuse_lora / :146 _unfuse_lora)
+    def set_lora(self, adapters, scale=1.0):
+        self._lora = adapters
+        self._lora_scale = scale
+        self._fuse_fn = None
+
+    def _gen_params(self):
+        if self._lora is None:
+            return self.params
+        if self._fuse_fn is None:
+            from ..ops.lora import fuse_lora
+
+            with self.mesh:
+                self._fuse_fn = jax.jit(
+                    lambda p, a: fuse_lora(p, a, self._lora_scale),
+                    out_shardings=self.param_shardings)
+        return self._fuse_fn(self.params, self._lora)
+
+    # -- generation ---------------------------------------------------------
+    def generate(self, input_ids, max_new_tokens=32, temperature=1.0, top_k=0,
+                 greedy=True, rng=None):
+        """Rollout generation on the live training weights.
+
+        input_ids: [b, prompt_len] int32. Returns [b, prompt + new] int32.
+        Compiled per (batch, prompt_len, max_new_tokens, greedy) — sampling
+        temperature/top_k are runtime args, not compile keys.
+        """
+        input_ids = jnp.asarray(input_ids, jnp.int32)
+        b, prompt_len = input_ids.shape
+        max_len = prompt_len + max_new_tokens
+        model = self.module
+        he_cfg = self._config.hybrid_engine
+        if max_new_tokens > he_cfg.max_out_tokens:
+            raise ConfigError(
+                f"generate: max_new_tokens {max_new_tokens} exceeds "
+                f"hybrid_engine.max_out_tokens {he_cfg.max_out_tokens}")
+        if max_len > model.config.max_seq_len:
+            raise ConfigError(
+                f"generate: {max_len} exceeds model max_seq_len "
+                f"{model.config.max_seq_len}")
+        if rng is None:
+            self._rng, rng = jax.random.split(self._rng)
+        if isinstance(temperature, (int, float)) and temperature == 0.0:
+            greedy = True
+
+        key = (b, prompt_len, max_new_tokens, bool(greedy), int(top_k))
+        if key not in self._gen_cache:
+            from ..models.decoding import decode_tokens, prefill_and_first_token
+
+            dtype = self.compute_dtype
+
+            def rollout(params, ids, rng, temperature):
+                cast = jax.tree_util.tree_map(lambda a: a.astype(dtype), params)
+                rng, r0 = jax.random.split(rng)
+                tok, cache = prefill_and_first_token(
+                    model, cast, ids, r0, temperature, max_len=max_len,
+                    greedy=greedy, top_k=top_k, dtype=dtype)
+                pieces = [ids, tok[:, None]]
+                if max_new_tokens > 1:
+                    toks = decode_tokens(
+                        model, cast, cache, tok, rng, temperature,
+                        prompt_len=prompt_len, max_len=max_len,
+                        steps=max_new_tokens - 1, greedy=greedy, top_k=top_k)
+                    pieces.append(jnp.transpose(toks))
+                return jnp.concatenate(pieces, axis=1)
+
+            with self.mesh:
+                self._gen_cache[key] = jax.jit(rollout)
+        gen = self._gen_cache[key]
+        return gen(self._gen_params(), input_ids, rng,
+                   jnp.asarray(temperature, jnp.float32))
+
+    def sequence_logprobs(self, input_ids, prompt_len):
+        """Per-token logprobs of the generated suffix under the CURRENT params
+        — the policy-gradient side of the RLHF loop (the critic/reward live
+        outside the engine, as in the reference's DeepSpeed-Chat usage).
+        Compiled once per (batch, seq, prompt_len) shape."""
+        input_ids = jnp.asarray(input_ids, jnp.int32)
+        key = ("logprobs", input_ids.shape, prompt_len)
+        if key not in self._gen_cache:
+            def lp(params, ids):
+                cast = jax.tree_util.tree_map(
+                    lambda a: a.astype(self.compute_dtype), params)
+                logits = self.module.apply(cast, ids)
+                logp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32), -1)
+                tgt = ids[:, 1:]
+                tok_lp = jnp.take_along_axis(logp, tgt[..., None], -1)[..., 0]
+                return tok_lp[:, prompt_len - 1:]
+
+            with self.mesh:
+                self._gen_cache[key] = jax.jit(lp)
+        return self._gen_cache[key](self.params, input_ids)

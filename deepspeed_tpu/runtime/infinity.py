@@ -1,0 +1,257 @@
+"""ZeRO-Infinity parameter streaming: train models bigger than device memory.
+
+Reference: ``runtime/swap_tensor/partitioned_param_swapper.py`` +
+``partitioned_optimizer_swapper.py`` — ZeRO-Infinity pages fp16 params
+NVMe->GPU per module (forward and backward), with the optimizer states swapped
+around the CPU update. The eager hook machinery doesn't translate to XLA;
+the TPU-native structure is a *chunked training step*:
+
+- params live on HOST (fp32 masters; optionally backed by the NVMe store) —
+  the device never holds the full model;
+- forward: a python loop over layer chunks; each chunk's params are placed on
+  device (read-ahead for chunk i+1 overlaps compute of chunk i via the aio
+  pool), one jitted chunk-forward runs, and only the boundary activation is
+  kept — device residency is O(chunk + boundaries);
+- backward: the reverse loop re-fetches each chunk and runs ``jax.vjp`` of the
+  chunk forward (recompute-in-chunk, the same trade the reference makes with
+  activation checkpointing at the swap boundary);
+- the chunk's gradient goes STRAIGHT into the host optimizer update for those
+  layers (the ``OffloadedOptimizer`` per-leaf path) and is dropped — gradients
+  are never all resident either.
+
+The embedding/head run on device (they are needed densely by the loss); their
+grads flow through ``jax.vjp`` exactly like the 1F1B schedule's embed/head
+split (``parallel/pipeline_1f1b.py``).
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..models.transformer import _remat_policy, block_apply, _norm_apply
+from ..utils.logging import log_dist
+
+
+class InfinityParamEngine:
+    """Chunked-streaming train step for a CausalLM whose stacked blocks exceed
+    device memory. Single-chip oriented (the multi-chip path shards params
+    instead — ZeRO-3); composes with the host optimizer (ZeRO-Offload)."""
+
+    def __init__(self, model, *, chunk_layers=4, optimizer=None, lr=1e-4,
+                 nvme_path="", compute_dtype=jnp.bfloat16, wd_mask=None,
+                 seed=0):
+        from ..ops.optimizers import Adam
+
+        self.model = model
+        self.cfg = model.config
+        if self.cfg.n_layers % chunk_layers:
+            raise ValueError(f"n_layers {self.cfg.n_layers} must divide "
+                             f"chunk_layers {chunk_layers}")
+        self.chunk_layers = chunk_layers
+        self.n_chunks = self.cfg.n_layers // chunk_layers
+        self.compute_dtype = compute_dtype
+        self.optimizer = optimizer or Adam(lr=lr)
+        self.lr = lr
+
+        cpu = jax.local_devices(backend="cpu")[0]
+        self.cpu = cpu
+        rng = jax.random.PRNGKey(seed)
+        from ..models.layers import split_params_axes
+
+        with jax.default_device(cpu):
+            values = split_params_axes(model.init(rng))[0]
+        # split: blocks stay host-resident; embed/head live on device
+        # np.array(copy=True): np.asarray of a CPU-backed jax array is a
+        # read-only zero-copy view; the page-out path writes in place
+        self.blocks_host = jax.tree_util.tree_map(
+            lambda a: np.array(a, copy=True), values["blocks"])
+        self.outer = jax.tree_util.tree_map(
+            jnp.asarray, {k: v for k, v in values.items() if k != "blocks"})
+
+        self.opt_state_blocks = {
+            "exp_avg": jax.tree_util.tree_map(np.zeros_like, self.blocks_host),
+            "exp_avg_sq": jax.tree_util.tree_map(np.zeros_like,
+                                                 self.blocks_host),
+        }
+        self.opt_state_outer = self.optimizer.init(self.outer)
+        self.step_count = 0
+
+        self._chunk_fwd = None
+        self._chunk_vjp = None
+        self._chunk_update = None
+        n_params = sum(int(np.prod(l.shape)) for l in
+                       jax.tree_util.tree_leaves(self.blocks_host))
+        log_dist(f"InfinityParamEngine: {self.n_chunks} chunks x "
+                 f"{chunk_layers} layers, {n_params/1e6:.1f}M streamed params",
+                 ranks=[0])
+
+    # ------------------------------------------------------------------
+    def _chunk(self, tree, i):
+        lo = i * self.chunk_layers
+        return jax.tree_util.tree_map(
+            lambda a: a[lo:lo + self.chunk_layers], tree)
+
+    def _fetch_chunk(self, i):
+        """Host slice -> device (the NVMe->device page-in; with an NVMe store
+        the host slice itself would be read through the aio pool)."""
+        return jax.tree_util.tree_map(
+            lambda a: jnp.asarray(a[i * self.chunk_layers:
+                                    (i + 1) * self.chunk_layers]),
+            self.blocks_host)
+
+    def _build_fns(self, seq_len):
+        cfg = self.cfg
+        from ..models import layers as L
+
+        has_rope = cfg.position_embedding == "rope"
+        self._rope = None
+        if has_rope:
+            pos = jnp.arange(seq_len)[None, :]
+            self._rope = L.rotary_embedding(pos, cfg.head_dim, cfg.rope_base)
+        alibi_const = (L.alibi_bias(cfg.n_heads, seq_len, seq_len)
+                       if cfg.position_embedding == "alibi" else None)
+
+        def blk(w, h, rope):
+            out, _ = block_apply(cfg, w, h, rope=rope, alibi=alibi_const)
+            return out
+
+        if cfg.remat:
+            blk = jax.checkpoint(blk, policy=_remat_policy(cfg))
+
+        def chunk_fwd(wchunk, h, rope):
+            def body(carry, w_i):
+                return blk(w_i, carry, rope), None
+
+            h, _ = jax.lax.scan(body, h, wchunk)
+            return h
+
+        self._chunk_fwd = jax.jit(chunk_fwd)
+
+        def chunk_bwd(wchunk, h_in, rope, g_out):
+            out, vjp = jax.vjp(lambda w, hh: chunk_fwd(w, hh, rope),
+                               wchunk, h_in)
+            gw, gh = vjp(g_out)
+            return gw, gh
+
+        self._chunk_bwd = jax.jit(chunk_bwd)
+
+        def chunk_update(wchunk, gw, m, v, lr, step):
+            b1, b2, eps = 0.9, 0.999, 1e-8
+            c1 = 1.0 - b1 ** step
+            c2 = 1.0 - b2 ** step
+
+            def leaf(p, g, mm, vv):
+                g = g.astype(jnp.float32)
+                mm = b1 * mm + (1 - b1) * g
+                vv = b2 * vv + (1 - b2) * g * g
+                upd = (mm / c1) / (jnp.sqrt(vv / c2) + eps)
+                return p - lr * upd, mm, vv
+
+            out = jax.tree_util.tree_map(leaf, wchunk, gw, m, v)
+            newp = jax.tree_util.tree_map(lambda t: t[0], out,
+                                          is_leaf=lambda t: isinstance(t, tuple))
+            newm = jax.tree_util.tree_map(lambda t: t[1], out,
+                                          is_leaf=lambda t: isinstance(t, tuple))
+            newv = jax.tree_util.tree_map(lambda t: t[2], out,
+                                          is_leaf=lambda t: isinstance(t, tuple))
+            return newp, newm, newv
+
+        self._chunk_update = jax.jit(chunk_update)
+
+    # ------------------------------------------------------------------
+    def train_step(self, batch):
+        """One full step. Returns the scalar loss. Device residency: one chunk
+        of params (+grads transiently) + n_chunks boundary activations."""
+        cfg = self.cfg
+        model = self.model
+        input_ids = jnp.asarray(batch["input_ids"], jnp.int32)
+        labels = batch.get("labels")
+        if labels is None:
+            labels = jnp.concatenate(
+                [input_ids[:, 1:], jnp.full_like(input_ids[:, :1], -100)],
+                axis=1)
+
+        # ---- embedding under vjp
+        def embed(outer):
+            x = jnp.take(outer["wte"]["weight"].astype(self.compute_dtype),
+                         input_ids, axis=0)
+            if cfg.position_embedding == "learned":
+                s = input_ids.shape[1]
+                x = x + outer["wpe"]["weight"].astype(
+                    self.compute_dtype)[:s][None]
+            return x
+
+        x, embed_vjp = jax.vjp(embed, self.outer)
+        if self._chunk_fwd is None:
+            self._build_fns(input_ids.shape[1])
+
+        # ---- forward sweep, keeping chunk INPUT boundaries
+        boundaries = []
+        w_next = self._fetch_chunk(0)
+        for i in range(self.n_chunks):
+            w = w_next
+            if i + 1 < self.n_chunks:
+                w_next = self._fetch_chunk(i + 1)  # page-in next while compute
+            boundaries.append(x)
+            x = self._chunk_fwd(w, x, self._rope)
+
+        # ---- head + loss under vjp
+        def head_loss(outer, h):
+            hn = _norm_apply(cfg, outer["ln_f"], h)
+            return model.head_ce(outer, hn, labels)
+
+        loss, head_vjp = jax.vjp(head_loss, self.outer, x)
+        g_outer_head, g = head_vjp(jnp.ones((), loss.dtype))
+
+        # ---- reverse sweep: per-chunk vjp + immediate optimizer update
+        self.step_count += 1
+        step = jnp.asarray(self.step_count, jnp.float32)
+        for i in reversed(range(self.n_chunks)):
+            w = self._fetch_chunk(i)
+            gw, g = self._chunk_bwd(w, boundaries[i], self._rope, g)
+            m = self._chunk(self.opt_state_blocks["exp_avg"], i)
+            v = self._chunk(self.opt_state_blocks["exp_avg_sq"], i)
+            newp, newm, newv = self._chunk_update(
+                w, gw, jax.tree_util.tree_map(jnp.asarray, m),
+                jax.tree_util.tree_map(jnp.asarray, v),
+                jnp.asarray(self.lr, jnp.float32), step)
+            self._store_chunk(i, newp, newm, newv)  # page-out
+
+        # ---- embedding/head params update on device
+        (g_embed,) = embed_vjp(g)
+        g_outer = jax.tree_util.tree_map(jnp.add, g_outer_head, g_embed)
+        self.outer, self.opt_state_outer = self.optimizer.update(
+            g_outer, self.opt_state_outer, self.outer, lr=self.lr)
+        return loss
+
+    def _store_chunk(self, i, newp, newm, newv):
+        lo = i * self.chunk_layers
+
+        def put(dst_tree, src_tree):
+            for dst, src in zip(jax.tree_util.tree_leaves(dst_tree),
+                                jax.tree_util.tree_leaves(src_tree)):
+                dst[lo:lo + self.chunk_layers] = np.asarray(src)
+
+        put(self.blocks_host, newp)
+        put(self.opt_state_blocks["exp_avg"], newm)
+        put(self.opt_state_blocks["exp_avg_sq"], newv)
+
+    # ------------------------------------------------------------------
+    def eval_loss(self, batch):
+        """Loss without the update (streams chunks forward only)."""
+        cfg = self.cfg
+        input_ids = jnp.asarray(batch["input_ids"], jnp.int32)
+        labels = jnp.concatenate(
+            [input_ids[:, 1:], jnp.full_like(input_ids[:, :1], -100)], axis=1)
+        x = jnp.take(self.outer["wte"]["weight"].astype(self.compute_dtype),
+                     input_ids, axis=0)
+        if cfg.position_embedding == "learned":
+            s = input_ids.shape[1]
+            x = x + self.outer["wpe"]["weight"].astype(
+                self.compute_dtype)[:s][None]
+        if self._chunk_fwd is None:
+            self._build_fns(input_ids.shape[1])
+        for i in range(self.n_chunks):
+            x = self._chunk_fwd(self._fetch_chunk(i), x, self._rope)
+        hn = _norm_apply(cfg, self.outer["ln_f"], x)
+        return self.model.head_ce(self.outer, hn, labels)

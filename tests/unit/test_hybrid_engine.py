@@ -1,0 +1,134 @@
+"""Hybrid (RLHF) engine: generation inside a training loop, LoRA fuse, and an
+end-to-end policy-gradient smoke (reference runtime/hybrid_engine.py +
+tests/hybrid_engine/)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.models import get_model
+from deepspeed_tpu.ops.lora import fuse_lora, lora_init, unfuse_lora
+
+
+def _engine(devices8, zero=3, **model_kw):
+    model = get_model("gpt2", "tiny", vocab_size=128, max_seq_len=64,
+                      compute_dtype=jnp.float32, **model_kw)
+    eng, _, _, _ = deepspeed_tpu.initialize(model=model, config={
+        "train_batch_size": 8,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": zero},
+        "mesh": {"data": 8},
+        "hybrid_engine": {"enabled": True},
+        "steps_per_print": 10 ** 9})
+    return eng
+
+
+def test_initialize_selects_hybrid_engine(devices8):
+    from deepspeed_tpu.runtime.hybrid_engine import DeepSpeedHybridEngine
+
+    eng = _engine(devices8)
+    assert isinstance(eng, DeepSpeedHybridEngine)
+
+
+def test_generate_then_train_then_generate(devices8):
+    """The hybrid loop: rollouts -> train step -> rollouts reflect new params."""
+    eng = _engine(devices8)
+    rng = np.random.RandomState(0)
+    prompts = jnp.asarray(rng.randint(0, 128, (8, 8)), jnp.int32)
+
+    out1 = np.asarray(eng.generate(prompts, max_new_tokens=6, greedy=True))
+    assert out1.shape == (8, 14)
+
+    batch = {"input_ids": jnp.asarray(out1, jnp.int32)}
+    for _ in range(3):
+        loss = eng.forward(batch)
+        eng.backward(loss)
+        eng.step()
+
+    out2 = np.asarray(eng.generate(prompts, max_new_tokens=6, greedy=True))
+    assert out2.shape == (8, 14)
+    # training on the rollouts makes them more likely -> greedy output of the
+    # updated policy generally changes; at minimum the program ran on the NEW
+    # params (loss on out1 decreased)
+    l2 = float(eng.eval_batch(batch))
+    assert l2 < float(loss) + 1e-6
+
+
+def test_generate_matches_inference_engine(devices8):
+    """The hybrid generate and the serving engine agree on the same weights."""
+    eng = _engine(devices8, n_layers=2)
+    rng = np.random.RandomState(1)
+    prompts = jnp.asarray(rng.randint(0, 128, (2, 6)), jnp.int32)
+    out_h = np.asarray(eng.generate(prompts, max_new_tokens=5, greedy=True))
+
+    ie = deepspeed_tpu.init_inference(
+        eng.module, dtype="float32", max_tokens=64)
+    ie.params = jax.tree_util.tree_map(lambda a: a.astype(jnp.float32),
+                                       eng.params)
+    out_i = np.asarray(ie.generate(prompts, max_new_tokens=5, greedy=True))
+    np.testing.assert_array_equal(out_h, out_i)
+
+
+def test_rlhf_policy_gradient_smoke(devices8):
+    """One REINFORCE-ish iteration: rollouts, per-token logprobs, a weighted
+    loss step — the numbers must stay finite and the engine keeps training."""
+    eng = _engine(devices8)
+    rng = np.random.RandomState(2)
+    prompts = jnp.asarray(rng.randint(0, 128, (8, 8)), jnp.int32)
+    rollouts = eng.generate(prompts, max_new_tokens=8, greedy=False,
+                            temperature=1.0,
+                            rng=jax.random.PRNGKey(0))
+    lp = eng.sequence_logprobs(rollouts, prompt_len=8)
+    assert lp.shape == (8, 8)
+    assert np.all(np.isfinite(np.asarray(lp)))
+
+    # policy-gradient proxy: train on rollouts weighted by a fake reward via
+    # the labels path (full CE on rollouts == maximizing their likelihood)
+    batch = {"input_ids": jnp.asarray(rollouts, jnp.int32)}
+    l0 = eng.forward(batch)
+    eng.backward(l0)
+    eng.step()
+    l1 = eng.forward(batch)
+    eng.backward(l1)
+    eng.step()
+    assert float(l1) < float(l0)
+
+
+def test_lora_fuse_unfuse_roundtrip(devices8):
+    eng = _engine(devices8, n_layers=2)
+    adapters = lora_init(jax.random.PRNGKey(0), eng.params, rank=4)
+    assert adapters  # q and v kernels matched
+    # b=0 at init -> fusing is an exact no-op
+    fused0 = fuse_lora(eng.params, adapters)
+    for a, b_ in zip(jax.tree_util.tree_leaves(eng.params),
+                     jax.tree_util.tree_leaves(fused0)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b_))
+    # nonzero b -> fuse changes weights, unfuse restores them
+    adapters = jax.tree_util.tree_map(lambda x: x + 0.01, adapters)
+    fused = fuse_lora(eng.params, adapters)
+    restored = unfuse_lora(fused, adapters)
+    for orig, rest in zip(jax.tree_util.tree_leaves(eng.params),
+                          jax.tree_util.tree_leaves(restored)):
+        np.testing.assert_allclose(np.asarray(orig), np.asarray(rest),
+                                   atol=1e-5)
+
+
+def test_generate_with_lora_differs(devices8):
+    eng = _engine(devices8, n_layers=2)
+    rng = np.random.RandomState(3)
+    prompts = jnp.asarray(rng.randint(0, 128, (2, 6)), jnp.int32)
+    base = np.asarray(eng.generate(prompts, max_new_tokens=8, greedy=True))
+
+    adapters = lora_init(jax.random.PRNGKey(1), eng.params, rank=4)
+    adapters = jax.tree_util.tree_map(
+        lambda x: x + 0.2, adapters)  # make it bite
+    eng.set_lora(adapters)
+    with_lora = np.asarray(eng.generate(prompts, max_new_tokens=8, greedy=True))
+    assert not np.array_equal(base, with_lora)
+
+    eng.set_lora(None)
+    again = np.asarray(eng.generate(prompts, max_new_tokens=8, greedy=True))
+    np.testing.assert_array_equal(base, again)  # masters untouched

