@@ -1,0 +1,208 @@
+"""Config autotuner: sweep mesh shape x micro-batch x ZeRO stage x remat.
+
+Reference: ``deepspeed/autotuning/`` (2.7k LoC — ``autotuner.py:404 tune``,
+``tuner/{index_based,model_based}_tuner.py``, ``tuner/cost_model.py``,
+experiment ``scheduler.py``): the reference launches whole training jobs per
+config and fits a cost model over the results. On TPU the compiler replaces
+most of that machinery:
+
+1. **compile-prune**: every candidate's train step is jit-lowered; XLA's
+   ``memory_analysis`` gives exact peak memory per candidate WITHOUT running a
+   step, so OOM configs are discarded for free (the reference has to crash a
+   job to learn this);
+2. **cost-model rank**: ``cost_analysis`` flops/bytes -> a roofline time
+   estimate orders the survivors;
+3. **measure**: only the top-k candidates run real timed steps.
+
+Emits the winning config as plain JSON (the reference's
+``autotuning_results/`` contract).
+"""
+
+import dataclasses
+import itertools
+import json
+import time
+
+import numpy as np
+
+from ..utils.logging import log_dist, logger
+
+
+@dataclasses.dataclass
+class TuneResult:
+    config: dict
+    peak_bytes: int = -1
+    est_time: float = -1.0
+    measured_tokens_per_s: float = -1.0
+    status: str = "pending"  # pruned-oom | compile-failed | estimated | measured
+
+    def row(self):
+        return {
+            "mesh": self.config.get("mesh"),
+            "micro": self.config.get("train_micro_batch_size_per_gpu"),
+            "zero": self.config.get("zero_optimization", {}).get("stage"),
+            "remat": self.config.get("_remat"),
+            "peak_gb": round(self.peak_bytes / 1e9, 3) if self.peak_bytes >= 0 else None,
+            "est_ms": round(self.est_time * 1e3, 2) if self.est_time >= 0 else None,
+            "tok_s": round(self.measured_tokens_per_s, 1)
+            if self.measured_tokens_per_s >= 0 else None,
+            "status": self.status,
+        }
+
+
+def _factor_meshes(n_devices, axes=("data", "model")):
+    """All 2-axis factorizations of the device count."""
+    out = []
+    for model in range(1, n_devices + 1):
+        if n_devices % model == 0:
+            out.append({"data": n_devices // model, "model": model})
+    return out
+
+
+class Autotuner:
+    """Sweep-and-measure over engine configs for a given model + batch shape.
+
+    ``model_factory``: () -> model (fresh per candidate; engines own their
+    params). ``base_config``: the user's config; tuned keys are overwritten.
+    """
+
+    def __init__(self, model_factory, base_config, *, device_memory_bytes=None,
+                 peak_flops=None, hbm_bw=None):
+        self.model_factory = model_factory
+        self.base_config = dict(base_config)
+        self.device_memory = device_memory_bytes or self._detect_memory()
+        # roofline constants for the estimate (defaults: v5e-ish)
+        self.peak_flops = peak_flops or 100e12
+        self.hbm_bw = hbm_bw or 6e11
+
+    @staticmethod
+    def _detect_memory():
+        import jax
+
+        stats = getattr(jax.devices()[0], "memory_stats", lambda: None)()
+        if stats and "bytes_limit" in stats:
+            return stats["bytes_limit"]
+        return 12 * 2 ** 30  # conservative default when the backend won't say
+
+    # ------------------------------------------------------------------
+    def search_space(self, n_devices, global_batch):
+        zero_stages = [0, 1, 2, 3]
+        remats = ["minimal", None]
+        micros = [m for m in (1, 2, 4, 8, 16)
+                  if global_batch % (m * 1) == 0]
+        meshes = _factor_meshes(n_devices)
+        cands = []
+        for mesh, zero, remat, micro in itertools.product(
+                meshes, zero_stages, remats, micros):
+            dp = mesh["data"]
+            if global_batch % (micro * dp):
+                continue
+            cfg = dict(self.base_config)
+            cfg["mesh"] = mesh
+            cfg["zero_optimization"] = {"stage": zero}
+            cfg["train_batch_size"] = global_batch
+            cfg["train_micro_batch_size_per_gpu"] = micro
+            cfg.pop("gradient_accumulation_steps", None)
+            cfg["_remat"] = remat
+            cands.append(cfg)
+        return cands
+
+    # ------------------------------------------------------------------
+    def _build_engine(self, cfg):
+        import deepspeed_tpu
+
+        model = self.model_factory()
+        if hasattr(model, "config"):
+            model.config.remat = cfg.get("_remat") is not None
+            if cfg.get("_remat"):
+                model.config.remat_policy = cfg["_remat"]
+        clean = {k: v for k, v in cfg.items() if not k.startswith("_")}
+        engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=clean)
+        return engine
+
+    def _lower_step(self, engine, batch):
+        """Lower+compile the fused fwd_bwd for analysis (the step's hot path)."""
+        import jax
+        import jax.numpy as jnp
+
+        engine._build_fwd_bwd()
+        sharded = engine._shard_batch(
+            {k: v[: engine.micro_batch_size * engine.dp_world_size]
+             for k, v in batch.items()})
+        rng = jax.random.PRNGKey(0)
+        lowered = engine._fwd_bwd_fn.lower(
+            engine.params, sharded, jnp.asarray(1.0, jnp.float32), rng)
+        return lowered.compile(), sharded, rng
+
+    def _estimate(self, compiled):
+        mem = compiled.memory_analysis()
+        peak = (mem.temp_size_in_bytes + mem.argument_size_in_bytes +
+                mem.output_size_in_bytes)
+        cost = compiled.cost_analysis() or {}
+        flops = cost.get("flops", 0.0)
+        bytes_ = cost.get("bytes accessed", 0.0)
+        est = max(flops / self.peak_flops, bytes_ / self.hbm_bw)
+        return peak, est
+
+    # ------------------------------------------------------------------
+    def tune(self, batch, *, measured_topk=3, measure_steps=3, max_candidates=None):
+        """Returns (best_config, [TuneResult...]). ``batch`` must cover the
+        largest global micro-batch in the space."""
+        import jax
+
+        n_devices = len(jax.devices())
+        global_batch = self.base_config.get("train_batch_size") \
+            or batch["input_ids"].shape[0]
+        cands = self.search_space(n_devices, global_batch)
+        if max_candidates:
+            cands = cands[:max_candidates]
+        results = []
+        for cfg in cands:
+            res = TuneResult(config=cfg)
+            results.append(res)
+            try:
+                engine = self._build_engine(cfg)
+                compiled, _, _ = self._lower_step(engine, batch)
+                res.peak_bytes, res.est_time = self._estimate(compiled)
+            except Exception as e:  # compile/shape failures prune the candidate
+                res.status = "compile-failed"
+                logger.debug(f"autotune candidate failed: {cfg}: {e}")
+                continue
+            if res.peak_bytes > self.device_memory:
+                res.status = "pruned-oom"
+                continue
+            res.status = "estimated"
+
+        live = [r for r in results if r.status == "estimated"]
+        live.sort(key=lambda r: r.est_time)
+        for res in live[:measured_topk]:
+            engine = self._build_engine(res.config)
+            tokens = (engine.micro_batch_size * engine.dp_world_size
+                      * batch["input_ids"].shape[1]
+                      * engine.gradient_accumulation_steps_)
+            sub = {k: v[: engine.micro_batch_size * engine.dp_world_size]
+                   for k, v in batch.items()}
+            engine.train_batch(batch=sub)  # compile+warm
+            t0 = time.perf_counter()
+            for _ in range(measure_steps):
+                engine.train_batch(batch=sub)
+            dt = (time.perf_counter() - t0) / measure_steps
+            res.measured_tokens_per_s = tokens / dt
+            res.status = "measured"
+
+        measured = [r for r in results if r.status == "measured"]
+        best = max(measured, key=lambda r: r.measured_tokens_per_s) \
+            if measured else (live[0] if live else None)
+        if best is None:
+            raise RuntimeError("autotune: no viable candidate")
+        log_dist(f"autotune: best {best.row()}", ranks=[0])
+        # emit a config initialize() fully consumes: remat travels as the
+        # engine's gradient_checkpointing flag (engine.py sets module remat)
+        out = {k: v for k, v in best.config.items() if not k.startswith("_")}
+        out["gradient_checkpointing"] = best.config.get("_remat") is not None
+        return out, results
+
+    @staticmethod
+    def dump(results, path):
+        with open(path, "w") as f:
+            json.dump([r.row() for r in results], f, indent=1)

@@ -13,3 +13,4 @@ __all__ = [
     "ElasticityError",
     "ElasticityConfig",
 ]
+from .agent import ElasticAgent  # noqa: F401

@@ -1,0 +1,84 @@
+"""Elastic agent: preemption-aware training with resume-at-any-scale.
+
+Reference: ``elasticity/elastic_agent.py:28`` ``DSElasticAgent`` — plugs into
+torch-elastic's rendezvous to restart jobs when membership changes; recovery is
+checkpoint-based. The TPU translation targets how TPU pods actually fail:
+preemption arrives as SIGTERM with a grace window. The agent
+
+- wraps the train loop, checkpointing every ``save_interval`` steps (async
+  sharded engine — the universal layout is what makes rescaled resume work);
+- on SIGTERM/SIGINT it finishes the in-flight step, writes a final
+  checkpoint, and returns cleanly (exit-for-restart);
+- on (re)start it loads the latest checkpoint INTO WHATEVER MESH the new
+  engine has — the index-range-addressed checkpoint reshapes itself, and the
+  elastic batch config (``compute_elastic_config``, ported reference math)
+  keeps the global batch constant across world sizes.
+"""
+
+import os
+import signal
+
+from ..utils.logging import log_dist
+
+
+class ElasticAgent:
+    def __init__(self, engine, save_dir, *, save_interval=100, tag_prefix="elastic"):
+        self.engine = engine
+        self.save_dir = save_dir
+        self.save_interval = save_interval
+        self.tag_prefix = tag_prefix
+        self._preempted = False
+        self._prev_handlers = {}
+
+    # -- signals ------------------------------------------------------------
+    def _install(self):
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            self._prev_handlers[sig] = signal.signal(sig, self._on_signal)
+
+    def _restore(self):
+        for sig, h in self._prev_handlers.items():
+            signal.signal(sig, h)
+        self._prev_handlers = {}
+
+    def _on_signal(self, signum, frame):
+        log_dist(f"ElasticAgent: received signal {signum}; will checkpoint "
+                 f"and stop after the current step", ranks=[0])
+        self._preempted = True
+
+    # -- checkpoint plumbing ------------------------------------------------
+    def _tag(self):
+        return f"{self.tag_prefix}-step{self.engine.global_steps}"
+
+    def save(self):
+        self.engine.save_checkpoint(self.save_dir, tag=self._tag())
+
+    def try_resume(self):
+        """Load the newest checkpoint if one exists; reshapes to the current
+        engine's mesh automatically. Returns the restored step (or 0)."""
+        latest = os.path.join(self.save_dir, "latest")
+        if not os.path.exists(latest):
+            return 0
+        self.engine.load_checkpoint(self.save_dir)
+        log_dist(f"ElasticAgent: resumed at step {self.engine.global_steps} "
+                 f"on mesh {dict(self.engine.mesh.shape)}", ranks=[0])
+        return self.engine.global_steps
+
+    # -- the loop -----------------------------------------------------------
+    def run(self, data_iter, total_steps):
+        """Train until ``total_steps`` or preemption. Returns
+        ("finished" | "preempted", steps_done)."""
+        self._install()
+        try:
+            start = self.engine.global_steps
+            for _ in range(start, total_steps):
+                batch = next(data_iter)
+                self.engine.train_batch(batch=batch)
+                if self.engine.global_steps % self.save_interval == 0:
+                    self.save()
+                if self._preempted:
+                    self.save()
+                    return "preempted", self.engine.global_steps
+            self.save()
+            return "finished", self.engine.global_steps
+        finally:
+            self._restore()

@@ -92,6 +92,42 @@ class InferenceEngine:
             self.params = jax.tree_util.tree_map(
                 lambda v, s: jax.device_put(jnp.asarray(v, self.dtype), s),
                 values, self.param_shardings)
+        if self._config.quant.enabled:
+            self._quantize_weights()
+
+    def _quantize_weights(self):
+        """int8 weight-only serving (reference ``replace_module.py:140``
+        GroupQuantizer + the inference dequant kernels): every block matmul
+        kernel becomes {kernel_q int8, kernel_scale} — the model reads weights
+        from HBM at 8 bits and dequantizes inside the fused matmul
+        (``models/layers.py linear_apply``)."""
+        from ..ops.quantizer import quantize_per_channel
+
+        bits = self._config.quant.bits
+        group_size = self._config.quant.group_size
+
+        def walk(tree, shardings, name=""):
+            if isinstance(tree, dict):
+                if "router" in name:
+                    return tree  # MoE router must stay fp32 (stable gating)
+                if "kernel" in tree and getattr(tree["kernel"], "ndim", 0) >= 2:
+                    q, scale = quantize_per_channel(tree["kernel"], bits=bits,
+                                                    group_size=group_size)
+                    out = {k: v for k, v in tree.items() if k != "kernel"}
+                    sh = shardings["kernel"]
+                    out["kernel_q"] = jax.device_put(q, sh)
+                    out["kernel_scale"] = scale
+                    return out
+                return {k: walk(v, shardings[k], f"{name}/{k}")
+                        for k, v in tree.items()}
+            return tree
+
+        # only block matmuls; embeddings/norms stay in the serving dtype
+        self.params = dict(self.params)
+        self.params["blocks"] = walk(self.params["blocks"],
+                                     self.param_shardings["blocks"])
+        log_dist(f"int8 weight-only quantization applied to block kernels "
+                 f"(bits={bits}, group_size={group_size})", ranks=[0])
 
     def load_checkpoint(self, load_dir, tag=None):
         """Load trained weights (npz layout from the training engine); TP
@@ -104,14 +140,23 @@ class InferenceEngine:
             latest = os.path.join(load_dir, "latest")
             tag = open(latest).read().strip() if os.path.exists(latest) else None
         path = os.path.join(load_dir, tag) if tag else load_dir
+        # the checkpoint holds FULL-PRECISION weights: build the template from
+        # the model's init shapes, not self.params (which may already be
+        # int8-quantized with kernel_q/kernel_scale keys the manifest lacks)
+        template = jax.tree_util.tree_map(
+            lambda p: jax.ShapeDtypeStruct(p.value.shape, self.dtype),
+            jax.eval_shape(self.module.init, self._rng),
+            is_leaf=lambda x: isinstance(x, Param))
         # sharded engine reads both layouts (per-shard pieces OR legacy npz)
         # and reshapes to the serving TP specs on load
         state, _ = ShardedCheckpointEngine().load(
-            path, template={"params": self.params},
+            path, template={"params": template},
             shardings={"params": self.param_shardings})
         self.params = jax.tree_util.tree_map(
             lambda v, s: jax.device_put(jnp.asarray(v, self.dtype), s),
             state["params"], self.param_shardings)
+        if self._config.quant.enabled:
+            self._quantize_weights()
         return path
 
     # ------------------------------------------------------------------------------

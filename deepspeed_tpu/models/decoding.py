@@ -85,7 +85,9 @@ def _mlp(cfg, p, h):
         out, _ = moe_mlp_apply(cfg, p["mlp"], h, deterministic=True)
         return out
     act = L.ACTIVATIONS[cfg.activation] if cfg.activation != "swiglu" else None
-    mp = jax.tree_util.tree_map(lambda a: a.astype(h.dtype), p["mlp"])
+    mp = jax.tree_util.tree_map(
+        lambda a: a.astype(h.dtype)
+        if jnp.issubdtype(a.dtype, jnp.floating) else a, p["mlp"])
     if cfg.activation == "swiglu":
         gate = L.linear_apply(mp["gate"], h)
         up = L.linear_apply(mp["up"], h)
@@ -95,10 +97,12 @@ def _mlp(cfg, p, h):
 
 def _block_cached(cfg, p, x, k_cache, v_cache, pos, kv_len, rope=None):
     """One block with cache. x: [b, q, d] compute dtype."""
+    cast = lambda a: a.astype(cfg.compute_dtype) \
+        if jnp.issubdtype(a.dtype, jnp.floating) else a
     p_cast = {
         "ln_1": p["ln_1"],
         "ln_2": p["ln_2"],
-        "attn": jax.tree_util.tree_map(lambda a: a.astype(cfg.compute_dtype), p["attn"]),
+        "attn": jax.tree_util.tree_map(cast, p["attn"]),
         "mlp": p["mlp"],
     }
 

@@ -83,9 +83,17 @@ def linear_init(rng, in_dim, out_dim, axes, bias=True, stddev=0.02):
 
 
 def linear_apply(p, x, compute_dtype=None):
-    kernel = p["kernel"]
+    if "kernel_q" in p:
+        # int8 weight-only serving: dequant fuses into the matmul, the weight
+        # streams from HBM at 8 bits (ops/quantizer.py quantize_per_channel)
+        from ..ops.quantizer import dequantize_per_channel
+
+        kernel = dequantize_per_channel(p["kernel_q"], p["kernel_scale"], x.dtype)
+    else:
+        kernel = p["kernel"]
+        if compute_dtype is not None:
+            kernel = kernel.astype(compute_dtype)
     if compute_dtype is not None:
-        kernel = kernel.astype(compute_dtype)
         x = x.astype(compute_dtype)
     y = x @ kernel
     if "bias" in p:

@@ -169,13 +169,17 @@ def block_apply(cfg, p, x, mask=None, rope=None, alibi=None, deterministic=True,
     Params arrive as fp32 masters and are cast to the compute dtype here (norm
     params stay fp32 — layernorm computes in fp32 internally anyway)."""
     x = x.astype(cfg.compute_dtype)
+    # int8 (weight-only-quantized) leaves must NOT be cast here — their dequant
+    # scale lives next to them and linear_apply fuses it into the matmul
+    cast = lambda a: a.astype(cfg.compute_dtype) \
+        if jnp.issubdtype(a.dtype, jnp.floating) else a
     p = {
         "ln_1": p["ln_1"],
         "ln_2": p["ln_2"],
-        "attn": jax.tree_util.tree_map(lambda a: a.astype(cfg.compute_dtype), p["attn"]),
+        "attn": jax.tree_util.tree_map(cast, p["attn"]),
         # MoE params cast inside moe_mlp_apply (router stays fp32 for stable gating)
         "mlp": p["mlp"] if cfg.n_experts > 0 else jax.tree_util.tree_map(
-            lambda a: a.astype(cfg.compute_dtype), p["mlp"]),
+            cast, p["mlp"]),
     }
     b, s, d = x.shape
 

@@ -60,3 +60,38 @@ def quantization_error(x, bits=8, group_size=64):
     """Mean squared quantization error (used by the MoQ eigenvalue-driven schedule)."""
     q, scale, meta = quantize(x, bits=bits, group_size=group_size)
     return jnp.mean((dequantize(q, scale, meta) - jnp.asarray(x, jnp.float32)) ** 2)
+
+
+def quantize_per_channel(w, bits=8, group_size=0):
+    """Weight-only serving quantization: symmetric per-output-channel int8,
+    optionally sub-grouped along the input dim.
+
+    w: [..., in, out] -> (q int8 same shape, scale f32 [..., groups, 1, out]).
+    ``group_size``: quantization granularity along the in-dim (0 / >= in means
+    one group = plain per-channel). The dequant (q * scale) fuses into the
+    consuming matmul, so the weight is READ from HBM at 8 bits — the
+    bandwidth/footprint win the reference's ``GroupQuantizer`` int8 path gets
+    from its dequant kernels (``csrc/.../dequantize.cu``).
+    """
+    w = jnp.asarray(w)
+    in_dim = w.shape[-2]
+    if group_size <= 0 or group_size >= in_dim or in_dim % group_size:
+        group_size = in_dim
+    groups = in_dim // group_size
+    lead = w.shape[:-2]
+    wg = w.astype(jnp.float32).reshape(lead + (groups, group_size, w.shape[-1]))
+    qmax = float(2 ** (bits - 1) - 1)
+    absmax = jnp.max(jnp.abs(wg), axis=-2, keepdims=True)
+    scale = absmax / qmax
+    safe = jnp.where(scale > 0, scale, 1.0)
+    q = jnp.clip(jnp.round(wg / safe), -qmax - 1, qmax).astype(jnp.int8)
+    return q.reshape(w.shape), scale.astype(jnp.float32)
+
+
+def dequantize_per_channel(q, scale, dtype):
+    """Inverse of ``quantize_per_channel`` in the consuming dtype."""
+    groups = scale.shape[-3]
+    lead = q.shape[:-2]
+    in_dim, out = q.shape[-2], q.shape[-1]
+    qg = q.astype(dtype).reshape(lead + (groups, in_dim // groups, out))
+    return (qg * scale.astype(dtype)).reshape(q.shape)

@@ -1,0 +1,122 @@
+"""Memory-mapped indexed dataset + offline data analyzer.
+
+Reference: ``runtime/data_pipeline/data_sampling/indexed_dataset.py:369``
+``MMapIndexedDataset`` (Megatron-derived binary format: a .bin of concatenated
+token arrays + a .idx with dtype/sizes/pointers) and ``data_analyzer.py:20``
+(map-reduce over a dataset computing per-sample metrics -> index files the
+curriculum sampler consumes).
+
+Same on-disk capability, reimplemented simply: the index is a small npz (sizes
++ pointers + dtype code), the payload one flat .bin consumed through
+``np.memmap`` — random access to sample i costs one slice of the mapping, no
+deserialization, and the file is shareable across processes.
+"""
+
+import json
+import os
+
+import numpy as np
+
+_DTYPES = {1: np.uint8, 2: np.int8, 3: np.int16, 4: np.int32, 5: np.int64,
+           6: np.float32, 7: np.float64, 8: np.uint16}
+_CODES = {np.dtype(v): k for k, v in _DTYPES.items()}
+
+
+class MMapIndexedDatasetBuilder:
+    def __init__(self, path, dtype=np.uint16):
+        self.path = path
+        self.dtype = np.dtype(dtype)
+        self._bin = open(path + ".bin", "wb")
+        self.sizes = []
+
+    def add_item(self, tokens):
+        arr = np.asarray(tokens, self.dtype)
+        self._bin.write(arr.tobytes(order="C"))
+        self.sizes.append(arr.size)
+
+    def finalize(self):
+        self._bin.close()
+        sizes = np.asarray(self.sizes, np.int64)
+        pointers = np.concatenate([[0], np.cumsum(sizes[:-1])]) \
+            if sizes.size else np.zeros(0, np.int64)
+        np.savez(self.path + ".idx.npz", sizes=sizes, pointers=pointers,
+                 dtype_code=np.asarray(_CODES[self.dtype]))
+        return self.path
+
+
+class MMapIndexedDataset:
+    """Random access over the built files; samples are 1-D token arrays."""
+
+    def __init__(self, path):
+        idx = np.load(path + ".idx.npz")
+        self.sizes = idx["sizes"]
+        self.pointers = idx["pointers"]
+        self.dtype = np.dtype(_DTYPES[int(idx["dtype_code"])])
+        self._mmap = np.memmap(path + ".bin", dtype=self.dtype, mode="r")
+
+    def __len__(self):
+        return len(self.sizes)
+
+    def __getitem__(self, i):
+        if isinstance(i, slice):
+            return [self[j] for j in range(*i.indices(len(self)))]
+        p, n = int(self.pointers[i]), int(self.sizes[i])
+        return np.asarray(self._mmap[p:p + n])
+
+
+class DataAnalyzer:
+    """Offline per-sample metric pass (reference ``data_analyzer.py:20``
+    ``DataAnalyzer.run_map/run_reduce``): computes metric values for every
+    sample, writes a metric->sample index usable as a curriculum difficulty
+    table. ``metric_fns``: {name: fn(sample)->scalar}."""
+
+    def __init__(self, dataset, metric_fns, save_path, num_workers=1,
+                 worker_id=0):
+        self.dataset = dataset
+        self.metric_fns = metric_fns
+        self.save_path = save_path
+        self.num_workers = num_workers
+        self.worker_id = worker_id
+        os.makedirs(save_path, exist_ok=True)
+
+    def run_map(self):
+        """This worker's shard of the metric pass (map phase)."""
+        n = len(self.dataset)
+        lo = n * self.worker_id // self.num_workers
+        hi = n * (self.worker_id + 1) // self.num_workers
+        out = {name: np.empty(hi - lo, np.float64)
+               for name in self.metric_fns}
+        for j, i in enumerate(range(lo, hi)):
+            sample = self.dataset[i]
+            for name, fn in self.metric_fns.items():
+                out[name][j] = float(fn(sample))
+        np.savez(os.path.join(self.save_path,
+                              f"metrics-{self.worker_id}.npz"),
+                 lo=lo, hi=hi, **out)
+
+    def run_reduce(self):
+        """Merge worker shards; emit, per metric: the full value array plus a
+        difficulty-sorted sample index (what the curriculum sampler consumes)."""
+        shards = sorted(f for f in os.listdir(self.save_path)
+                        if f.startswith("metrics-"))
+        per_metric = {name: {} for name in self.metric_fns}
+        total = 0
+        for f in shards:
+            blob = np.load(os.path.join(self.save_path, f))
+            lo = int(blob["lo"])
+            total = max(total, int(blob["hi"]))
+            for name in self.metric_fns:
+                per_metric[name][lo] = blob[name]
+        result = {}
+        for name, chunks in per_metric.items():
+            values = np.concatenate([chunks[k] for k in sorted(chunks)])
+            order = np.argsort(values, kind="stable")
+            np.savez(os.path.join(self.save_path, f"index-{name}.npz"),
+                     values=values, sample_order=order)
+            result[name] = {"values": values, "sample_order": order}
+        with open(os.path.join(self.save_path, "summary.json"), "w") as f:
+            json.dump({name: {"min": float(np.min(r["values"])),
+                              "max": float(np.max(r["values"])),
+                              "count": int(r["values"].size)}
+                       for name, r in result.items()}, f, indent=1)
+        return result

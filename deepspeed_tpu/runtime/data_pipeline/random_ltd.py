@@ -1,0 +1,79 @@
+"""Random layerwise token dropping (random-LTD).
+
+Reference: ``runtime/data_pipeline/data_routing/basic_layer.py:14``
+``RandomLayerTokenDrop`` + its scheduler (``:38``) and the CUDA
+gather/scatter kernels (``csrc/random_ltd/``): middle transformer layers
+process a random SUBSET of tokens; the skipped tokens bypass the layer via
+the residual stream. The kept-token count follows a schedule that anneals to
+the full sequence.
+
+TPU-native: the gather/scatter kernels are ``jnp.take_along_axis`` /
+``scatter`` (XLA fuses them); the random subset is drawn per layer per step
+with a sorted index so relative order (and causal masking) is preserved.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def random_token_select(rng, seq_len, keep):
+    """Sorted random subset of ``keep`` positions out of ``seq_len``."""
+    scores = jax.random.uniform(rng, (seq_len,))
+    idx = jnp.argsort(scores)[:keep]
+    return jnp.sort(idx)
+
+
+def ltd_gather(x, idx):
+    """x: [b, s, d]; idx: [keep] -> [b, keep, d]."""
+    return jnp.take(x, idx, axis=1)
+
+
+def ltd_scatter(x_full, x_kept, idx):
+    """Write the processed kept tokens back; dropped tokens keep the residual
+    input (the layer is skipped for them)."""
+    return x_full.at[:, idx].set(x_kept)
+
+
+def apply_random_ltd(block_fn, x, rng, keep, *block_args, **block_kw):
+    """Run ``block_fn`` on a random ``keep``-token subsequence of x.
+
+    Returns the full-sequence output where non-kept tokens passed through
+    unchanged. ``keep`` is static (shapes are compiled)."""
+    s = x.shape[1]
+    if keep >= s:
+        return block_fn(x, *block_args, **block_kw)
+    idx = random_token_select(rng, s, keep)
+    sub = ltd_gather(x, idx)
+    sub_out = block_fn(sub, *block_args, **block_kw)
+    return ltd_scatter(x, sub_out, idx)
+
+
+class RandomLTDScheduler:
+    """Kept-token schedule (reference ``data_routing/scheduler.py``): linear
+    anneal from ``start_seq`` to the full length over ``total_steps``, in
+    ``step_size`` granules."""
+
+    def __init__(self, full_seq, start_seq, total_steps, step_size=16):
+        self.full_seq = full_seq
+        self.start_seq = min(start_seq, full_seq)
+        self.total_steps = max(1, total_steps)
+        self.step_size = step_size
+        self.global_step = 0
+
+    def keep_at(self, step):
+        frac = min(1.0, step / self.total_steps)
+        if frac >= 1.0:
+            return self.full_seq  # fully annealed regardless of granularity
+        raw = self.start_seq + frac * (self.full_seq - self.start_seq)
+        granular = int(raw // self.step_size * self.step_size)
+        return int(min(self.full_seq, max(self.start_seq, granular)))
+
+    def step(self):
+        self.global_step += 1
+        return self.keep_at(self.global_step)
+
+    def state_dict(self):
+        return {"global_step": self.global_step}
+
+    def load_state_dict(self, state):
+        self.global_step = state["global_step"]

@@ -1,0 +1,105 @@
+"""Smaller runtime utilities with direct reference counterparts.
+
+- ``Eigenvalue``: power-iteration estimate of a loss-curvature eigenvalue per
+  param block (reference ``runtime/eigenvalue.py`` — feeds the compression
+  scheduler's layer sensitivity).
+- ``ProgressiveLayerDrop``: the PLD theta schedule (reference
+  ``runtime/progressive_layer_drop.py``); the keep-probability gate is applied
+  by ``stack_apply`` when enabled.
+- ``TiledLinear``: a linear whose matmul runs tile-by-tile over the output dim
+  (reference ``runtime/zero/tiling.py`` splits huge linears so ZeRO-3 only
+  gathers a tile at a time; under XLA the win is bounding live activation
+  slices for very wide layers).
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+class Eigenvalue:
+    """Power iteration on the loss Hessian-vector product, per param leaf.
+
+    ``loss_fn(params) -> scalar``; returns {path: eigenvalue estimate}. HVP is
+    forward-over-reverse (jvp of grad) — exact, no finite differences."""
+
+    def __init__(self, max_iter=20, tol=1e-2, seed=0):
+        self.max_iter = max_iter
+        self.tol = tol
+        self.seed = seed
+
+    def compute(self, loss_fn, params):
+        grad_fn = jax.grad(loss_fn)
+
+        def hvp(v):
+            return jax.jvp(grad_fn, (params,), (v,))[1]
+
+        rng = jax.random.PRNGKey(self.seed)
+        flat, treedef = jax.tree_util.tree_flatten(params)
+        keys = jax.random.split(rng, len(flat))
+        v = jax.tree_util.tree_unflatten(
+            treedef, [jax.random.normal(k, l.shape, jnp.float32)
+                      for k, l in zip(keys, flat)])
+
+        def norm(t):
+            return jnp.sqrt(sum(jnp.sum(jnp.square(l))
+                                for l in jax.tree_util.tree_leaves(t)))
+
+        eig_prev = jnp.asarray(0.0)
+        for _ in range(self.max_iter):
+            n = norm(v)
+            v = jax.tree_util.tree_map(lambda a: a / (n + 1e-30), v)
+            hv = hvp(v)
+            eig = sum(jnp.sum(a * b) for a, b in zip(
+                jax.tree_util.tree_leaves(v), jax.tree_util.tree_leaves(hv)))
+            if abs(float(eig - eig_prev)) <= self.tol * abs(float(eig) + 1e-30):
+                v = hv
+                eig_prev = eig
+                break
+            v, eig_prev = hv, eig
+        return float(eig_prev)
+
+
+class ProgressiveLayerDrop:
+    """theta(t) = (1 - theta_bar) * exp(-gamma * t) + theta_bar (reference
+    ``progressive_layer_drop.py``); per-layer keep prob follows the usual
+    depth scaling keep_i = 1 - (i/L) * (1 - theta)."""
+
+    def __init__(self, theta=0.5, gamma=0.001):
+        self.theta_bar = theta
+        self.gamma = gamma
+        self.current_theta = 1.0
+
+    def update_state(self, global_step):
+        import math
+
+        self.current_theta = ((1.0 - self.theta_bar)
+                              * math.exp(-self.gamma * global_step)
+                              + self.theta_bar)
+        return self.current_theta
+
+    def get_theta(self):
+        return self.current_theta
+
+    def keep_prob(self, layer_idx, n_layers):
+        return 1.0 - (layer_idx / max(1, n_layers)) * (1.0 - self.current_theta)
+
+
+def tiled_linear_apply(p, x, tiles=4, compute_dtype=None):
+    """y = x @ W (+ b), computed in ``tiles`` slices of the output dim —
+    bounds the live [tokens, out/tiles] slice (reference TiledLinear,
+    ``runtime/zero/tiling.py``). Exactly equals the untiled linear."""
+    kernel = p["kernel"]
+    if compute_dtype is not None:
+        kernel = kernel.astype(compute_dtype)
+        x = x.astype(compute_dtype)
+    out_dim = kernel.shape[-1]
+    if out_dim % tiles:
+        tiles = 1
+    width = out_dim // tiles
+    pieces = [x @ jax.lax.slice_in_dim(kernel, t * width, (t + 1) * width, axis=-1)
+              for t in range(tiles)]
+    y = jnp.concatenate(pieces, axis=-1)
+    if "bias" in p:
+        b = p["bias"].astype(y.dtype) if compute_dtype is not None else p["bias"]
+        y = y + b
+    return y

@@ -76,8 +76,7 @@ class InfinityParamEngine:
         self.opt_state_outer = self.optimizer.init(self.outer)
         self.step_count = 0
 
-        self._chunk_fwd = None
-        self._chunk_vjp = None
+        self._fns = {}  # seq_len -> (chunk_fwd, chunk_bwd, rope)
         self._chunk_update = None
         n_params = sum(int(np.prod(l.shape)) for l in
                        jax.tree_util.tree_leaves(self.blocks_host))
@@ -99,15 +98,17 @@ class InfinityParamEngine:
                                     (i + 1) * self.chunk_layers]),
             self.blocks_host)
 
-    def _build_fns(self, seq_len):
+    def _get_fns(self, seq_len):
+        if seq_len in self._fns:
+            return self._fns[seq_len]
         cfg = self.cfg
         from ..models import layers as L
 
         has_rope = cfg.position_embedding == "rope"
-        self._rope = None
+        rope_tables = None
         if has_rope:
             pos = jnp.arange(seq_len)[None, :]
-            self._rope = L.rotary_embedding(pos, cfg.head_dim, cfg.rope_base)
+            rope_tables = L.rotary_embedding(pos, cfg.head_dim, cfg.rope_base)
         alibi_const = (L.alibi_bias(cfg.n_heads, seq_len, seq_len)
                        if cfg.position_embedding == "alibi" else None)
 
@@ -125,18 +126,24 @@ class InfinityParamEngine:
             h, _ = jax.lax.scan(body, h, wchunk)
             return h
 
-        self._chunk_fwd = jax.jit(chunk_fwd)
-
         def chunk_bwd(wchunk, h_in, rope, g_out):
             out, vjp = jax.vjp(lambda w, hh: chunk_fwd(w, hh, rope),
                                wchunk, h_in)
             gw, gh = vjp(g_out)
             return gw, gh
 
-        self._chunk_bwd = jax.jit(chunk_bwd)
+        fns = (jax.jit(chunk_fwd), jax.jit(chunk_bwd), rope_tables)
+        self._fns[seq_len] = fns
+
+        # streamed blocks use the Adam-family update with the CONFIGURED
+        # optimizer's hyperparameters (the reference's CPUAdam role); exotic
+        # optimizers apply only to the resident embed/head params
+        b1 = getattr(self.optimizer, "b1", 0.9)
+        b2 = getattr(self.optimizer, "b2", 0.999)
+        eps = getattr(self.optimizer, "eps", 1e-8)
+        wd = getattr(self.optimizer, "weight_decay", 0.0)
 
         def chunk_update(wchunk, gw, m, v, lr, step):
-            b1, b2, eps = 0.9, 0.999, 1e-8
             c1 = 1.0 - b1 ** step
             c2 = 1.0 - b2 ** step
 
@@ -145,6 +152,8 @@ class InfinityParamEngine:
                 mm = b1 * mm + (1 - b1) * g
                 vv = b2 * vv + (1 - b2) * g * g
                 upd = (mm / c1) / (jnp.sqrt(vv / c2) + eps)
+                if wd:
+                    upd = upd + wd * p
                 return p - lr * upd, mm, vv
 
             out = jax.tree_util.tree_map(leaf, wchunk, gw, m, v)
@@ -157,6 +166,7 @@ class InfinityParamEngine:
             return newp, newm, newv
 
         self._chunk_update = jax.jit(chunk_update)
+        return fns
 
     # ------------------------------------------------------------------
     def train_step(self, batch):
@@ -182,8 +192,7 @@ class InfinityParamEngine:
             return x
 
         x, embed_vjp = jax.vjp(embed, self.outer)
-        if self._chunk_fwd is None:
-            self._build_fns(input_ids.shape[1])
+        chunk_fwd, chunk_bwd, rope = self._get_fns(input_ids.shape[1])
 
         # ---- forward sweep, keeping chunk INPUT boundaries
         boundaries = []
@@ -193,7 +202,7 @@ class InfinityParamEngine:
             if i + 1 < self.n_chunks:
                 w_next = self._fetch_chunk(i + 1)  # page-in next while compute
             boundaries.append(x)
-            x = self._chunk_fwd(w, x, self._rope)
+            x = chunk_fwd(w, x, rope)
 
         # ---- head + loss under vjp
         def head_loss(outer, h):
@@ -208,7 +217,7 @@ class InfinityParamEngine:
         step = jnp.asarray(self.step_count, jnp.float32)
         for i in reversed(range(self.n_chunks)):
             w = self._fetch_chunk(i)
-            gw, g = self._chunk_bwd(w, boundaries[i], self._rope, g)
+            gw, g = chunk_bwd(w, boundaries[i], rope, g)
             m = self._chunk(self.opt_state_blocks["exp_avg"], i)
             v = self._chunk(self.opt_state_blocks["exp_avg_sq"], i)
             newp, newm, newv = self._chunk_update(
@@ -249,9 +258,8 @@ class InfinityParamEngine:
             s = input_ids.shape[1]
             x = x + self.outer["wpe"]["weight"].astype(
                 self.compute_dtype)[:s][None]
-        if self._chunk_fwd is None:
-            self._build_fns(input_ids.shape[1])
+        chunk_fwd, _, rope = self._get_fns(input_ids.shape[1])
         for i in range(self.n_chunks):
-            x = self._chunk_fwd(self._fetch_chunk(i), x, self._rope)
+            x = chunk_fwd(self._fetch_chunk(i), x, rope)
         hn = _norm_apply(cfg, self.outer["ln_f"], x)
         return self.model.head_ce(self.outer, hn, labels)

@@ -1,0 +1,60 @@
+"""Autotuner: compile-prune + cost ranking + measured best (reference
+deepspeed/autotuning/, tests/unit/autotuning/)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.autotuning import Autotuner
+from deepspeed_tpu.models import get_model
+
+pytestmark = pytest.mark.slow  # builds/compiles several engines
+
+
+def _factory():
+    return lambda: get_model("gpt2", "tiny", vocab_size=128, max_seq_len=32,
+                             n_layers=2, compute_dtype=jnp.float32)
+
+
+BASE = {
+    "train_batch_size": 8,
+    "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+    "steps_per_print": 10 ** 9,
+}
+
+
+def test_search_space_respects_divisibility():
+    tuner = Autotuner(_factory(), BASE, device_memory_bytes=2 ** 40)
+    cands = tuner.search_space(n_devices=8, global_batch=8)
+    for c in cands:
+        dp = c["mesh"]["data"]
+        micro = c["train_micro_batch_size_per_gpu"]
+        assert 8 % (micro * dp) == 0
+        assert dp * c["mesh"]["model"] == 8
+
+
+def test_tune_picks_a_measured_config(tmp_path):
+    rng = np.random.RandomState(0)
+    batch = {"input_ids": rng.randint(0, 128, (8, 32)).astype(np.int32)}
+    tuner = Autotuner(_factory(), BASE, device_memory_bytes=2 ** 40)
+    best, results = tuner.tune(batch, measured_topk=2, measure_steps=2,
+                               max_candidates=10)
+    assert best["mesh"]["data"] * best["mesh"]["model"] == 8
+    assert any(r.status == "measured" for r in results)
+    assert any(r.measured_tokens_per_s > 0 for r in results)
+    tuner.dump(results, str(tmp_path / "autotune.json"))
+    import json
+
+    rows = json.load(open(tmp_path / "autotune.json"))
+    assert len(rows) == len(results)
+
+
+def test_oom_candidates_are_pruned_without_running():
+    rng = np.random.RandomState(0)
+    batch = {"input_ids": rng.randint(0, 128, (8, 32)).astype(np.int32)}
+    # absurdly small budget: everything must prune, nothing must execute
+    tuner = Autotuner(_factory(), BASE, device_memory_bytes=1024)
+    with pytest.raises(RuntimeError, match="no viable"):
+        tuner.tune(batch, measured_topk=1, max_candidates=6)

@@ -1,0 +1,136 @@
+"""Data-efficiency tail + misc runtime utilities: indexed dataset, analyzer,
+random-LTD, PLD, eigenvalue, tiled linear."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.runtime.data_pipeline.indexed_dataset import (
+    DataAnalyzer, MMapIndexedDataset, MMapIndexedDatasetBuilder)
+from deepspeed_tpu.runtime.data_pipeline.random_ltd import (
+    RandomLTDScheduler, apply_random_ltd, random_token_select)
+from deepspeed_tpu.runtime.extras import (
+    Eigenvalue, ProgressiveLayerDrop, tiled_linear_apply)
+
+
+# ---------------------------------------------------------------------------
+def test_indexed_dataset_roundtrip(tmp_path):
+    path = str(tmp_path / "ds")
+    b = MMapIndexedDatasetBuilder(path, dtype=np.uint16)
+    rng = np.random.RandomState(0)
+    samples = [rng.randint(0, 60000, (n,)).astype(np.uint16)
+               for n in (5, 17, 1, 64)]
+    for s in samples:
+        b.add_item(s)
+    b.finalize()
+
+    ds = MMapIndexedDataset(path)
+    assert len(ds) == 4
+    for i, s in enumerate(samples):
+        np.testing.assert_array_equal(ds[i], s)
+    got = ds[1:3]
+    np.testing.assert_array_equal(got[0], samples[1])
+
+
+def test_data_analyzer_map_reduce(tmp_path):
+    path = str(tmp_path / "ds")
+    b = MMapIndexedDatasetBuilder(path, dtype=np.int32)
+    lengths = [3, 10, 1, 7, 5, 2]
+    for n in lengths:
+        b.add_item(np.arange(n))
+    b.finalize()
+    ds = MMapIndexedDataset(path)
+
+    # two workers map, one reduce (the reference's map-reduce contract)
+    for w in range(2):
+        DataAnalyzer(ds, {"length": len}, str(tmp_path / "an"),
+                     num_workers=2, worker_id=w).run_map()
+    result = DataAnalyzer(ds, {"length": len}, str(tmp_path / "an"),
+                          num_workers=2).run_reduce()
+    np.testing.assert_array_equal(result["length"]["values"], lengths)
+    order = result["length"]["sample_order"]
+    assert list(np.asarray(lengths)[order]) == sorted(lengths)
+
+
+# ---------------------------------------------------------------------------
+def test_random_ltd_passthrough_and_subset():
+    rng = jax.random.PRNGKey(0)
+    x = jnp.asarray(np.random.RandomState(0).randn(2, 16, 8), jnp.float32)
+
+    idx = random_token_select(rng, 16, 6)
+    assert idx.shape == (6,)
+    assert np.all(np.diff(np.asarray(idx)) > 0)  # sorted, unique
+
+    calls = {}
+
+    def block(h):
+        calls["shape"] = h.shape
+        return h * 2.0
+
+    out = apply_random_ltd(block, x, rng, keep=6)
+    assert calls["shape"] == (2, 6, 8)
+    kept = np.asarray(idx)
+    np.testing.assert_allclose(np.asarray(out)[:, kept],
+                               np.asarray(x)[:, kept] * 2.0)
+    dropped = [i for i in range(16) if i not in kept]
+    np.testing.assert_allclose(np.asarray(out)[:, dropped],
+                               np.asarray(x)[:, dropped])
+
+    # keep >= seq is a no-op wrapper
+    out_full = apply_random_ltd(block, x, rng, keep=16)
+    np.testing.assert_allclose(np.asarray(out_full), np.asarray(x) * 2.0)
+
+
+def test_random_ltd_scheduler_anneals():
+    sch = RandomLTDScheduler(full_seq=128, start_seq=32, total_steps=100,
+                             step_size=16)
+    assert sch.keep_at(0) == 32
+    assert sch.keep_at(100) == 128
+    mids = [sch.step() for _ in range(100)]
+    assert mids[-1] == 128
+    assert all(b >= a for a, b in zip(mids, mids[1:]))
+
+
+# ---------------------------------------------------------------------------
+def test_pld_theta_schedule():
+    pld = ProgressiveLayerDrop(theta=0.5, gamma=0.01)
+    t0 = pld.update_state(0)
+    t_inf = pld.update_state(10 ** 6)
+    assert abs(t0 - 1.0) < 1e-6
+    assert abs(t_inf - 0.5) < 1e-3
+    pld.update_state(100)
+    assert pld.keep_prob(0, 12) == 1.0
+    assert pld.keep_prob(12, 12) == pytest.approx(pld.get_theta())
+
+
+def test_eigenvalue_quadratic():
+    """For loss = 0.5 x^T diag(d) x the top eigenvalue is max(d)."""
+    d = jnp.asarray([1.0, 4.0, 2.0, 9.0, 3.0])
+
+    def loss(p):
+        return 0.5 * jnp.sum(d * p["x"] ** 2)
+
+    eig = Eigenvalue(max_iter=50, tol=1e-4).compute(
+        loss, {"x": jnp.ones((5,), jnp.float32)})
+    assert abs(eig - 9.0) < 0.2
+
+
+def test_tiled_linear_matches_dense():
+    rng = np.random.RandomState(0)
+    p = {"kernel": jnp.asarray(rng.randn(16, 32), jnp.float32),
+         "bias": jnp.asarray(rng.randn(32), jnp.float32)}
+    x = jnp.asarray(rng.randn(4, 16), jnp.float32)
+    ref = x @ p["kernel"] + p["bias"]
+    for tiles in (1, 2, 4, 5):  # 5 doesn't divide 32 -> falls back to 1
+        out = tiled_linear_apply(p, x, tiles=tiles)
+        np.testing.assert_allclose(np.asarray(ref), np.asarray(out),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_random_ltd_anneals_to_full_with_nonmultiple_seq():
+    sch = RandomLTDScheduler(full_seq=100, start_seq=32, total_steps=10,
+                             step_size=16)
+    assert sch.keep_at(10) == 100
+    assert sch.keep_at(999) == 100

@@ -90,3 +90,13 @@ def test_infinity_rejects_indivisible_chunks():
     model = get_model("gpt2", "tiny", n_layers=4, compute_dtype=jnp.float32)
     with pytest.raises(ValueError, match="divide"):
         InfinityParamEngine(model, chunk_layers=3)
+
+
+def test_infinity_handles_multiple_seq_lengths():
+    model = get_model("llama", "tiny", compute_dtype=jnp.float32,
+                      fused_ce=False)
+    inf = InfinityParamEngine(model, chunk_layers=1, lr=1e-3,
+                              compute_dtype=jnp.float32)
+    l1 = float(inf.train_step(_batch(s=16, vocab=1024)))
+    l2 = float(inf.train_step(_batch(s=32, vocab=1024)))  # rope re-keyed
+    assert np.isfinite(l1) and np.isfinite(l2)

@@ -201,3 +201,24 @@ def test_1f1b_uneven_ignore_labels_matches_plain_ad(pipe2_mesh):
                      jax.tree_util.tree_leaves(grads)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
                                    rtol=5e-4, atol=1e-5)
+
+
+def test_eval_batch_on_tp_pipe_mesh(devices8):
+    """VERDICT weak item: eval_batch on a TP x PP mesh must produce the same
+    loss the training path sees (it reads pipe-sharded params via SPMD)."""
+    cfg = _cfg()
+    model = CausalLM(cfg)
+    config = {
+        "train_batch_size": 8,
+        "train_micro_batch_size_per_gpu": 1,
+        "gradient_accumulation_steps": 4,
+        "optimizer": {"type": "adamw", "params": {"lr": 0.0}},
+        "zero_optimization": {"stage": 0},
+        "mesh": {"data": 2, "pipe": 2, "model": 2},
+        "steps_per_print": 10 ** 9,
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=config)
+    batch = _batch(b=8)
+    train_loss = engine.train_batch(batch=batch)  # lr=0: params unchanged
+    eval_loss = float(engine.eval_batch(batch))
+    np.testing.assert_allclose(train_loss, eval_loss, rtol=2e-4)
