@@ -128,7 +128,15 @@ class OffloadedOptimizer:
 
     # ------------------------------------------------------------------------------
     def _to_host(self, grads, scale_inv):
-        """Device grads -> host fp32, unscaled; also the global norm (host)."""
+        """Device grads -> host fp32, unscaled; also the global norm (host).
+
+        All leaf transfers are STARTED asynchronously before any is consumed,
+        so D2H copies overlap each other (and any still-running device work)
+        instead of serializing leaf by leaf — the same overlap the reference
+        gets from its side-stream grad copies (stage_1_and_2.py:1031)."""
+        for g in jax.tree_util.tree_leaves(grads):
+            if hasattr(g, "copy_to_host_async"):
+                g.copy_to_host_async()
         host = jax.tree_util.tree_map(
             lambda g: jax.device_put(np.asarray(jax.device_get(g)), self.cpu), grads)
         with jax.default_device(self.cpu):
