@@ -46,8 +46,10 @@ def _attn_with_cache(cfg, p_attn, h, k_cache, v_cache, pos, kv_len, rope=None):
     v = L.linear_apply(p_attn["v"], h).reshape(b, q_len, cfg.kv_heads, cfg.head_dim)
     if rope is not None:
         cos, sin = rope
-        q = L.apply_rotary(q, cos, sin)
-        k = L.apply_rotary(k, cos, sin)
+        q = L.apply_rotary(q, cos, sin, cfg.rotary_dim,
+                           cfg.rotary_interleaved)
+        k = L.apply_rotary(k, cos, sin, cfg.rotary_dim,
+                           cfg.rotary_interleaved)
 
     k_cache = jax.lax.dynamic_update_slice(k_cache, k.astype(k_cache.dtype),
                                            (0, pos, 0, 0))
@@ -112,8 +114,10 @@ def _block_cached(cfg, p, x, k_cache, v_cache, pos, kv_len, rope=None):
 
     if cfg.parallel_attn_mlp:
         h = _norm_apply(cfg, p_cast["ln_1"], x)
+        h_mlp = _norm_apply(cfg, p_cast["ln_2"], x) \
+            if cfg.parallel_norm_split else h
         a, kc, vc = attn(h)
-        return x + a + _mlp(cfg, p_cast, h), kc, vc
+        return x + a + _mlp(cfg, p_cast, h_mlp), kc, vc
     if cfg.prenorm:
         a, kc, vc = attn(_norm_apply(cfg, p_cast["ln_1"], x))
         x = x + a
@@ -142,7 +146,8 @@ def forward_with_cache(model, params, input_ids, cache, pos, kv_len):
                          positions, axis=0)
     rope = None
     if cfg.position_embedding == "rope":
-        rope = L.rotary_embedding(positions, cfg.head_dim, cfg.rope_base)
+        rope = L.rotary_embedding(positions, cfg.rotary_dim or cfg.head_dim,
+                                  cfg.rope_base)
 
     def scan_fn(carry, layer):
         h = carry

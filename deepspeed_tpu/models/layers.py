@@ -151,7 +151,10 @@ def rmsnorm_apply(p, x, eps=1e-6):
 # Activations (reference: csrc/transformer/gelu_kernels.cu — XLA fuses these)
 # ---------------------------------------------------------------------------------
 ACTIVATIONS = {
+    # jax.nn.gelu defaults to the tanh approximation — matches BLOOM/GPT-2's
+    # "gelu"; HF models whose gelu is the exact erf form map to gelu_exact.
     "gelu": jax.nn.gelu,
+    "gelu_exact": lambda x: jax.nn.gelu(x, approximate=False),
     "gelu_new": lambda x: jax.nn.gelu(x, approximate=True),
     "relu": jax.nn.relu,
     "silu": jax.nn.silu,
@@ -227,11 +230,26 @@ def rotary_embedding(positions, head_dim, base=10000.0, dtype=jnp.float32):
     return jnp.cos(angles).astype(dtype), jnp.sin(angles).astype(dtype)
 
 
-def apply_rotary(x, cos, sin):
-    """x: [batch, seq, heads, head_dim]; cos/sin: [batch, seq, head_dim/2]."""
-    x1, x2 = jnp.split(x, 2, axis=-1)
+def apply_rotary(x, cos, sin, rotary_dim=None, interleaved=False):
+    """x: [batch, seq, heads, head_dim]; cos/sin: [batch, seq, rd/2].
+
+    ``rotary_dim``: rotate only the first rd dims of each head (GPT-J/NeoX
+    partial rotary), pass the remainder through unchanged.
+    ``interleaved``: rotate (x0,x1),(x2,x3),... pairs (GPT-J rotate-every-two)
+    instead of the half-split (x_i, x_{i+d/2}) convention (NeoX/LLaMA)."""
+    if rotary_dim is not None and rotary_dim < x.shape[-1]:
+        x_rot, x_pass = x[..., :rotary_dim], x[..., rotary_dim:]
+        return jnp.concatenate(
+            [apply_rotary(x_rot, cos, sin, interleaved=interleaved), x_pass],
+            axis=-1)
     cos = cos[:, :, None, :].astype(x.dtype)
     sin = sin[:, :, None, :].astype(x.dtype)
+    if interleaved:
+        x1 = x[..., 0::2]
+        x2 = x[..., 1::2]
+        out = jnp.stack([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+        return out.reshape(x.shape)
+    x1, x2 = jnp.split(x, 2, axis=-1)
     return jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
 
 

@@ -39,11 +39,21 @@ class TransformerConfig:
     norm: str = "layernorm"  # layernorm | rmsnorm
     position_embedding: str = "learned"  # learned | rope | alibi | none
     rope_base: float = 10000.0
+    # partial rotary (GPT-J rotary_dim / NeoX rotary_pct): rope the first
+    # ``rotary_dim`` dims of each head, pass the rest through. None = full.
+    rotary_dim: typing.Optional[int] = None
+    rotary_interleaved: bool = False  # GPT-J rotate-every-two pairing
     tie_embeddings: bool = True
+    head_bias: bool = False  # untied LM head with bias (GPT-J)
+    mlp_bias: typing.Optional[bool] = None  # None -> use_bias (GPT-J: attn
+    # projections have no bias but the MLP does)
     embed_layernorm: bool = False  # LN right after the embedding (BLOOM)
     use_bias: bool = True
     prenorm: bool = True
     parallel_attn_mlp: bool = False
+    # parallel residual with SEPARATE norms: x + attn(ln1 x) + mlp(ln2 x)
+    # (GPT-NeoX use_parallel_residual) vs GPT-J's shared ln1 for both
+    parallel_norm_split: bool = False
     dropout: float = 0.0
     attn_dropout: float = 0.0
     layernorm_eps: float = 1e-5
@@ -117,15 +127,16 @@ def _mlp_init(rng, cfg):
     std = cfg.initializer_range
     # GPT-2 scales residual-projection init by 1/sqrt(2L)
     out_std = std / (2.0 * cfg.n_layers) ** 0.5
+    bias = cfg.use_bias if cfg.mlp_bias is None else cfg.mlp_bias
     if cfg.activation == "swiglu":
         return {
-            "gate": L.linear_init(k1, cfg.d_model, cfg.d_ff, ("embed", "mlp"), cfg.use_bias, std),
-            "up": L.linear_init(k2, cfg.d_model, cfg.d_ff, ("embed", "mlp"), cfg.use_bias, std),
-            "down": L.linear_init(k3, cfg.d_ff, cfg.d_model, ("mlp", "embed"), cfg.use_bias, out_std),
+            "gate": L.linear_init(k1, cfg.d_model, cfg.d_ff, ("embed", "mlp"), bias, std),
+            "up": L.linear_init(k2, cfg.d_model, cfg.d_ff, ("embed", "mlp"), bias, std),
+            "down": L.linear_init(k3, cfg.d_ff, cfg.d_model, ("mlp", "embed"), bias, out_std),
         }
     return {
-        "fc": L.linear_init(k1, cfg.d_model, cfg.d_ff, ("embed", "mlp"), cfg.use_bias, std),
-        "proj": L.linear_init(k2, cfg.d_ff, cfg.d_model, ("mlp", "embed"), cfg.use_bias, out_std),
+        "fc": L.linear_init(k1, cfg.d_model, cfg.d_ff, ("embed", "mlp"), bias, std),
+        "proj": L.linear_init(k2, cfg.d_ff, cfg.d_model, ("mlp", "embed"), bias, out_std),
     }
 
 
@@ -186,16 +197,37 @@ def block_apply(cfg, p, x, mask=None, rope=None, alibi=None, deterministic=True,
     from jax.ad_checkpoint import checkpoint_name
 
     def attn(h):
-        q = L.linear_apply(p["attn"]["q"], h).reshape(b, s, cfg.n_heads, cfg.head_dim)
-        k = L.linear_apply(p["attn"]["k"], h).reshape(b, s, cfg.kv_heads, cfg.head_dim)
-        v = L.linear_apply(p["attn"]["v"], h).reshape(b, s, cfg.kv_heads, cfg.head_dim)
+        pa = p["attn"]
+        kv_dim = cfg.kv_heads * cfg.head_dim
+        if "kernel" in pa["q"]:
+            # one fused qkv matmul (the reference's c_attn / fused qkv gemm):
+            # concat of the kernels is a cheap copy next to the [tokens, d] x
+            # [d, d+2kv] matmul it enables — wider N keeps the MXU busier than
+            # three narrow matmuls. Bitwise-identical per output column.
+            wqkv = jnp.concatenate(
+                [pa["q"]["kernel"], pa["k"]["kernel"], pa["v"]["kernel"]], axis=1)
+            qkv = h @ wqkv
+            if "bias" in pa["q"]:
+                qkv = qkv + jnp.concatenate(
+                    [pa["q"]["bias"], pa["k"]["bias"], pa["v"]["bias"]])
+            q, k, v = (qkv[..., :d], qkv[..., d:d + kv_dim],
+                       qkv[..., d + kv_dim:])
+        else:  # quantized serving path keeps per-matrix dequant
+            q = L.linear_apply(pa["q"], h)
+            k = L.linear_apply(pa["k"], h)
+            v = L.linear_apply(pa["v"], h)
+        q = q.reshape(b, s, cfg.n_heads, cfg.head_dim)
+        k = k.reshape(b, s, cfg.kv_heads, cfg.head_dim)
+        v = v.reshape(b, s, cfg.kv_heads, cfg.head_dim)
         q = checkpoint_name(q, "q_proj")
         k = checkpoint_name(k, "k_proj")
         v = checkpoint_name(v, "v_proj")
         if rope is not None:
             cos, sin = rope
-            q = L.apply_rotary(q, cos, sin)
-            k = L.apply_rotary(k, cos, sin)
+            q = L.apply_rotary(q, cos, sin, cfg.rotary_dim,
+                               cfg.rotary_interleaved)
+            k = L.apply_rotary(k, cos, sin, cfg.rotary_dim,
+                               cfg.rotary_interleaved)
         n_rep = cfg.n_heads // cfg.kv_heads
         k = L._repeat_kv(k, n_rep)
         v = L._repeat_kv(v, n_rep)
@@ -248,7 +280,8 @@ def block_apply(cfg, p, x, mask=None, rope=None, alibi=None, deterministic=True,
 
     if cfg.parallel_attn_mlp:
         h = _norm_apply(cfg, p["ln_1"], x)
-        return x + maybe_drop(attn(h), 2) + maybe_drop(mlp(h), 3), aux
+        h_mlp = _norm_apply(cfg, p["ln_2"], x) if cfg.parallel_norm_split else h
+        return x + maybe_drop(attn(h), 2) + maybe_drop(mlp(h_mlp), 3), aux
     elif cfg.prenorm:
         x = x + maybe_drop(attn(_norm_apply(cfg, p["ln_1"], x)), 2)
         x = x + maybe_drop(mlp(_norm_apply(cfg, p["ln_2"], x)), 3)
@@ -411,8 +444,8 @@ class CausalLM:
             params["ln_emb"] = _norm_init(cfg)
         if not cfg.tie_embeddings:
             params["lm_head"] = L.linear_init(
-                k_head, cfg.d_model, cfg.vocab_size, ("embed", "vocab"), bias=False,
-                stddev=cfg.initializer_range,
+                k_head, cfg.d_model, cfg.vocab_size, ("embed", "vocab"),
+                bias=cfg.head_bias, stddev=cfg.initializer_range,
             )
         return params
 
@@ -444,7 +477,8 @@ class CausalLM:
 
         rope = None
         if cfg.position_embedding == "rope":
-            rope = L.rotary_embedding(positions, cfg.head_dim, cfg.rope_base)
+            rope = L.rotary_embedding(positions, cfg.rotary_dim or cfg.head_dim,
+                                      cfg.rope_base)
         alibi = None
         if cfg.position_embedding == "alibi":
             alibi = L.alibi_bias(cfg.n_heads, s, s)
@@ -470,10 +504,13 @@ class CausalLM:
         if cfg.fused_ce:
             from ..ops.cross_entropy import fused_cross_entropy
 
-            emb = params["wte"]["weight"] if cfg.tie_embeddings \
-                else params["lm_head"]["kernel"].T
+            if cfg.tie_embeddings:
+                emb, bias = params["wte"]["weight"], None
+            else:
+                emb = params["lm_head"]["kernel"].T
+                bias = params["lm_head"].get("bias")  # GPT-J biased head
             return fused_cross_entropy(
-                x.reshape(-1, cfg.d_model), emb, labels.reshape(-1))
+                x.reshape(-1, cfg.d_model), emb, labels.reshape(-1), bias)
         return cross_entropy_loss(self.head(params, x), labels)
 
     def apply(self, params, input_ids, positions=None, attention_mask=None,

@@ -119,12 +119,13 @@ class _Reader:
 # ---------------------------------------------------------------------------
 def detect_family(hf_config):
     mt = hf_config.get("model_type", "")
-    if mt in ("gpt2", "opt", "bloom", "llama"):
+    if mt in ("gpt2", "opt", "bloom", "llama", "gptj", "gpt_neox"):
         return mt
     if mt == "mistral":
         return "llama"
     raise ValueError(f"Unsupported HF model_type '{mt}' "
-                     "(supported: gpt2, opt, bloom, llama, mistral)")
+                     "(supported: gpt2, opt, bloom, llama, mistral, gptj, "
+                     "gpt_neox)")
 
 
 def config_from_hf(hf_config, **overrides):
@@ -161,6 +162,42 @@ def config_from_hf(hf_config, **overrides):
             activation="gelu", norm="layernorm", position_embedding="alibi",
             tie_embeddings=True, use_bias=True, prenorm=True, embed_layernorm=True,
             layernorm_eps=g("layer_norm_epsilon", 1e-5),
+        )
+    elif fam == "gptj":
+        # parallel attention+mlp with ONE shared layernorm; partial rotary;
+        # untied head WITH bias (reference container: containers/gptj.py)
+        d = g("n_embd")
+        kw = dict(
+            vocab_size=g("vocab_size"), max_seq_len=g("n_positions", 2048),
+            n_layers=g("n_layer"), n_heads=g("n_head"), d_model=d,
+            d_ff=g("n_inner") or 4 * d,
+            activation="gelu_new", norm="layernorm", position_embedding="rope",
+            rotary_dim=g("rotary_dim") or None, rotary_interleaved=True,
+            tie_embeddings=False, head_bias=True, use_bias=False, mlp_bias=True,
+            prenorm=True, parallel_attn_mlp=True,
+            layernorm_eps=g("layer_norm_epsilon", 1e-5),
+        )
+    elif fam == "gpt_neox":
+        # parallel residual with SEPARATE norms; partial rotary via rotary_pct
+        # (reference container: containers/gptneox.py)
+        d = g("hidden_size")
+        hd = d // g("num_attention_heads")
+        kw = dict(
+            vocab_size=g("vocab_size"), max_seq_len=g("max_position_embeddings", 2048),
+            n_layers=g("num_hidden_layers"), n_heads=g("num_attention_heads"),
+            d_model=d, d_ff=g("intermediate_size"),
+            # HF NeoX "gelu" is the exact erf form, not the tanh approximation
+            activation={"gelu": "gelu_exact", "gelu_new": "gelu_new",
+                        "gelu_fast": "gelu_new",
+                        "relu": "relu"}[g("hidden_act", "gelu")],
+            norm="layernorm", position_embedding="rope",
+            rope_base=g("rotary_emb_base", 10000.0),
+            rotary_dim=int(hd * g("rotary_pct", 1.0)) or None,
+            tie_embeddings=g("tie_word_embeddings", False), use_bias=True,
+            prenorm=True,
+            parallel_attn_mlp=g("use_parallel_residual", True),
+            parallel_norm_split=g("use_parallel_residual", True),
+            layernorm_eps=g("layer_norm_eps", 1e-5),
         )
     else:  # llama / mistral
         kw = dict(
@@ -289,8 +326,57 @@ def _llama_block(r, cfg, i):
     }
 
 
+def _identity_ln(d):
+    return {"scale": np.ones((d,), np.float32),
+            "bias": np.zeros((d,), np.float32)}
+
+
+def _gptj_block(r, cfg, i):
+    # parallel block with one shared LN: our tree still carries ln_2 (unused in
+    # the shared-LN parallel path) — fill it with the identity
+    p = f"transformer.h.{i}"
+    return {
+        "ln_1": _ln(r, f"{p}.ln_1"),
+        "attn": {
+            "q": _linear_t(r, f"{p}.attn.q_proj", bias=False),
+            "k": _linear_t(r, f"{p}.attn.k_proj", bias=False),
+            "v": _linear_t(r, f"{p}.attn.v_proj", bias=False),
+            "o": _linear_t(r, f"{p}.attn.out_proj", bias=False),
+        },
+        "ln_2": _identity_ln(cfg.d_model),
+        "mlp": {
+            "fc": _linear_t(r, f"{p}.mlp.fc_in"),
+            "proj": _linear_t(r, f"{p}.mlp.fc_out"),
+        },
+    }
+
+
+def _neox_block(r, cfg, i):
+    # NeoX fuses qkv with BLOOM-style per-head (q,k,v) row interleaving
+    p = f"gpt_neox.layers.{i}"
+    d, h = cfg.d_model, cfg.n_heads
+    hd = d // h
+    w = r.get(f"{p}.attention.query_key_value.weight").reshape(h, 3, hd, d)
+    b = r.get(f"{p}.attention.query_key_value.bias").reshape(h, 3, hd)
+    mk = lambda j: {"kernel": np.ascontiguousarray(w[:, j].reshape(d, d).T),
+                    "bias": b[:, j].reshape(d)}
+    return {
+        "ln_1": _ln(r, f"{p}.input_layernorm"),
+        "attn": {
+            "q": mk(0), "k": mk(1), "v": mk(2),
+            "o": _linear_t(r, f"{p}.attention.dense"),
+        },
+        "ln_2": _ln(r, f"{p}.post_attention_layernorm"),
+        "mlp": {
+            "fc": _linear_t(r, f"{p}.mlp.dense_h_to_4h"),
+            "proj": _linear_t(r, f"{p}.mlp.dense_4h_to_h"),
+        },
+    }
+
+
 _BLOCK_FNS = {"gpt2": _gpt2_block, "opt": _opt_block, "bloom": _bloom_block,
-              "llama": _llama_block}
+              "llama": _llama_block, "gptj": _gptj_block,
+              "gpt_neox": _neox_block}
 
 
 def _first(r, *names):
@@ -320,6 +406,18 @@ def _top_level(r, cfg, fam):
         params["wte"] = {"weight": r.get(pre + "word_embeddings.weight")}
         params["ln_emb"] = _ln(r, pre + "word_embeddings_layernorm")
         params["ln_f"] = _ln(r, pre + "ln_f")
+    elif fam == "gptj":
+        params["wte"] = {"weight": r.get("transformer.wte.weight")}
+        params["ln_f"] = _ln(r, "transformer.ln_f")
+        params["lm_head"] = {
+            "kernel": np.ascontiguousarray(r.get("lm_head.weight").T),
+            "bias": r.get("lm_head.bias")}
+    elif fam == "gpt_neox":
+        params["wte"] = {"weight": r.get("gpt_neox.embed_in.weight")}
+        params["ln_f"] = _ln(r, "gpt_neox.final_layer_norm")
+        if not cfg.tie_embeddings:
+            params["lm_head"] = {
+                "kernel": np.ascontiguousarray(r.get("embed_out.weight").T)}
     else:  # llama
         params["wte"] = {"weight": r.get("model.embed_tokens.weight")}
         params["ln_f"] = _ln(r, "model.norm", rms=True)

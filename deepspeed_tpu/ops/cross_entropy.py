@@ -40,22 +40,32 @@ def _pad_emb(emb, padded_vocab):
     return jnp.pad(emb, ((0, padded_vocab - vocab), (0, 0)))
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def fused_cross_entropy(x, emb, labels, ignore_index=-100, n_chunks=8):
-    """Token-mean CE of ``softmax(x @ emb^T)`` against ``labels``.
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def fused_cross_entropy(x, emb, labels, bias=None, ignore_index=-100,
+                        n_chunks=8):
+    """Token-mean CE of ``softmax(x @ emb^T + bias)`` against ``labels``.
 
-    x: [tokens, d] (compute dtype); emb: [V, d]; labels: [tokens] int
-    (``ignore_index`` entries masked out). Returns a scalar fp32 loss.
+    x: [tokens, d] (compute dtype); emb: [V, d]; ``bias``: optional [V] logit
+    bias (GPT-J-style biased LM head); labels: [tokens] int (``ignore_index``
+    entries masked out). Returns a scalar fp32 loss.
     """
-    loss, _ = _ce_fwd_impl(x, emb, labels, ignore_index, n_chunks)
+    loss, _ = _ce_fwd_impl(x, emb, labels, bias, ignore_index, n_chunks)
     return loss
 
 
-def _ce_fwd_impl(x, emb, labels, ignore_index, n_chunks):
+def _pad_bias(bias, padded_vocab):
+    if bias is None or padded_vocab == bias.shape[0]:
+        return bias
+    return jnp.pad(bias, (0, padded_vocab - bias.shape[0]))
+
+
+def _ce_fwd_impl(x, emb, labels, bias, ignore_index, n_chunks):
     tokens, d = x.shape
     vocab = emb.shape[0]
     nc, chunk, padded = _chunking(vocab, n_chunks)
     emb_c = _pad_emb(emb, padded).reshape(nc, chunk, d)
+    bias_c = None if bias is None \
+        else _pad_bias(bias, padded).reshape(nc, chunk)
     starts = jnp.arange(nc, dtype=jnp.int32) * chunk
 
     valid = labels != ignore_index
@@ -63,11 +73,13 @@ def _ce_fwd_impl(x, emb, labels, ignore_index, n_chunks):
 
     def body(carry, inp):
         m, s, lab_logit = carry
-        e_c, c0 = inp
+        e_c, b_c, c0 = inp
         logits = jax.lax.dot_general(
             x, e_c.astype(x.dtype), (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )  # [tokens, chunk]
+        if b_c is not None:
+            logits = logits + b_c.astype(jnp.float32)[None, :]
         if padded != vocab:
             # padded (fake-vocab) columns must not contribute to the logsumexp
             col = c0 + jnp.arange(chunk, dtype=jnp.int32)[None, :]
@@ -84,7 +96,8 @@ def _ce_fwd_impl(x, emb, labels, ignore_index, n_chunks):
     m0 = jnp.full((tokens,), -jnp.inf, jnp.float32)
     s0 = jnp.zeros((tokens,), jnp.float32)
     ll0 = jnp.zeros((tokens,), jnp.float32)
-    (m, s, lab_logit), _ = jax.lax.scan(body, (m0, s0, ll0), (emb_c, starts))
+    (m, s, lab_logit), _ = jax.lax.scan(body, (m0, s0, ll0),
+                                        (emb_c, bias_c, starts))
 
     lse = m + jnp.log(s)
     n_valid = jnp.maximum(jnp.sum(valid), 1)
@@ -92,17 +105,20 @@ def _ce_fwd_impl(x, emb, labels, ignore_index, n_chunks):
     return loss, (lse, n_valid)
 
 
-def _ce_vjp_fwd(x, emb, labels, ignore_index, n_chunks):
-    loss, (lse, n_valid) = _ce_fwd_impl(x, emb, labels, ignore_index, n_chunks)
-    return loss, (x, emb, labels, lse, n_valid)
+def _ce_vjp_fwd(x, emb, labels, bias, ignore_index, n_chunks):
+    loss, (lse, n_valid) = _ce_fwd_impl(x, emb, labels, bias, ignore_index,
+                                        n_chunks)
+    return loss, (x, emb, labels, bias, lse, n_valid)
 
 
 def _ce_vjp_bwd(ignore_index, n_chunks, residuals, g):
-    x, emb, labels, lse, n_valid = residuals
+    x, emb, labels, bias, lse, n_valid = residuals
     tokens, d = x.shape
     vocab = emb.shape[0]
     nc, chunk, padded = _chunking(vocab, n_chunks)
     emb_c = _pad_emb(emb, padded).reshape(nc, chunk, d)
+    bias_c = None if bias is None \
+        else _pad_bias(bias, padded).reshape(nc, chunk)
     starts = jnp.arange(nc, dtype=jnp.int32) * chunk
 
     valid = labels != ignore_index
@@ -110,11 +126,13 @@ def _ce_vjp_bwd(ignore_index, n_chunks, residuals, g):
     coef = (g / n_valid.astype(jnp.float32)) * valid.astype(jnp.float32)  # [tokens]
 
     def body(dx_acc, inp):
-        e_c, c0 = inp
+        e_c, b_c, c0 = inp
         logits = jax.lax.dot_general(
             x, e_c.astype(x.dtype), (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )  # [tokens, chunk]
+        if b_c is not None:
+            logits = logits + b_c.astype(jnp.float32)[None, :]
         p = jnp.exp(logits - lse[:, None])
         if padded != vocab:
             col = c0 + jnp.arange(chunk, dtype=jnp.int32)[None, :]
@@ -133,12 +151,15 @@ def _ce_vjp_bwd(ignore_index, n_chunks, residuals, g):
             dl16, x, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )  # [chunk, d]
-        return dx_acc, de_c
+        db_c = jnp.sum(dlogits, axis=0)  # [chunk]
+        return dx_acc, (de_c, db_c)
 
     dx0 = jnp.zeros((tokens, d), jnp.float32)
-    dx, de = jax.lax.scan(body, dx0, (emb_c, starts))
+    dx, (de, db) = jax.lax.scan(body, dx0, (emb_c, bias_c, starts))
     de = de.reshape(padded, d)[:vocab]
-    return dx.astype(x.dtype), de.astype(emb.dtype), None
+    dbias = None if bias is None \
+        else db.reshape(padded)[:vocab].astype(bias.dtype)
+    return dx.astype(x.dtype), de.astype(emb.dtype), None, dbias
 
 
 fused_cross_entropy.defvjp(_ce_vjp_fwd, _ce_vjp_bwd)

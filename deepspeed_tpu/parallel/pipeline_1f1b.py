@@ -101,7 +101,8 @@ def build_1f1b_train_step(model, mesh, n_microbatches):
             mask = Lyr.causal_mask(s, s) & attention_mask[:, None, None, :].astype(bool)
             side["mask"] = mask
         if cfg.position_embedding == "rope":
-            cos, sin = Lyr.rotary_embedding(positions, cfg.head_dim, cfg.rope_base)
+            cos, sin = Lyr.rotary_embedding(
+                positions, cfg.rotary_dim or cfg.head_dim, cfg.rope_base)
             side["rope_cos"], side["rope_sin"] = cos, sin
         if cfg.position_embedding == "alibi":
             static_side["_alibi_const"] = Lyr.alibi_bias(cfg.n_heads, s, s)

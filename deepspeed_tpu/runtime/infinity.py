@@ -108,7 +108,8 @@ class InfinityParamEngine:
         rope_tables = None
         if has_rope:
             pos = jnp.arange(seq_len)[None, :]
-            rope_tables = L.rotary_embedding(pos, cfg.head_dim, cfg.rope_base)
+            rope_tables = L.rotary_embedding(
+                pos, cfg.rotary_dim or cfg.head_dim, cfg.rope_base)
         alibi_const = (L.alibi_bias(cfg.n_heads, seq_len, seq_len)
                        if cfg.position_embedding == "alibi" else None)
 

@@ -23,7 +23,7 @@ def test_fused_ce_matches_naive(n_chunks):
     x, emb, labels = _setup()
     logits = (x @ emb.T)[None]  # [1, T, V]
     ref = cross_entropy_loss(logits, labels[None])
-    out = fused_cross_entropy(x, emb, labels, -100, n_chunks)
+    out = fused_cross_entropy(x, emb, labels, None, -100, n_chunks)
     np.testing.assert_allclose(float(ref), float(out), rtol=1e-5)
 
 
@@ -34,7 +34,7 @@ def test_fused_ce_grads_match():
         return cross_entropy_loss((x @ emb.T)[None], labels[None])
 
     def fused_loss(x, emb):
-        return fused_cross_entropy(x, emb, labels, -100, 4)
+        return fused_cross_entropy(x, emb, labels, None, -100, 4)
 
     gx_r, ge_r = jax.grad(ref_loss, argnums=(0, 1))(x, emb)
     gx_f, ge_f = jax.grad(fused_loss, argnums=(0, 1))(x, emb)
@@ -63,7 +63,7 @@ def test_fused_ce_vocab_not_divisible():
     x, emb, labels = _setup(vocab=50, seed=7)
     logits = (x @ emb.T)[None]
     ref = cross_entropy_loss(logits, labels[None])
-    out = fused_cross_entropy(x, emb, labels, -100, 8)
+    out = fused_cross_entropy(x, emb, labels, None, -100, 8)
     np.testing.assert_allclose(float(ref), float(out), rtol=1e-5)
 
 
@@ -79,14 +79,35 @@ def test_fused_ce_prime_vocab_stays_chunked():
     x, emb, labels = _setup(vocab=97, seed=11)
     logits = (x @ emb.T)[None]
     ref = cross_entropy_loss(logits, labels[None])
-    out = fused_cross_entropy(x, emb, labels, -100, 8)
+    out = fused_cross_entropy(x, emb, labels, None, -100, 8)
     np.testing.assert_allclose(float(ref), float(out), rtol=1e-5)
 
     gx_r, ge_r = jax.grad(
         lambda x, e: cross_entropy_loss((x @ e.T)[None], labels[None]),
         argnums=(0, 1))(x, emb)
     gx_f, ge_f = jax.grad(
-        lambda x, e: fused_cross_entropy(x, e, labels, -100, 8),
+        lambda x, e: fused_cross_entropy(x, e, labels, None, -100, 8),
         argnums=(0, 1))(x, emb)
     np.testing.assert_allclose(np.asarray(gx_r), np.asarray(gx_f), rtol=2e-4, atol=1e-6)
     np.testing.assert_allclose(np.asarray(ge_r), np.asarray(ge_f), rtol=2e-4, atol=1e-6)
+
+
+def test_fused_ce_with_head_bias_matches_naive():
+    """GPT-J-style biased LM head: loss AND all grads (incl. dbias) match."""
+    x, emb, labels = _setup(seed=21)
+    rng = np.random.RandomState(22)
+    bias = jnp.asarray(rng.randn(emb.shape[0]) * 0.3, jnp.float32)
+
+    def ref(x, emb, bias):
+        return cross_entropy_loss((x @ emb.T + bias)[None], labels[None])
+
+    def fused(x, emb, bias):
+        return fused_cross_entropy(x, emb, labels, bias, -100, 4)
+
+    np.testing.assert_allclose(float(ref(x, emb, bias)),
+                               float(fused(x, emb, bias)), rtol=1e-5)
+    g_r = jax.grad(ref, argnums=(0, 1, 2))(x, emb, bias)
+    g_f = jax.grad(fused, argnums=(0, 1, 2))(x, emb, bias)
+    for a, b_ in zip(g_r, g_f):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=2e-4, atol=1e-6)

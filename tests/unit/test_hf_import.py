@@ -101,3 +101,59 @@ def test_init_inference_from_path_generates(tmp_path, devices8):
 
     np.testing.assert_array_equal(out1, out2)
     assert out1.shape == (2, 14)
+
+
+def test_gptj_import_parity(tmp_path):
+    cfg = transformers.GPTJConfig(
+        n_layer=2, n_head=4, n_embd=32, vocab_size=96, n_positions=64,
+        rotary_dim=4)
+    _seed()
+    hf = transformers.GPTJForCausalLM(cfg).eval()
+    ids = np.random.RandomState(5).randint(0, 96, (2, 10))
+    _parity(_save(tmp_path, hf), hf, ids)
+
+
+def test_gpt_neox_import_parity(tmp_path):
+    cfg = transformers.GPTNeoXConfig(
+        num_hidden_layers=2, num_attention_heads=4, hidden_size=32,
+        intermediate_size=64, vocab_size=96, max_position_embeddings=64,
+        rotary_pct=0.5, use_parallel_residual=True)
+    _seed()
+    hf = transformers.GPTNeoXForCausalLM(cfg).eval()
+    ids = np.random.RandomState(6).randint(0, 96, (1, 12))
+    _parity(_save(tmp_path, hf), hf, ids)
+
+
+@pytest.mark.parametrize("family", ["gptj", "gpt_neox"])
+def test_decode_path_matches_full_forward(tmp_path, family, devices8):
+    """The KV-cache decode path (partial/interleaved rotary at pos>0, split-
+    norm parallel residual) must reproduce the teacher-forced argmax of the
+    full forward — pins generate() to apply() per family."""
+    import deepspeed_tpu
+
+    if family == "gptj":
+        cfg = transformers.GPTJConfig(n_layer=2, n_head=4, n_embd=32,
+                                      vocab_size=96, n_positions=64,
+                                      rotary_dim=4)
+        _seed()
+        hf = transformers.GPTJForCausalLM(cfg)
+    else:
+        cfg = transformers.GPTNeoXConfig(
+            num_hidden_layers=2, num_attention_heads=4, hidden_size=32,
+            intermediate_size=64, vocab_size=96, max_position_embeddings=64,
+            rotary_pct=0.5)
+        _seed()
+        hf = transformers.GPTNeoXForCausalLM(cfg)
+    path = _save(tmp_path, hf)
+    eng = deepspeed_tpu.init_inference(path, dtype="float32", max_tokens=64)
+
+    ids = np.random.RandomState(7).randint(0, 96, (2, 6)).astype(np.int32)
+    out = np.asarray(eng.generate(ids, max_new_tokens=6, greedy=True))
+
+    # teacher-forced argmax through the NON-cached forward
+    cur = jnp.asarray(ids)
+    for _ in range(6):
+        logits = eng.forward(cur)
+        nxt = np.asarray(jnp.argmax(logits[:, -1], axis=-1))[:, None]
+        cur = jnp.concatenate([cur, jnp.asarray(nxt, jnp.int32)], axis=1)
+    np.testing.assert_array_equal(out, np.asarray(cur))
