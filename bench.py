@@ -76,10 +76,8 @@ def main():
     batch = {"input_ids": rng.randint(0, cfg.vocab_size, (batch_size, seq_len)).astype(np.int32)}
 
     def one_step():
-        loss = engine.forward(batch)
-        engine.backward(loss)
-        engine.step()
-        return loss
+        # fused path: fwd+bwd+optimizer in ONE device dispatch (engine.train_batch)
+        return engine.train_batch(batch=batch)
 
     def sync():
         # On the axon-tunneled platform block_until_ready doesn't actually block;

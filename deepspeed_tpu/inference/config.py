@@ -31,6 +31,10 @@ class DeepSpeedInferenceConfig(ConfigModel):
     max_tokens: int = 1024          # reference max_out_tokens
     min_tokens: int = 1
     max_batch_size: int = 8
+    # generate() pads prompts up to a multiple of this, so serving compiles one
+    # program per LENGTH BUCKET instead of one per distinct prompt length
+    # (recompile-free TTFT for varying prompts). 1 disables bucketing.
+    prompt_bucket_size: int = 64
     quant: QuantizationConfig = None
     replace_with_kernel_inject: bool = False  # accepted for config compat; no-op
     seed: int = 0

@@ -206,21 +206,37 @@ class InferenceEngine:
         # IS greedy (and must stay exact argmax, not logits/1e-6 + noise).
         if isinstance(temperature, (int, float)) and temperature == 0.0:
             greedy = True
-        key = (b, prompt_len, max_new_tokens, bool(greedy), int(top_k))
+
+        # Prompt-length BUCKETING: right-pad the prompt to the next bucket and
+        # pass the true length as a traced scalar, so a TTFT-critical serving
+        # loop compiles once per bucket, not once per distinct prompt length.
+        bucket = max(int(self._config.prompt_bucket_size), 1)
+        padded_len = min(-(-prompt_len // bucket) * bucket,
+                         self._config.max_tokens - max_new_tokens)
+        padded_len = max(padded_len, prompt_len)
+        max_len = padded_len + max_new_tokens
+        if padded_len > prompt_len:
+            ids_in = jnp.pad(input_ids, ((0, 0), (0, padded_len - prompt_len)))
+        else:
+            ids_in = input_ids
+        true_len = jnp.asarray(prompt_len, jnp.int32)
+
+        key = (b, padded_len, max_new_tokens, bool(greedy), int(top_k))
         if key not in self._prefill_cache:
             from ..models.decoding import decode_tokens, prefill_and_first_token
 
             model = self.module
 
-            def prefill(params, ids, rng, temperature):
+            def prefill(params, ids, rng, temperature, true_len):
                 return prefill_and_first_token(
                     model, params, ids, rng, temperature, max_len=max_len,
-                    greedy=greedy, top_k=top_k, dtype=self.dtype)
+                    greedy=greedy, top_k=top_k, dtype=self.dtype,
+                    true_len=true_len)
 
-            def decode(params, cache, tok, rng, temperature):
+            def decode(params, cache, tok, rng, temperature, true_len):
                 return decode_tokens(
                     model, params, cache, tok, rng, temperature,
-                    prompt_len=prompt_len, max_len=max_len,
+                    prompt_len=true_len, max_len=max_len,
                     steps=max_new_tokens - 1, greedy=greedy, top_k=top_k)
 
             with self.mesh:
@@ -234,10 +250,10 @@ class InferenceEngine:
         prefill_fn, decode_fn = self._prefill_cache[key]
         rng, r1, r2 = jax.random.split(rng, 3)
         temp = jnp.asarray(temperature, jnp.float32)
-        first, cache = prefill_fn(self.params, input_ids, r1, temp)
+        first, cache = prefill_fn(self.params, ids_in, r1, temp, true_len)
         out = [input_ids, first[:, None]]
         if max_new_tokens > 1:
-            toks = decode_fn(self.params, cache, first, r2, temp)  # [steps, b]
+            toks = decode_fn(self.params, cache, first, r2, temp, true_len)  # [steps, b]
             out.append(jnp.transpose(toks))
         result = jnp.concatenate(out, axis=1)
         if eos_token_id is not None:

@@ -186,14 +186,27 @@ def sample_token(logits, rng, *, temperature=1.0, top_k=0, greedy=False):
 
 
 def prefill_and_first_token(model, params, ids, rng, temperature, *, max_len,
-                            greedy, top_k, dtype):
+                            greedy, top_k, dtype, true_len=None):
     """Prefill the KV cache with the prompt and sample the first new token.
     Shared by the serving engine and the hybrid (RLHF) engine — one
-    implementation of the rollout math, two jit wrappers."""
+    implementation of the rollout math, two jit wrappers.
+
+    ``true_len`` (traced scalar) supports right-padded bucketed prompts: the
+    first token is sampled at column ``true_len - 1`` instead of the last
+    column. Pad slots beyond ``true_len`` hold garbage k/v but always sit in
+    the causally-masked future of every real query, and the decode loop
+    overwrites each one exactly when its position enters the window — so no
+    mask tensor is needed (the serving engine recompiles per prompt LENGTH
+    BUCKET, not per length; cf. the reference re-using one CUDA workspace
+    across lengths)."""
     b, prompt_len = ids.shape
     cache = init_cache(model.config, b, max_len, dtype)
     logits, cache = forward_with_cache(model, params, ids, cache, 0, max_len)
-    tok = sample_token(logits[:, prompt_len - 1], rng, temperature=temperature,
+    if true_len is None:
+        last = logits[:, prompt_len - 1]
+    else:
+        last = jax.lax.dynamic_slice_in_dim(logits, true_len - 1, 1, axis=1)[:, 0]
+    tok = sample_token(last, rng, temperature=temperature,
                        top_k=top_k, greedy=greedy)
     return tok, cache
 

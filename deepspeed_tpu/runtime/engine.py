@@ -208,6 +208,7 @@ class DeepSpeedEngine:
         self._fwd_bwd_fn = None
         self._accumulate_fn = None
         self._apply_fn = None
+        self._train_step_fn = None
         self._eval_fn = None
 
         log_dist(
@@ -340,9 +341,9 @@ class DeepSpeedEngine:
     # ------------------------------------------------------------------------------
     # compiled programs
     # ------------------------------------------------------------------------------
-    def _build_fwd_bwd(self):
-        gas = self.gradient_accumulation_steps_
-
+    def _use_1f1b(self, warn=False):
+        """Single source of truth for 1F1B eligibility (used by the fwd_bwd
+        builder AND the fused-step gate — they must never disagree)."""
         use_1f1b = (self.pipe_stages > 1
                     and self._config.pipeline.schedule == "1f1b"
                     and isinstance(self.params, dict) and "blocks" in self.params)
@@ -352,12 +353,18 @@ class DeepSpeedEngine:
             # stage-varying lax.cond branches (deadlock at runtime). Until that
             # is fixed upstream, TP x PP meshes take the GPipe schedule — same
             # numerics, activation footprint O(microbatches).
-            logger.warning(
-                "pipeline schedule '1f1b' is not supported with tensor "
-                "parallelism (mesh model=%d); falling back to gpipe",
-                self.mp_world_size)
+            if warn:
+                logger.warning(
+                    "pipeline schedule '1f1b' is not supported with tensor "
+                    "parallelism (mesh model=%d); falling back to gpipe",
+                    self.mp_world_size)
             use_1f1b = False
-        if use_1f1b:
+        return use_1f1b
+
+    def _build_fwd_bwd(self):
+        gas = self.gradient_accumulation_steps_
+
+        if self._use_1f1b(warn=True):
             # 1F1B: the whole microbatch window (fwd AND bwd, interleaved) is one
             # compiled schedule — in-flight activations bounded by stages, not
             # microbatches (reference runtime/pipe/schedule.py:189 TrainSchedule).
@@ -397,37 +404,43 @@ class DeepSpeedEngine:
                 accumulate, donate_argnums=(0,), out_shardings=self._grad_shardings
             )
 
-    def _build_apply(self):
+    def _apply_body(self, params, opt_state, acc_grads, scale, good_steps, lr):
+        """Unscale -> overflow check -> clip -> optimizer update -> loss-scale
+        update. Shared by the standalone apply program and the fused train step."""
         clip = self._config.gradient_clipping
         fp16 = self.fp16_enabled
         window = self._config.fp16.loss_scale_window
         min_scale = self._config.fp16.min_loss_scale
         dynamic = (self._scaler_meta or {}).get("_dynamic", False)
 
-        def apply_step(params, opt_state, acc_grads, scale, good_steps, lr):
-            inv = (1.0 / scale).astype(jnp.float32)
-            grads = jax.tree_util.tree_map(lambda g: g.astype(jnp.float32) * inv, acc_grads)
-            overflow = check_overflow(grads) if fp16 else jnp.asarray(False)
-            norm = global_grad_norm(grads)
-            if clip > 0:
-                grads, _ = clip_grads_by_global_norm(grads, clip, norm=norm)
-            new_params, new_state = self.optimizer.update(
-                grads, opt_state, params, lr=lr, wd_mask=self._wd_mask
+        inv = (1.0 / scale).astype(jnp.float32)
+        grads = jax.tree_util.tree_map(lambda g: g.astype(jnp.float32) * inv, acc_grads)
+        overflow = check_overflow(grads) if fp16 else jnp.asarray(False)
+        norm = global_grad_norm(grads)
+        if clip > 0:
+            grads, _ = clip_grads_by_global_norm(grads, clip, norm=norm)
+        new_params, new_state = self.optimizer.update(
+            grads, opt_state, params, lr=lr, wd_mask=self._wd_mask
+        )
+        if fp16:
+            # skip the update on overflow (reference FP16_Optimizer.step)
+            new_params = jax.tree_util.tree_map(
+                lambda old, new: jnp.where(overflow, old, new), params, new_params
             )
-            if fp16:
-                # skip the update on overflow (reference FP16_Optimizer.step)
-                new_params = jax.tree_util.tree_map(
-                    lambda old, new: jnp.where(overflow, old, new), params, new_params
+            new_state = jax.tree_util.tree_map(
+                lambda old, new: jnp.where(overflow, old, new), opt_state, new_state
+            )
+            if dynamic:
+                scale, good_steps = update_scale(
+                    scale, good_steps, overflow, loss_scale_window=window,
+                    min_scale=min_scale,
                 )
-                new_state = jax.tree_util.tree_map(
-                    lambda old, new: jnp.where(overflow, old, new), opt_state, new_state
-                )
-                if dynamic:
-                    scale, good_steps = update_scale(
-                        scale, good_steps, overflow, loss_scale_window=window,
-                        min_scale=min_scale,
-                    )
-            return new_params, new_state, scale, good_steps, overflow, norm
+        return new_params, new_state, scale, good_steps, overflow, norm
+
+    def _build_apply(self):
+        def apply_step(params, opt_state, acc_grads, scale, good_steps, lr):
+            return self._apply_body(params, opt_state, acc_grads, scale,
+                                    good_steps, lr)
 
         # Donate params + opt state only: grads (arg 2) have the same
         # shapes/dtypes as the params but there are only len(outputs) buffers to
@@ -447,6 +460,114 @@ class DeepSpeedEngine:
                     NamedSharding(self.mesh, P()),
                 ),
             )
+
+    def _build_train_step(self):
+        """The whole optimizer step as ONE compiled program: grad-accum loop
+        (lax.scan over stacked micro-batches), in-program rng split, optimizer
+        apply — params/opt-state donated through. The reference pays a Python
+        round-trip per micro-batch plus one per step (``engine.py:1634/:1775/:1971``);
+        here ``train_batch`` is a single device dispatch, which also removes the
+        grads' HBM round-trip between the backward and the update."""
+        gas = self.gradient_accumulation_steps_
+
+        def train_step(params, opt_state, batches, scale, good_steps, rng, lr):
+            new_rng, step_rng = jax.random.split(rng)
+
+            def scaled_loss(p, batch, r):
+                loss = self.module.loss(p, batch, deterministic=False, dropout_rng=r)
+                return loss * scale.astype(loss.dtype) / gas, loss
+
+            grad_fn = jax.value_and_grad(scaled_loss, has_aux=True)
+            constrain = lambda g: jax.lax.with_sharding_constraint(
+                g, self._grad_shardings)  # ZeRO-2: grads sharded over data
+            if gas == 1:
+                (_, loss), grads = grad_fn(params, batches, step_rng)
+                grads = constrain(grads)
+                mean_loss = loss
+            else:
+                micro_rngs = jax.random.split(step_rng, gas)
+                zeros = constrain(jax.tree_util.tree_map(
+                    lambda p: jnp.zeros(p.shape, p.dtype), params))
+
+                def body(acc, xs):
+                    micro, r = xs
+                    (_, loss), g = grad_fn(params, micro, r)
+                    acc = constrain(jax.tree_util.tree_map(jnp.add, acc, g))
+                    return acc, loss
+
+                grads, losses = jax.lax.scan(body, zeros, (batches, micro_rngs))
+                mean_loss = jnp.mean(losses)
+
+            (new_params, new_state, scale, good_steps,
+             overflow, norm) = self._apply_body(params, opt_state, grads, scale,
+                                                good_steps, lr)
+            return (new_params, new_state, scale, good_steps, overflow, norm,
+                    mean_loss, new_rng)
+
+        rep = NamedSharding(self.mesh, P())
+        with self.mesh:
+            self._train_step_fn = jax.jit(
+                train_step,
+                donate_argnums=(0, 1),
+                out_shardings=(self.param_shardings, self._opt_shardings,
+                               rep, rep, rep, rep, rep, rep),
+            )
+
+    def _can_fuse_train_step(self):
+        """One-dispatch train_batch: anything but the offloaded (host-step) path
+        and the 1F1B schedule (whose fwd+bwd program has its own contract)."""
+        return self._offloaded is None and not self._use_1f1b()
+
+    def _fused_train_batch(self, micros):
+        if self._train_step_fn is None:
+            self._build_train_step()
+        gas = self.gradient_accumulation_steps_
+        if gas == 1:
+            batches = self._shard_batch(micros[0])
+        else:
+            data_size = self.mesh.shape[DATA_AXIS]
+            stacked = {}
+            keys = micros[0].keys()
+            for k in keys:
+                stacked[k] = np.stack([np.asarray(m[k]) for m in micros])
+                if stacked[k].ndim >= 2 and stacked[k].shape[1] % data_size:
+                    raise ConfigError(
+                        f"Batch leaf '{k}' has {stacked[k].shape[1]} rows, not "
+                        f"divisible by the data-parallel mesh axis ({data_size}); "
+                        f"global micro-batch must be a multiple of dp size")
+            shapes = {k: tuple(v.shape[1:]) for k, v in stacked.items()}
+            specs = batch_partition_specs(shapes, self.mesh)
+            shardings = {
+                k: NamedSharding(self.mesh, P(None, *specs[k]))
+                for k in keys
+            }
+            batches = {k: jax.device_put(jnp.asarray(stacked[k]), shardings[k])
+                       for k in keys}
+        lr = self._current_lr()
+        (self.params, self.optimizer_state, self._scale, self._good_steps,
+         overflow, grad_norm, mean_loss, self._rng) = self._train_step_fn(
+            self.params, self.optimizer_state, batches, self._scale,
+            self._good_steps, self._rng, jnp.asarray(lr, jnp.float32),
+        )
+        self.micro_steps += gas
+        self.global_steps += 1
+        if self.fp16_enabled and bool(overflow):
+            self.skipped_steps += 1
+            log_dist(
+                f"step {self.global_steps}: fp16 overflow, skipping update "
+                f"(loss scale -> {float(self._scale)})",
+                ranks=[0],
+            )
+        elif self.lr_scheduler is not None:
+            self.lr_scheduler.step()
+        if self.global_steps % self._config.steps_per_print == 0:
+            self.monitor.write_events(
+                [("Train/lr", lr, self.global_steps),
+                 ("Train/grad_norm", float(grad_norm), self.global_steps),
+                 ("Train/loss", float(mean_loss), self.global_steps)]
+            )
+            self._report_progress()
+        return mean_loss
 
     # ------------------------------------------------------------------------------
     # data placement
@@ -612,22 +733,31 @@ class DeepSpeedEngine:
 
     def train_batch(self, data_iter=None, batch=None):
         """Full accumulation window in one call (reference PipelineEngine.train_batch
-        shape). Feeds ``gradient_accumulation_steps`` micro-batches."""
+        shape). Feeds ``gradient_accumulation_steps`` micro-batches. On the main
+        path this is ONE device dispatch (see ``_build_train_step``); the returned
+        loss is a device scalar — not synced — so back-to-back calls pipeline.
+        Exception: fp16's dynamic loss scaling must read the overflow flag each
+        step (as the reference's ``FP16_Optimizer.step`` does), which syncs;
+        the pipelining guarantee holds for bf16/fp32.
+        """
         self.tput_timer.start()
-        losses = []
+        micros = []
         for _ in range(self.gradient_accumulation_steps_):
-            if batch is not None:
-                micro = batch
-            else:
-                micro = next(data_iter)
+            micros.append(batch if batch is not None else next(data_iter))
+        if self._can_fuse_train_step():
+            mean_loss = self._fused_train_batch(micros)
+            self.tput_timer.stop(global_step=True)
+            return mean_loss
+        losses = []
+        for micro in micros:
             loss = self.forward(micro)
             self.backward(loss)
             losses.append(loss)
         self.step()
         self.tput_timer.stop(global_step=True)
-        mean_loss = float(jnp.mean(jnp.stack(losses)))
+        mean_loss = jnp.mean(jnp.stack(losses)) if len(losses) > 1 else losses[0]
         if self.global_steps % self._config.steps_per_print == 0:
-            self.monitor.write_events([("Train/loss", mean_loss, self.global_steps)])
+            self.monitor.write_events([("Train/loss", float(mean_loss), self.global_steps)])
             self._report_progress()
         return mean_loss
 

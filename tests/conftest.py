@@ -21,6 +21,14 @@ import jax  # noqa: E402
 # which overrides the env var — force CPU at the config level before backend init.
 jax.config.update("jax_platforms", "cpu")
 
+# Persistent compilation cache: the suite compiles hundreds of small SPMD
+# programs (this box has ONE core); identical programs across runs hit the disk
+# cache instead of recompiling, cutting repeat wall-clock by minutes.
+jax.config.update("jax_compilation_cache_dir",
+                  os.path.join(os.path.dirname(__file__), ".jax_cache"))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+
 import pytest  # noqa: E402
 
 

@@ -279,3 +279,34 @@ def test_int8_engine_loads_fp_checkpoint(tmp_path, devices8):
     ids = batch["input_ids"][:2, :8]
     out = ie.generate(ids, max_new_tokens=4, greedy=True)
     assert out.shape == (2, 12)
+
+
+def test_prompt_length_bucketing_one_compile():
+    """Prompts of different lengths within one bucket share ONE compiled
+    prefill/decode pair, and bucketed output == unbucketed output (the pad
+    slots never leak into real positions)."""
+    import deepspeed_tpu
+    from deepspeed_tpu.models import get_model
+    import jax.numpy as jnp
+    import numpy as np
+
+    kw = dict(vocab_size=128, max_seq_len=64, compute_dtype=jnp.float32)
+    model = get_model("gpt2", "tiny", **kw)
+    eng = deepspeed_tpu.init_inference(model, dtype="float32", max_tokens=64,
+                                       prompt_bucket_size=16)
+    raw = deepspeed_tpu.init_inference(model, dtype="float32", max_tokens=64,
+                                       prompt_bucket_size=1)
+    raw.params = eng.params  # same weights
+
+    r = np.random.RandomState(7)
+    p6 = r.randint(0, 128, (2, 6)).astype(np.int32)
+    p11 = r.randint(0, 128, (2, 11)).astype(np.int32)
+
+    out6 = eng.generate(p6, max_new_tokens=4, greedy=True)
+    out11 = eng.generate(p11, max_new_tokens=4, greedy=True)
+    assert len(eng._prefill_cache) == 1  # 6 and 11 share the 16-bucket
+
+    ref6 = raw.generate(p6, max_new_tokens=4, greedy=True)
+    ref11 = raw.generate(p11, max_new_tokens=4, greedy=True)
+    np.testing.assert_array_equal(np.asarray(out6), np.asarray(ref6))
+    np.testing.assert_array_equal(np.asarray(out11), np.asarray(ref11))
