@@ -2,8 +2,15 @@ from .layers import Param, split_params_axes
 from .transformer import CausalLM, TransformerConfig, cross_entropy_loss
 from .registry import get_model, MODEL_CONFIGS, gpt2_config, opt_config, bloom_config, llama_config
 from .simple import SimpleModel, random_batch
+from .spatial import (DSUNet, DSVAE, SpatialConfig, SpatialUNet,
+                      SpatialVAEDecoder)
 
 __all__ = [
+    "DSUNet",
+    "DSVAE",
+    "SpatialConfig",
+    "SpatialUNet",
+    "SpatialVAEDecoder",
     "Param",
     "split_params_axes",
     "CausalLM",

@@ -1,0 +1,376 @@
+"""Spatial (diffusion) inference blocks: NHWC convs, GroupNorm, UNet/VAE.
+
+TPU-native counterpart of the reference's spatial inference surface:
+
+- ``csrc/spatial/`` (NHWC conv helpers + fused ``opt_bias_add.cu``): here the
+  layout is NHWC end-to-end — the conv layout XLA:TPU prefers — and bias/SiLU
+  fuse into the conv epilogue automatically, so the hand-written kernels
+  collapse into layer functions.
+- ``model_implementations/diffusers/{unet,vae}.py`` (``DSUNet``/``DSVAE``:
+  cuda-graph capture over an HF diffusers module): here ``DSUNet``/``DSVAE``
+  wrap OUR spatial modules with a jitted, shape-cached forward — a compiled
+  XLA program is the cuda-graph equivalent (one replayable executable, zero
+  Python in the hot path).
+- ``ops/transformer/inference/diffusers_attention.py`` /
+  ``diffusers_transformer_block.py``: the spatial self/cross-attention
+  transformer block below.
+
+Models are ``init``/``apply`` pairs over Param pytrees like the rest of the
+zoo (``models/layers.py``), so ``init_inference`` TP/quant machinery applies.
+"""
+
+import dataclasses
+import math
+
+import jax
+import jax.numpy as jnp
+
+from . import layers as L
+from .layers import Param
+
+
+@dataclasses.dataclass
+class SpatialConfig:
+    """Compact UNet/VAE geometry (diffusers UNet2DConditionModel-shaped)."""
+
+    in_channels: int = 4
+    out_channels: int = 4
+    base_channels: int = 64
+    channel_mults: tuple = (1, 2)
+    n_res_blocks: int = 1
+    n_heads: int = 4
+    context_dim: int = 0        # >0 enables cross-attention (text conditioning)
+    groups: int = 16
+    compute_dtype: object = jnp.float32
+
+
+# ---------------------------------------------------------------------------------
+# primitive spatial layers (NHWC)
+# ---------------------------------------------------------------------------------
+def conv2d_init(rng, in_ch, out_ch, kernel=3, stddev=None):
+    """HWIO kernel layout. Axes: out-channels are TP-shardable ("mlp" vocab)."""
+    stddev = stddev if stddev is not None else 1.0 / math.sqrt(in_ch * kernel * kernel)
+    k = L.normal_init(rng, (kernel, kernel, in_ch, out_ch), stddev)
+    return {
+        "kernel": Param(k, (None, None, None, "mlp")),
+        "bias": Param(jnp.zeros((out_ch,)), (None,)),
+    }
+
+
+def conv2d_apply(p, x, stride=1, compute_dtype=None):
+    """x: [b, h, w, c] NHWC. Bias adds fuse into the conv epilogue (the
+    reference needs ``opt_bias_add.cu`` for this; XLA does it for free)."""
+    dtype = compute_dtype or x.dtype
+    k = p["kernel"].astype(dtype)
+    pad = (k.shape[0] - 1) // 2
+    out = jax.lax.conv_general_dilated(
+        x.astype(dtype), k, window_strides=(stride, stride),
+        padding=[(pad, pad), (pad, pad)],
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+    return out + p["bias"].astype(dtype)
+
+
+def groupnorm_init(ch):
+    return {"scale": Param(jnp.ones((ch,)), (None,)),
+            "bias": Param(jnp.zeros((ch,)), (None,))}
+
+
+def groupnorm_apply(p, x, groups, eps=1e-5, act=None):
+    """GroupNorm over NHWC (+ optionally fused SiLU). fp32 statistics."""
+    b, h, w, c = x.shape
+    xg = x.astype(jnp.float32).reshape(b, h, w, groups, c // groups)
+    mean = xg.mean(axis=(1, 2, 4), keepdims=True)
+    var = xg.var(axis=(1, 2, 4), keepdims=True)
+    xg = (xg - mean) * jax.lax.rsqrt(var + eps)
+    out = xg.reshape(b, h, w, c) * p["scale"] + p["bias"]
+    if act == "silu":
+        out = jax.nn.silu(out)
+    return out.astype(x.dtype)
+
+
+def timestep_embedding(t, dim, max_period=10000.0):
+    """Sinusoidal timestep embedding [b] -> [b, dim] (diffusion standard)."""
+    half = dim // 2
+    freqs = jnp.exp(-math.log(max_period) * jnp.arange(half) / half)
+    args = t.astype(jnp.float32)[:, None] * freqs[None, :]
+    return jnp.concatenate([jnp.cos(args), jnp.sin(args)], axis=-1)
+
+
+# ---------------------------------------------------------------------------------
+# blocks
+# ---------------------------------------------------------------------------------
+def resnet_block_init(rng, in_ch, out_ch, temb_dim):
+    r = jax.random.split(rng, 4)
+    p = {
+        "norm1": groupnorm_init(in_ch),
+        "conv1": conv2d_init(r[0], in_ch, out_ch),
+        "norm2": groupnorm_init(out_ch),
+        "conv2": conv2d_init(r[1], out_ch, out_ch),
+    }
+    if temb_dim:
+        p["temb"] = L.linear_init(r[2], temb_dim, out_ch, (("embed",), (None,)))
+    if in_ch != out_ch:
+        p["skip"] = conv2d_init(r[3], in_ch, out_ch, kernel=1)
+    return p
+
+
+def resnet_block_apply(cfg, p, x, temb=None):
+    h = groupnorm_apply(p["norm1"], x, cfg.groups, act="silu")
+    h = conv2d_apply(p["conv1"], h)
+    if temb is not None and "temb" in p:
+        h = h + L.linear_apply(p["temb"], jax.nn.silu(temb))[:, None, None, :]
+    h = groupnorm_apply(p["norm2"], h, cfg.groups, act="silu")
+    h = conv2d_apply(p["conv2"], h)
+    skip = conv2d_apply(p["skip"], x) if "skip" in p else x
+    return skip + h
+
+
+def spatial_transformer_init(rng, ch, n_heads, context_dim):
+    """Self-attention (+ optional cross-attention) over flattened h*w tokens —
+    the ``diffusers_transformer_block`` equivalent."""
+    r = jax.random.split(rng, 5)
+    p = {
+        "norm": groupnorm_init(ch),
+        "attn": L.attention_init(r[0], ch, n_heads),
+        "ln_attn": L.layernorm_init(ch),
+    }
+    if context_dim:
+        p["ln_cross"] = L.layernorm_init(ch)
+        p["cross_q"] = L.linear_init(r[1], ch, ch, (("embed",), ("heads",)))
+        p["cross_k"] = L.linear_init(r[2], context_dim, ch, ((None,), ("heads",)))
+        p["cross_v"] = L.linear_init(r[3], context_dim, ch, ((None,), ("heads",)))
+        p["cross_o"] = L.linear_init(r[4], ch, ch, (("heads",), ("embed",)))
+    return p
+
+
+def spatial_transformer_apply(cfg, p, x, context=None):
+    b, h, w, c = x.shape
+    hd = c // cfg.n_heads
+    tokens = groupnorm_apply(p["norm"], x, cfg.groups).reshape(b, h * w, c)
+
+    # self-attention
+    t = L.layernorm_apply(p["ln_attn"], tokens)
+    pa = p["attn"]
+    q = L.linear_apply(pa["q"], t).reshape(b, h * w, cfg.n_heads, hd)
+    k = L.linear_apply(pa["k"], t).reshape(b, h * w, cfg.n_heads, hd)
+    v = L.linear_apply(pa["v"], t).reshape(b, h * w, cfg.n_heads, hd)
+    a = L.dot_product_attention(q, k, v)
+    tokens = tokens + L.linear_apply(pa["o"], a.reshape(b, h * w, c))
+
+    # cross-attention against the conditioning sequence (text encoder states)
+    if context is not None and "cross_q" in p:
+        t = L.layernorm_apply(p["ln_cross"], tokens)
+        s = context.shape[1]
+        q = L.linear_apply(p["cross_q"], t).reshape(b, h * w, cfg.n_heads, hd)
+        k = L.linear_apply(p["cross_k"], context).reshape(b, s, cfg.n_heads, hd)
+        v = L.linear_apply(p["cross_v"], context).reshape(b, s, cfg.n_heads, hd)
+        a = L.dot_product_attention(q, k, v)
+        tokens = tokens + L.linear_apply(p["cross_o"], a.reshape(b, h * w, c))
+
+    return x + tokens.reshape(b, h, w, c)
+
+
+# ---------------------------------------------------------------------------------
+# UNet (conditional, diffusers UNet2DConditionModel-shaped)
+# ---------------------------------------------------------------------------------
+class SpatialUNet:
+    """Compact conditional UNet: down blocks (resnet [+ attention] + stride-2
+    conv), a middle block with attention, and up blocks with skip connections.
+
+    Reference parity target: the model ``DSUNet`` wraps (diffusers
+    ``UNet2DConditionModel``) — capability, not architecture-identical."""
+
+    def __init__(self, config: SpatialConfig):
+        self.config = config
+
+    def init(self, rng):
+        cfg = self.config
+        temb_dim = cfg.base_channels * 4
+        chans = [cfg.base_channels * m for m in cfg.channel_mults]
+        r = iter(jax.random.split(rng, 64))
+        p = {
+            "temb1": L.linear_init(next(r), cfg.base_channels, temb_dim,
+                                   ((None,), (None,))),
+            "temb2": L.linear_init(next(r), temb_dim, temb_dim,
+                                   ((None,), (None,))),
+            "conv_in": conv2d_init(next(r), cfg.in_channels, chans[0]),
+        }
+        down, ch = [], chans[0]
+        for i, out_ch in enumerate(chans):
+            blocks = []
+            for _ in range(cfg.n_res_blocks):
+                blk = {"res": resnet_block_init(next(r), ch, out_ch, temb_dim)}
+                if i == len(chans) - 1:  # attention at the lowest resolution
+                    blk["attn"] = spatial_transformer_init(
+                        next(r), out_ch, cfg.n_heads, cfg.context_dim)
+                blocks.append(blk)
+                ch = out_ch
+            down.append({"blocks": blocks,
+                         "downsample": conv2d_init(next(r), ch, ch)
+                         if i < len(chans) - 1 else None})
+        p["down"] = down
+        p["mid"] = {
+            "res1": resnet_block_init(next(r), ch, ch, temb_dim),
+            "attn": spatial_transformer_init(next(r), ch, cfg.n_heads,
+                                             cfg.context_dim),
+            "res2": resnet_block_init(next(r), ch, ch, temb_dim),
+        }
+        up = []
+        for i, out_ch in reversed(list(enumerate(chans))):
+            blocks = []
+            for _ in range(cfg.n_res_blocks):
+                blocks.append(
+                    {"res": resnet_block_init(next(r), ch + out_ch, out_ch,
+                                              temb_dim)})
+                ch = out_ch
+            up.append({"blocks": blocks,
+                       "upsample": conv2d_init(next(r), ch, ch)
+                       if i > 0 else None})
+        p["up"] = up
+        p["norm_out"] = groupnorm_init(ch)
+        p["conv_out"] = conv2d_init(next(r), ch, cfg.out_channels)
+        return p
+
+    def apply(self, params, sample, timestep, encoder_hidden_states=None):
+        """sample: [b, h, w, in_ch] NHWC; timestep: [b]; encoder_hidden_states:
+        [b, s, context_dim] or None. Returns the predicted noise [b, h, w, out_ch].
+        """
+        cfg = self.config
+        dtype = cfg.compute_dtype
+        x = sample.astype(dtype)
+        ctx = None if encoder_hidden_states is None \
+            else encoder_hidden_states.astype(dtype)
+
+        temb = timestep_embedding(jnp.asarray(timestep), cfg.base_channels)
+        temb = L.linear_apply(params["temb2"], jax.nn.silu(
+            L.linear_apply(params["temb1"], temb.astype(dtype))))
+
+        x = conv2d_apply(params["conv_in"], x)
+        skips = []
+        for stage in params["down"]:
+            for blk in stage["blocks"]:
+                x = resnet_block_apply(cfg, blk["res"], x, temb)
+                if "attn" in blk:
+                    x = spatial_transformer_apply(cfg, blk["attn"], x, ctx)
+                skips.append(x)
+            if stage["downsample"] is not None:
+                x = conv2d_apply(stage["downsample"], x, stride=2)
+
+        x = resnet_block_apply(cfg, params["mid"]["res1"], x, temb)
+        x = spatial_transformer_apply(cfg, params["mid"]["attn"], x, ctx)
+        x = resnet_block_apply(cfg, params["mid"]["res2"], x, temb)
+
+        for stage in params["up"]:
+            for blk in stage["blocks"]:
+                skip = skips.pop()
+                if skip.shape[1] != x.shape[1]:  # resolution mismatch: upsample first
+                    b, h, w, c = x.shape
+                    x = jax.image.resize(x, (b, h * 2, w * 2, c), "nearest")
+                x = resnet_block_apply(cfg, blk["res"],
+                                       jnp.concatenate([x, skip], axis=-1), temb)
+            if stage["upsample"] is not None:
+                b, h, w, c = x.shape
+                x = jax.image.resize(x, (b, h * 2, w * 2, c), "nearest")
+                x = conv2d_apply(stage["upsample"], x)
+
+        x = groupnorm_apply(params["norm_out"], x, cfg.groups, act="silu")
+        return conv2d_apply(params["conv_out"], x).astype(dtype)
+
+
+class SpatialVAEDecoder:
+    """VAE decoder: latents [b, h, w, latent_ch] -> images
+    [b, h * 2^(len(mults)-1), w * 2^(len(mults)-1), 3] — one stage per channel
+    mult from deepest to shallowest with an x2 nearest upsample between stages
+    (diffusers AutoencoderKL decoder geometry)."""
+
+    def __init__(self, config: SpatialConfig):
+        self.config = config
+
+    def init(self, rng):
+        cfg = self.config
+        ch = cfg.base_channels * cfg.channel_mults[-1]
+        r = iter(jax.random.split(rng, 32))
+        p = {"conv_in": conv2d_init(next(r), cfg.in_channels, ch),
+             "mid": {"res1": resnet_block_init(next(r), ch, ch, 0),
+                     "attn": spatial_transformer_init(next(r), ch, cfg.n_heads, 0),
+                     "res2": resnet_block_init(next(r), ch, ch, 0)},
+             "up": []}
+        stages = [cfg.base_channels * m for m in reversed(cfg.channel_mults)]
+        for i, out_ch in enumerate(stages):
+            p["up"].append({
+                "res": resnet_block_init(next(r), ch, out_ch, 0),
+                "conv": conv2d_init(next(r), out_ch, out_ch)
+                if i < len(stages) - 1 else None,
+            })
+            ch = out_ch
+        p["norm_out"] = groupnorm_init(ch)
+        p["conv_out"] = conv2d_init(next(r), ch, 3)
+        return p
+
+    def apply(self, params, latents):
+        cfg = self.config
+        x = latents.astype(cfg.compute_dtype)
+        x = conv2d_apply(params["conv_in"], x)
+        x = resnet_block_apply(cfg, params["mid"]["res1"], x)
+        x = spatial_transformer_apply(cfg, params["mid"]["attn"], x)
+        x = resnet_block_apply(cfg, params["mid"]["res2"], x)
+        for stage in params["up"]:
+            x = resnet_block_apply(cfg, stage["res"], x)
+            if stage["conv"] is not None:
+                b, h, w, c = x.shape
+                x = jax.image.resize(x, (b, h * 2, w * 2, c), "nearest")
+                x = conv2d_apply(stage["conv"], x)
+        x = groupnorm_apply(params["norm_out"], x, cfg.groups, act="silu")
+        return conv2d_apply(params["conv_out"], x)
+
+
+# ---------------------------------------------------------------------------------
+# DSUNet / DSVAE: the cuda-graph-equivalent serving wrappers
+# ---------------------------------------------------------------------------------
+class _JittedSpatial:
+    """Jitted, shape-cached forward over a spatial module — one compiled XLA
+    executable per input shape plays the role of the reference's captured CUDA
+    graph (``DSUNet._create_cuda_graph``): after the first call, replay is a
+    single dispatch with no Python in the loop."""
+
+    def __init__(self, module, params=None, rng=None):
+        self.module = module
+        self.config = module.config
+        if params is None:
+            values, _ = L.split_params_axes(
+                module.init(rng if rng is not None else jax.random.PRNGKey(0)))
+            params = values
+        self.params = params
+        self._fns = {}
+
+    def _call(self, key, fn, *args):
+        if key not in self._fns:
+            self._fns[key] = jax.jit(fn)
+        return self._fns[key](self.params, *args)
+
+
+class DSUNet(_JittedSpatial):
+    def __call__(self, sample, timestep, encoder_hidden_states=None):
+        sample = jnp.asarray(sample)
+        ts = jnp.asarray(timestep)
+        if ts.ndim == 0:
+            ts = jnp.broadcast_to(ts, (sample.shape[0],))
+        ctx = None if encoder_hidden_states is None else jnp.asarray(
+            encoder_hidden_states)
+        key = (sample.shape, None if ctx is None else ctx.shape)
+        if ctx is None:
+            return self._call(key, lambda p, s, t: self.module.apply(p, s, t),
+                              sample, ts)
+        return self._call(
+            key, lambda p, s, t, c: self.module.apply(p, s, t, c),
+            sample, ts, ctx)
+
+
+class DSVAE(_JittedSpatial):
+    def decode(self, latents):
+        latents = jnp.asarray(latents)
+        return self._call(latents.shape,
+                          lambda p, z: self.module.apply(p, z), latents)
+
+    __call__ = decode
