@@ -173,7 +173,7 @@ def block_init(rng, cfg):
 
 
 def block_apply(cfg, p, x, mask=None, rope=None, alibi=None, deterministic=True,
-                dropout_rng=None, kv_mask=None):
+                dropout_rng=None, kv_mask=None, seq_manual=False):
     """One transformer block. x: [batch, seq, d_model] in compute dtype.
     Returns ``(x, aux_loss)`` — aux is the MoE load-balancing term (0 for dense).
 
@@ -232,9 +232,15 @@ def block_apply(cfg, p, x, mask=None, rope=None, alibi=None, deterministic=True,
         k = L._repeat_kv(k, n_rep)
         v = L._repeat_kv(v, n_rep)
         if cfg.sequence_parallel:
-            from ..parallel.ring_attention import ring_attention
+            from ..parallel.ring_attention import (ring_attention,
+                                                   ring_attention_manual)
 
-            out = ring_attention(q, k, v, cfg.mesh, kv_mask=kv_mask, causal=True)
+            if seq_manual:
+                # already inside the pipeline's manual region over {pipe, seq}
+                out = ring_attention_manual(q, k, v, kv_mask=kv_mask, causal=True)
+            else:
+                out = ring_attention(q, k, v, cfg.mesh, kv_mask=kv_mask,
+                                     causal=True)
             out = checkpoint_name(out, "attn_out")
             return L.linear_apply(p["attn"]["o"], out.reshape(b, s, d))
         # flash path: plain causal attention, no padding mask / alibi / dropout
@@ -330,9 +336,9 @@ def stack_apply(cfg, stacked_params, x, mask=None, rope=None, alibi=None,
     if cfg.sequence_parallel:
         if cfg.mesh is None:
             raise ValueError("sequence_parallel requires cfg.mesh to be set")
-        if cfg.pipeline_stages > 1:
+        if cfg.pipeline_stages > 1 and kv_mask is not None:
             raise NotImplementedError(
-                "sequence_parallel + pipeline_stages > 1 not supported yet"
+                "padding kv_mask not supported with sequence_parallel + pipeline"
             )
         if cfg.position_embedding == "alibi":
             raise NotImplementedError("alibi bias not supported with ring attention")
@@ -383,8 +389,13 @@ def _pipeline_stack(cfg, stacked_params, x, mask, rope, alibi, deterministic,
     # rotation; unbatched ones ride the closure. Shapes from CausalLM.apply:
     # mask [b,1,q,kv] (causal-only masks are [1,1,q,kv]), rope cos/sin [b,s,hd/2].
     b = x.shape[0]
+    seq_manual = cfg.sequence_parallel
     side = {}
     if mask is not None and mask.ndim == 4 and mask.shape[0] == b and b > 1:
+        if seq_manual:
+            raise NotImplementedError(
+                "batched attention masks not supported with sequence_parallel "
+                "+ pipeline (ring attention computes causal masking itself)")
         side["mask"] = mask
     if rope is not None and rope[0].ndim == 3 and rope[0].shape[0] == b:
         side["rope_cos"], side["rope_sin"] = rope
@@ -394,7 +405,8 @@ def _pipeline_stack(cfg, stacked_params, x, mask, rope, alibi, deterministic,
         r = ((side_mb["rope_cos"], side_mb["rope_sin"])
              if "rope_cos" in side_mb else rope)
         return block_apply(cfg, p, h, mask=m, rope=r, alibi=alibi,
-                           deterministic=deterministic, dropout_rng=rng)
+                           deterministic=deterministic, dropout_rng=rng,
+                           seq_manual=seq_manual)
 
     if cfg.remat:
         pipe_block = jax.checkpoint(pipe_block, policy=_remat_policy(cfg))
@@ -413,6 +425,7 @@ def _pipeline_stack(cfg, stacked_params, x, mask, rope, alibi, deterministic,
     return pipeline_stack_apply(
         cfg, stacked_params, x, mesh=cfg.mesh,
         n_microbatches=cfg.pipeline_microbatches, block_fn=block_fn, side=side,
+        seq_manual=seq_manual,
     )
 
 

@@ -37,11 +37,11 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-from .topology import PIPE_AXIS, DATA_AXIS
+from .topology import PIPE_AXIS, DATA_AXIS, SEQ_AXIS
 
 
 def pipeline_stack_apply(cfg, stacked_params, x, *, mesh, n_microbatches,
-                         block_fn, side=None):
+                         block_fn, side=None, seq_manual=False):
     """Run stacked transformer blocks pipelined over the ``pipe`` mesh axis.
 
     Args:
@@ -169,13 +169,25 @@ def pipeline_stack_apply(cfg, stacked_params, x, *, mesh, n_microbatches,
         return outs, aux
 
     param_specs = jax.tree_util.tree_map(lambda _: P(PIPE_AXIS), stacked_params)
-    side_specs = jax.tree_util.tree_map(lambda _: P(), side_ms)
+    if seq_manual:
+        # sequence parallelism composes by widening the manual region to
+        # {pipe, seq}: activations/side inputs enter seq-sharded on their
+        # sequence dim and the block's ring attention runs its seq-axis
+        # ppermutes directly (shard_maps don't nest).
+        xs_spec = P(None, None, SEQ_AXIS)
+        side_specs = jax.tree_util.tree_map(
+            lambda a: P(None, None, SEQ_AXIS) if a.ndim >= 3 else P(), side_ms)
+        axis_names = {PIPE_AXIS, SEQ_AXIS}
+    else:
+        xs_spec = P()
+        side_specs = jax.tree_util.tree_map(lambda _: P(), side_ms)
+        axis_names = {PIPE_AXIS}
     sm = jax.shard_map(
         pipe_fn,
         mesh=mesh,
-        in_specs=(param_specs, P(), side_specs),
-        out_specs=(P(), P()),
-        axis_names={PIPE_AXIS},
+        in_specs=(param_specs, xs_spec, side_specs),
+        out_specs=(xs_spec, P()),
+        axis_names=axis_names,
         check_vma=False,
     )
     outs, aux = sm(stacked_params, xs, side_ms)

@@ -82,6 +82,20 @@ def _ring_attention_local(q, k, v, kv_mask, *, scale, causal, remat_steps):
     return out.astype(q.dtype)
 
 
+def ring_attention_manual(q, k, v, *, kv_mask=None, causal=True, scale=None,
+                          remat_steps=True):
+    """Ring attention for callers ALREADY inside a manual region whose axis set
+    includes ``seq`` (e.g. the pipeline's shard_map with
+    ``axis_names={'pipe','seq'}`` — shard_maps don't nest, so the pipeline
+    cannot call the wrapped ``ring_attention``). q/k/v are the LOCAL sequence
+    blocks [b, s_local, h, dh]; global causal offsets come from
+    ``axis_index('seq')`` exactly as in the wrapped version."""
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    return _ring_attention_local(q, k, v, kv_mask, scale=scale, causal=causal,
+                                 remat_steps=remat_steps)
+
+
 def ring_attention(q, k, v, mesh, *, kv_mask=None, causal=True, scale=None,
                    remat_steps=True):
     """Exact attention with the sequence dim sharded over the ``seq`` mesh axis.

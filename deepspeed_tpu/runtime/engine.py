@@ -347,6 +347,13 @@ class DeepSpeedEngine:
         use_1f1b = (self.pipe_stages > 1
                     and self._config.pipeline.schedule == "1f1b"
                     and isinstance(self.params, dict) and "blocks" in self.params)
+        if use_1f1b and self.seq_parallel_size > 1:
+            if warn:
+                logger.warning(
+                    "pipeline schedule '1f1b' does not compose with sequence "
+                    "parallelism (mesh seq=%d); falling back to gpipe",
+                    self.seq_parallel_size)
+            use_1f1b = False
         if use_1f1b and self.mp_world_size > 1:
             # XLA's partial-manual partitioner cannot rendezvous the model-axis
             # (TP) collectives it inserts inside the 1F1B schedule's

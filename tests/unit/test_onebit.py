@@ -58,13 +58,25 @@ def test_error_feedback_is_unbiased_over_steps(devices8, bits):
     exact = np.mean(np.asarray(x).reshape(world, n_local), axis=0)
 
     sm = make_compressed_allreduce(mesh, "data", bits=bits)
-    we = jnp.zeros_like(x)
-    se = jnp.zeros((world * (n_local // world),), jnp.float32)
-    acc = np.zeros_like(exact)
     steps = 64
-    for _ in range(steps):
-        out, we, se = sm(x, we, se)
-        acc += np.asarray(out).reshape(world, n_local)[0]
+
+    # the whole 64-step accumulation as ONE scanned program: per-dispatch
+    # overhead on the emulated 8-device CPU backend dominated the old
+    # python-loop version (~90s -> ~2s)
+    @jax.jit
+    def run(x, we, se):
+        def body(carry, _):
+            we, se, acc = carry
+            out, we, se = sm(x, we, se)
+            return (we, se, acc + out), None
+
+        acc0 = jnp.zeros_like(x)
+        (we, se, acc), _ = jax.lax.scan(body, (we, se, acc0), None, length=steps)
+        return acc
+
+    acc = run(x, jnp.zeros_like(x),
+              jnp.zeros((world * (n_local // world),), jnp.float32))
+    acc = np.asarray(acc).reshape(world, n_local)[0]
     # time-average of compensated quantized reductions -> exact mean
     err = np.abs(acc / steps - exact).mean() / (np.abs(exact).mean() + 1e-9)
     assert err < 0.05, err
@@ -112,20 +124,19 @@ def test_onebit_adam_converges_after_freeze(devices8):
 
     losses = [loss(params["w"])]
     shards = data.reshape(world, 16, dim)
+    grads_all = jax.jit(jax.vmap(local_grads, in_axes=(None, 0)))
+    momenta_all = jax.jit(jax.vmap(
+        lambda g, st: opt.local_momentum({"w": g}, st)["w"], in_axes=(0, None)))
     for step in range(40):
-        g_local = np.stack([np.asarray(local_grads(params["w"], shards[r]))
-                            for r in range(world)])
+        g_local = grads_all(params["w"], shards)  # [world, dim], one dispatch
         if step < opt.freeze_step:
-            g_mean = {"w": jnp.asarray(g_local.mean(0))}
+            g_mean = {"w": jnp.mean(g_local, axis=0)}
             params, state = opt.update(g_mean, state, params)
         else:
             # compressed momentum path: each device folds ITS local grad
-            m_locals = np.stack([
-                np.asarray(opt.local_momentum(
-                    {"w": jnp.asarray(g_local[r])}, state)["w"])
-                for r in range(world)])
-            m_red, we, se = sm(jnp.asarray(m_locals.reshape(-1)), we, se)
-            m_tree = {"w": jnp.asarray(np.asarray(m_red).reshape(world, dim)[0])}
+            m_locals = momenta_all(g_local, state)
+            m_red, we, se = sm(m_locals.reshape(-1), we, se)
+            m_tree = {"w": m_red.reshape(world, dim)[0]}
             params, state = opt.apply_compressed(m_tree, state, params)
         losses.append(loss(params["w"]))
 

@@ -1,0 +1,78 @@
+"""Flash-attention kernel benchmark: Pallas vs XLA across sequence lengths.
+
+Answers "does the Pallas kernel actually win, and where?" (VERDICT r1 flagged
+that no such number existed). Run on the real chip:
+
+    python tools/bench_attention.py            # fwd+bwd train-shape sweep
+    BENCH_FWD_ONLY=1 python tools/bench_attention.py
+
+Prints one line per (seq, impl): ms/iter and achieved TFLOP/s; causal
+attention flops = 2 * 0.5 * s^2 * d * 3 matmuls fwd (+~2.5x bwd).
+"""
+
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    from deepspeed_tpu.models import layers as L
+    from deepspeed_tpu.ops.flash_attention import flash_attention
+
+    b, h, d = 4, 16, 64
+    fwd_only = os.environ.get("BENCH_FWD_ONLY") == "1"
+    seqs = [int(s) for s in os.environ.get(
+        "BENCH_SEQS", "1024,2048,4096,8192").split(",")]
+
+    def xla_attn(q, k, v):
+        return L.dot_product_attention(q, k, v,
+                                       mask=L.causal_mask(q.shape[1], k.shape[1]))
+
+    def flash(q, k, v):
+        return flash_attention(q, k, v, causal=True)
+
+    def bench(fn, q, k, v, n=8):
+        if fwd_only:
+            f = jax.jit(lambda q, k, v: fn(q, k, v))
+        else:
+            f = jax.jit(jax.grad(
+                lambda q, k, v: fn(q, k, v).astype(jnp.float32).sum(),
+                argnums=(0, 1, 2)))
+        out = f(q, k, v)  # compile
+        leaf = jax.tree_util.tree_leaves(out)[0]
+        np.asarray(jax.device_get(leaf.ravel()[0]))  # fence (axon tunnel)
+        t0 = time.perf_counter()
+        for _ in range(n):
+            out = f(q, k, v)
+        leaf = jax.tree_util.tree_leaves(out)[0]
+        np.asarray(jax.device_get(leaf.ravel()[0]))
+        return (time.perf_counter() - t0) / n
+
+    print(f"# b={b} h={h} d={d} dtype=bf16 mode={'fwd' if fwd_only else 'fwd+bwd'}")
+    for s in seqs:
+        rng = np.random.RandomState(0)
+        mk = lambda: jnp.asarray(rng.randn(b, s, h, d), jnp.bfloat16)
+        q, k, v = mk(), mk(), mk()
+        # causal: half the s^2 tile pairs; 2 matmuls fwd (qk^T, pv);
+        # bwd adds ~3.5x fwd matmul work (dq, dk, dv + prob recompute)
+        flops = 2 * (s * s / 2) * d * 2 * b * h
+        if not fwd_only:
+            flops *= 4.5
+        for name, fn in [("xla", xla_attn), ("flash", flash)]:
+            try:
+                dt = bench(fn, q, k, v)
+                print(f"seq={s:6d} {name:6s} {dt * 1e3:9.2f} ms "
+                      f"{flops / dt / 1e12:7.1f} TFLOP/s")
+            except Exception as e:
+                print(f"seq={s:6d} {name:6s} FAILED: {type(e).__name__}: "
+                      f"{str(e)[:100]}")
+
+
+if __name__ == "__main__":
+    main()
