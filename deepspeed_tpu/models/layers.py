@@ -23,6 +23,7 @@ dtype (bf16/fp16) at apply time.
 """
 
 import dataclasses
+import functools
 import math
 
 import jax
@@ -80,6 +81,66 @@ def linear_init(rng, in_dim, out_dim, axes, bias=True, stddev=0.02):
     if bias:
         p["bias"] = Param(zeros_init((out_dim,)), (axes[-1],))
     return p
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def tp_copy(x, axis):
+    """Identity forward / psum backward over ``axis`` — the conjugate of the
+    row-parallel psum, applied to the INPUT of column-parallel matmuls inside a
+    manual-TP region (Megatron's f operator): ``d(x @ W_local)/dx`` is a
+    partial sum, and this is where it completes."""
+    return x
+
+
+def _psum_f32(x, axis):
+    # bf16/f16 all-reduces miscompile in partial-manual regions ("Invalid
+    # binary instruction opcode copy", same workaround as parallel/pipeline.py)
+    if x.dtype in (jnp.bfloat16, jnp.float16):
+        return jax.lax.psum(x.astype(jnp.float32), axis).astype(x.dtype)
+    return jax.lax.psum(x, axis)
+
+
+def _tp_copy_fwd(x, axis):
+    return x, None
+
+
+def _tp_copy_bwd(axis, _, g):
+    return (_psum_f32(g, axis),)
+
+
+tp_copy.defvjp(_tp_copy_fwd, _tp_copy_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def tp_reduce(x, axis):
+    """psum forward / identity backward — the row-parallel output reduction
+    (Megatron's g operator). A bare ``lax.psum`` is WRONG here under legacy
+    (check_vma=False) shard_map: its transpose is another psum, which doubles
+    every upstream cotangent."""
+    return _psum_f32(x, axis)
+
+
+def _tp_reduce_fwd(x, axis):
+    return _psum_f32(x, axis), None
+
+
+def _tp_reduce_bwd(axis, _, g):
+    return (g,)
+
+
+tp_reduce.defvjp(_tp_reduce_fwd, _tp_reduce_bwd)
+
+
+def linear_apply_rowparallel(p, x, axis):
+    """Row-parallel linear INSIDE a manual region over ``axis``: the input's
+    feature dim is a local shard, the matmul produces a partial sum,
+    ``tp_reduce`` completes it, and the bias is added once after (the
+    reference's ``RowParallelLinear`` ordering, ``compression/basic_layer.py:802``)."""
+    y = x @ p["kernel"].astype(x.dtype)
+    y = tp_reduce(y, axis)
+    if "bias" in p:
+        y = y + p["bias"].astype(y.dtype)
+    return y
 
 
 def linear_apply(p, x, compute_dtype=None):

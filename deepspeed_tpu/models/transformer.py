@@ -140,16 +140,23 @@ def _mlp_init(rng, cfg):
     }
 
 
-def _mlp_apply(cfg, p, x):
+def _mlp_apply(cfg, p, x, tp_manual=False):
     from jax.ad_checkpoint import checkpoint_name
 
+    # tp_manual: column-parallel in (local hidden shard), row-parallel out with
+    # an explicit psum over the model axis (used inside manual regions where
+    # the SPMD partitioner cannot insert the collective itself, e.g. 1F1B x TP)
+    out = (lambda w, h: L.linear_apply_rowparallel(w, h, "model")) \
+        if tp_manual else L.linear_apply
+    if tp_manual:
+        x = L.tp_copy(x, "model")  # completes dL/dx with a backward psum
     if cfg.activation == "swiglu":
         gate = checkpoint_name(L.linear_apply(p["gate"], x), "mlp_hidden")
         up = checkpoint_name(L.linear_apply(p["up"], x), "mlp_hidden")
-        return L.linear_apply(p["down"], jax.nn.silu(gate) * up)
+        return out(p["down"], jax.nn.silu(gate) * up)
     act = L.ACTIVATIONS[cfg.activation]
     h = checkpoint_name(L.linear_apply(p["fc"], x), "mlp_hidden")
-    return L.linear_apply(p["proj"], act(h))
+    return out(p["proj"], act(h))
 
 
 def block_init(rng, cfg):
@@ -173,7 +180,8 @@ def block_init(rng, cfg):
 
 
 def block_apply(cfg, p, x, mask=None, rope=None, alibi=None, deterministic=True,
-                dropout_rng=None, kv_mask=None, seq_manual=False):
+                dropout_rng=None, kv_mask=None, seq_manual=False,
+                tp_manual=False):
     """One transformer block. x: [batch, seq, d_model] in compute dtype.
     Returns ``(x, aux_loss)`` — aux is the MoE load-balancing term (0 for dense).
 
@@ -198,27 +206,32 @@ def block_apply(cfg, p, x, mask=None, rope=None, alibi=None, deterministic=True,
 
     def attn(h):
         pa = p["attn"]
-        kv_dim = cfg.kv_heads * cfg.head_dim
+        if tp_manual:
+            h = L.tp_copy(h, "model")  # completes dL/dh with a backward psum
         if "kernel" in pa["q"]:
             # one fused qkv matmul (the reference's c_attn / fused qkv gemm):
             # concat of the kernels is a cheap copy next to the [tokens, d] x
             # [d, d+2kv] matmul it enables — wider N keeps the MXU busier than
             # three narrow matmuls. Bitwise-identical per output column.
+            # Widths come from the kernels (not cfg) so a tp_manual caller can
+            # hand in LOCAL head shards and everything below just works.
+            q_w = pa["q"]["kernel"].shape[1]
+            kv_w = pa["k"]["kernel"].shape[1]
             wqkv = jnp.concatenate(
                 [pa["q"]["kernel"], pa["k"]["kernel"], pa["v"]["kernel"]], axis=1)
             qkv = h @ wqkv
             if "bias" in pa["q"]:
                 qkv = qkv + jnp.concatenate(
                     [pa["q"]["bias"], pa["k"]["bias"], pa["v"]["bias"]])
-            q, k, v = (qkv[..., :d], qkv[..., d:d + kv_dim],
-                       qkv[..., d + kv_dim:])
+            q, k, v = (qkv[..., :q_w], qkv[..., q_w:q_w + kv_w],
+                       qkv[..., q_w + kv_w:])
         else:  # quantized serving path keeps per-matrix dequant
             q = L.linear_apply(pa["q"], h)
             k = L.linear_apply(pa["k"], h)
             v = L.linear_apply(pa["v"], h)
-        q = q.reshape(b, s, cfg.n_heads, cfg.head_dim)
-        k = k.reshape(b, s, cfg.kv_heads, cfg.head_dim)
-        v = v.reshape(b, s, cfg.kv_heads, cfg.head_dim)
+        q = q.reshape(b, s, q.shape[-1] // cfg.head_dim, cfg.head_dim)
+        k = k.reshape(b, s, k.shape[-1] // cfg.head_dim, cfg.head_dim)
+        v = v.reshape(b, s, v.shape[-1] // cfg.head_dim, cfg.head_dim)
         q = checkpoint_name(q, "q_proj")
         k = checkpoint_name(k, "k_proj")
         v = checkpoint_name(v, "v_proj")
@@ -242,7 +255,7 @@ def block_apply(cfg, p, x, mask=None, rope=None, alibi=None, deterministic=True,
                 out = ring_attention(q, k, v, cfg.mesh, kv_mask=kv_mask,
                                      causal=True)
             out = checkpoint_name(out, "attn_out")
-            return L.linear_apply(p["attn"]["o"], out.reshape(b, s, d))
+            return o_proj(out)
         # flash path: plain causal attention, no padding mask / alibi / dropout
         flash_ok = (
             cfg.attention_impl == "flash" and alibi is None and mask is None
@@ -262,7 +275,13 @@ def block_apply(cfg, p, x, mask=None, rope=None, alibi=None, deterministic=True,
                 dropout_rng=drop_rng, alibi_bias=alibi,
             )
         out = checkpoint_name(out, "attn_out")
-        return L.linear_apply(p["attn"]["o"], out.reshape(b, s, d))
+        return o_proj(out)
+
+    def o_proj(out):
+        out = out.reshape(b, s, -1)  # local width under tp_manual
+        if tp_manual:
+            return L.linear_apply_rowparallel(p["attn"]["o"], out, "model")
+        return L.linear_apply(p["attn"]["o"], out)
 
     def maybe_drop(h, salt):
         if deterministic or cfg.dropout == 0.0 or dropout_rng is None:
@@ -274,6 +293,10 @@ def block_apply(cfg, p, x, mask=None, rope=None, alibi=None, deterministic=True,
     def mlp(h):
         nonlocal aux
         if cfg.n_experts > 0:
+            if tp_manual:
+                raise NotImplementedError(
+                    "MoE layers do not compose with the manual-TP block "
+                    "(1F1B x TP); use the GPipe schedule for MoE pipelines")
             from ..moe import moe_mlp_apply
 
             moe_rng = (jax.random.fold_in(dropout_rng, 4)
@@ -282,7 +305,7 @@ def block_apply(cfg, p, x, mask=None, rope=None, alibi=None, deterministic=True,
                                        rng=moe_rng)
             aux = aux + aux_i
             return out
-        return _mlp_apply(cfg, p["mlp"], h)
+        return _mlp_apply(cfg, p["mlp"], h, tp_manual=tp_manual)
 
     if cfg.parallel_attn_mlp:
         h = _norm_apply(cfg, p["ln_1"], x)

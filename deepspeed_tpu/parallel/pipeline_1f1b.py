@@ -50,15 +50,29 @@ def _to_microbatches(a, M, mesh):
     return jax.lax.with_sharding_constraint(a, NamedSharding(mesh, spec))
 
 
-def build_1f1b_train_step(model, mesh, n_microbatches):
+def build_1f1b_train_step(model, mesh, n_microbatches, blocks_param_specs=None):
     """Returns ``train_step(params, batch, scale, rng) -> (loss, grads)`` — the
-    1F1B replacement for the engine's ``fwd_bwd`` pass on pipe meshes."""
+    1F1B replacement for the engine's ``fwd_bwd`` pass on pipe meshes.
+
+    Tensor parallelism composes by widening the manual region to
+    {pipe, model} and running the block in ``tp_manual`` mode (explicit
+    row-parallel psums) — the auto partitioner cannot place model-axis
+    collectives inside the schedule's stage-varying ``lax.cond`` branches
+    (runtime deadlock), so the block writes them itself.
+    ``blocks_param_specs``: the engine's PartitionSpec tree for
+    ``params['blocks']`` (supplies the model-axis layout of each leaf).
+    """
     cfg = model.config
     S = mesh.shape[PIPE_AXIS]
+    TP = mesh.shape.get("model", 1)
     M = int(n_microbatches)
     if cfg.n_layers % S:
         raise ValueError(f"n_layers {cfg.n_layers} not divisible by stages {S}")
     L_local = cfg.n_layers // S
+    # manual TP only when the caller supplies the model-axis layout; without
+    # specs a TP-sized mesh keeps the block weights model-replicated (valid,
+    # just unsharded — direct/test callers)
+    tp_manual = TP > 1 and blocks_param_specs is not None
 
     from ..models import layers as Lyr
     from ..models.transformer import block_apply, _norm_apply, _remat_policy
@@ -70,7 +84,7 @@ def build_1f1b_train_step(model, mesh, n_microbatches):
         return block_apply(cfg, p, h, mask=m, rope=r,
                            alibi=side_mb.get("_alibi_const"),
                            deterministic=side_mb.get("_det", True),
-                           dropout_rng=rng)
+                           dropout_rng=rng, tp_manual=tp_manual)
 
     def head_loss(head_w, h, labels_mb):
         x = _norm_apply(cfg, head_w["ln_f"], h)
@@ -298,20 +312,43 @@ def build_1f1b_train_step(model, mesh, n_microbatches):
             gx = jax.lax.psum(carry["gx"] * is_first, PIPE_AXIS)
             return loss, aux, carry["gW"], g_head, gx
 
-        blocks_specs = jax.tree_util.tree_map(lambda _: P(PIPE_AXIS),
-                                              params["blocks"])
+        if tp_manual:
+            # layers dim over pipe + whatever model-axis layout the engine gave
+            # each leaf; axes outside {pipe, model} (e.g. ZeRO's data) stay auto
+            manual = (PIPE_AXIS, "model")
+
+            def filt(spec):
+                return P(*(a if a in manual else None for a in tuple(spec)))
+
+            blocks_specs = jax.tree_util.tree_map(
+                filt, blocks_param_specs, is_leaf=lambda x: isinstance(x, P))
+            axis_names = {PIPE_AXIS, "model"}
+        else:
+            blocks_specs = jax.tree_util.tree_map(lambda _: P(PIPE_AXIS),
+                                                  params["blocks"])
+            axis_names = {PIPE_AXIS}
         head_specs = jax.tree_util.tree_map(lambda _: P(), head_w)
         side_specs = jax.tree_util.tree_map(lambda _: P(), side_ms)
+        # Gather the block weights to exactly their manual-region layout BEFORE
+        # entering the schedule: any leftover data-axis (ZeRO-3) sharding would
+        # make the auto partitioner emit its all-gathers inside the
+        # stage-varying lax.cond branches — a rendezvous deadlock at runtime.
+        # (The reference has the same constraint: its pipeline engine composes
+        # with ZeRO-1, not ZeRO-3, deepspeed/runtime/pipe/engine.py:61.)
+        blocks_in = jax.tree_util.tree_map(
+            lambda a, s: jax.lax.with_sharding_constraint(
+                a, NamedSharding(mesh, s)),
+            params["blocks"], blocks_specs)
         sm = jax.shard_map(
             pipe_fn,
             mesh=mesh,
             in_specs=(blocks_specs, head_specs, P(), P(), P(), side_specs),
             out_specs=(P(), P(), blocks_specs, head_specs, P()),
-            axis_names={PIPE_AXIS},
+            axis_names=axis_names,
             check_vma=False,
         )
         loss, aux_mean, gW, g_head, gx = sm(
-            params["blocks"], head_w, xs, labels_ms, mb_weight, side_ms)
+            blocks_in, head_w, xs, labels_ms, mb_weight, side_ms)
 
         (g_embed,) = embed_vjp(gx.reshape((B,) + gx.shape[2:]))
 

@@ -354,17 +354,13 @@ class DeepSpeedEngine:
                     "parallelism (mesh seq=%d); falling back to gpipe",
                     self.seq_parallel_size)
             use_1f1b = False
-        if use_1f1b and self.mp_world_size > 1:
-            # XLA's partial-manual partitioner cannot rendezvous the model-axis
-            # (TP) collectives it inserts inside the 1F1B schedule's
-            # stage-varying lax.cond branches (deadlock at runtime). Until that
-            # is fixed upstream, TP x PP meshes take the GPipe schedule — same
-            # numerics, activation footprint O(microbatches).
+        if use_1f1b and self.mp_world_size > 1 and \
+                getattr(self.module.config, "n_experts", 0) > 0:
+            # the manual-TP block has no MoE dispatch path
             if warn:
                 logger.warning(
-                    "pipeline schedule '1f1b' is not supported with tensor "
-                    "parallelism (mesh model=%d); falling back to gpipe",
-                    self.mp_world_size)
+                    "pipeline schedule '1f1b' with tensor parallelism does not "
+                    "support MoE layers; falling back to gpipe")
             use_1f1b = False
         return use_1f1b
 
@@ -377,8 +373,10 @@ class DeepSpeedEngine:
             # microbatches (reference runtime/pipe/schedule.py:189 TrainSchedule).
             from ..parallel.pipeline_1f1b import build_1f1b_train_step
 
-            step = build_1f1b_train_step(self.module, self.mesh,
-                                         self._pipe_microbatches)
+            step = build_1f1b_train_step(
+                self.module, self.mesh, self._pipe_microbatches,
+                blocks_param_specs=self.param_specs.get("blocks")
+                if isinstance(self.param_specs, dict) else None)
             with self.mesh:
                 self._fwd_bwd_fn = jax.jit(
                     step,
