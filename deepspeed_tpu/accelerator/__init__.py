@@ -1,0 +1,28 @@
+"""Accelerator selection (reference ``accelerator/real_accelerator.py:37-55``)."""
+
+from .abstract_accelerator import DeepSpeedAccelerator  # noqa: F401
+from .tpu_accelerator import TPU_Accelerator  # noqa: F401
+
+_accelerator = None
+
+
+def get_accelerator():
+    global _accelerator
+    if _accelerator is None:
+        _accelerator = TPU_Accelerator()
+    return _accelerator
+
+
+def set_accelerator(accel):
+    """Register an out-of-tree accelerator BEFORE first use (the reference
+    raises on late registration too)."""
+    global _accelerator
+    if _accelerator is not None and _accelerator is not accel:
+        raise RuntimeError(
+            "set_accelerator called after get_accelerator; register the "
+            "backend before any framework component touches the platform")
+    _accelerator = accel
+
+
+__all__ = ["DeepSpeedAccelerator", "TPU_Accelerator", "get_accelerator",
+           "set_accelerator"]

@@ -1,0 +1,118 @@
+"""Accelerator abstraction: the pluggable-platform seam.
+
+TPU-native counterpart of the reference's ``accelerator/abstract_accelerator.py``
+(``DeepSpeedAccelerator`` ABC, ~60 methods) + ``real_accelerator.py`` selection
+logic. The reference uses this seam to retarget torch code across CUDA/XPU/CPU;
+here the compute API is JAX itself (device placement, RNG, and streams are
+jax-level concepts), so the abstraction carries what a second backend would
+actually need to swap:
+
+- device enumeration / selection / properties,
+- memory statistics and empty-cache semantics,
+- dtype capability flags (bf16/fp16/fp64),
+- the communication-backend name the comm layer initializes,
+- synchronization (the "stream" surface collapses to ``block_until_ready`` —
+  XLA programs are the streams),
+- op-builder dispatch (which native extensions exist and how to build them),
+- RNG seeding helpers.
+
+``get_accelerator()`` returns the process-wide accelerator;
+``set_accelerator()`` registers an out-of-tree implementation before first use
+(the reference's ``set_accelerator`` contract, ``real_accelerator.py:55``).
+"""
+
+import abc
+
+
+class DeepSpeedAccelerator(abc.ABC):
+    """Capability surface a backend must provide (subset of the reference ABC
+    that is meaningful under a compiled-XLA execution model; the stream/event
+    and tensor-factory groups collapse — see class docstring)."""
+
+    name: str = ""
+
+    # ---- device management (reference :18-42) --------------------------------
+    @abc.abstractmethod
+    def devices(self):
+        """All addressable accelerator devices (jax.Device list)."""
+
+    @abc.abstractmethod
+    def device_count(self):
+        ...
+
+    @abc.abstractmethod
+    def current_device(self):
+        """Default device for uncommitted arrays."""
+
+    @abc.abstractmethod
+    def device_name(self, device_index=None):
+        """Human-readable device kind (e.g. 'TPU v5e')."""
+
+    def is_available(self):
+        return self.device_count() > 0
+
+    # ---- synchronization (reference Streams/Events :77-94) -------------------
+    def synchronize(self, x=None):
+        """Block until outstanding work on ``x`` (or everything) finishes.
+        Streams/events have no user-level analog under XLA: each compiled
+        program is an ordered stream; donation expresses the dependencies."""
+        import jax
+
+        if x is not None:
+            return jax.block_until_ready(x)
+        for d in self.devices():
+            try:
+                d.synchronize_all_activity()
+            except AttributeError:
+                pass
+        return None
+
+    # ---- memory (reference :99-143) ------------------------------------------
+    @abc.abstractmethod
+    def memory_stats(self, device_index=None):
+        """dict with at least bytes_in_use / bytes_limit when the platform
+        reports them (empty dict otherwise)."""
+
+    def memory_allocated(self, device_index=None):
+        return self.memory_stats(device_index).get("bytes_in_use", 0)
+
+    def total_memory(self, device_index=None):
+        return self.memory_stats(device_index).get("bytes_limit", 0)
+
+    def available_memory(self, device_index=None):
+        s = self.memory_stats(device_index)
+        return max(0, s.get("bytes_limit", 0) - s.get("bytes_in_use", 0))
+
+    def empty_cache(self):
+        """XLA owns the allocator; live buffers are freed by dropping
+        references (donation in-program). No-op hook for API parity."""
+
+    # ---- dtype capabilities (reference :148-161) -----------------------------
+    def is_bf16_supported(self):
+        return True
+
+    def is_fp16_supported(self):
+        return True
+
+    def is_fp64_supported(self):
+        return False
+
+    # ---- RNG (reference :47-71) ----------------------------------------------
+    def manual_seed(self, seed):
+        import jax
+
+        return jax.random.PRNGKey(seed)
+
+    # ---- communication backend (reference :177) ------------------------------
+    @abc.abstractmethod
+    def communication_backend_name(self):
+        """What comm.init_distributed initializes over."""
+
+    # ---- op builders (reference :225-239) ------------------------------------
+    @abc.abstractmethod
+    def op_builder(self, name):
+        """Return the OpBuilder class for a named native op, or None."""
+
+    def create_op_builder(self, name):
+        cls = self.op_builder(name)
+        return cls() if cls is not None else None

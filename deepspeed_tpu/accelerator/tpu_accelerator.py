@@ -1,0 +1,46 @@
+"""The TPU (and virtual-CPU-mesh) accelerator implementation.
+
+Reference counterpart: ``accelerator/cuda_accelerator.py`` (``CUDA_Accelerator``)
+— one concrete class retargets the whole stack. ``communication_backend_name``
+is what ``comm.init_distributed`` brings up (the reference returns 'nccl'
+there; here the collectives ride XLA over ICI/DCN via ``jax.distributed``)."""
+
+from .abstract_accelerator import DeepSpeedAccelerator
+
+
+class TPU_Accelerator(DeepSpeedAccelerator):
+    name = "tpu"
+
+    def devices(self):
+        import jax
+
+        return jax.devices()
+
+    def device_count(self):
+        return len(self.devices())
+
+    def current_device(self):
+        return self.devices()[0]
+
+    def device_name(self, device_index=None):
+        d = self.devices()[device_index or 0]
+        return getattr(d, "device_kind", str(d))
+
+    def memory_stats(self, device_index=None):
+        d = self.devices()[device_index or 0]
+        stats = getattr(d, "memory_stats", lambda: None)()
+        return dict(stats) if stats else {}
+
+    def is_fp64_supported(self):
+        import jax
+
+        return bool(jax.config.jax_enable_x64) and \
+            self.devices()[0].platform == "cpu"
+
+    def communication_backend_name(self):
+        return "xla"  # jax.distributed + XLA collectives (ICI/DCN)
+
+    def op_builder(self, name):
+        from ..ops.op_builder import ALL_OPS
+
+        return ALL_OPS.get(name)

@@ -77,12 +77,10 @@ class Autotuner:
 
     @staticmethod
     def _detect_memory():
-        import jax
+        from ..accelerator import get_accelerator
 
-        stats = getattr(jax.devices()[0], "memory_stats", lambda: None)()
-        if stats and "bytes_limit" in stats:
-            return stats["bytes_limit"]
-        return 12 * 2 ** 30  # conservative default when the backend won't say
+        limit = get_accelerator().total_memory()
+        return limit or 12 * 2 ** 30  # conservative when the backend won't say
 
     # ------------------------------------------------------------------
     def search_space(self, n_devices, global_batch):

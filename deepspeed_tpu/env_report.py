@@ -34,11 +34,22 @@ def main():
         import jax
         import jaxlib
 
+        from .accelerator import get_accelerator
+
+        accel = get_accelerator()
         print(f"jax / jaxlib ......... {jax.__version__} / {jaxlib.__version__}")
-        devices = jax.devices()
         print(f"backend .............. {jax.default_backend()}")
-        print(f"devices .............. {len(devices)} x {devices[0].device_kind}")
+        print(f"accelerator .......... {accel.name} "
+              f"(comm backend: {accel.communication_backend_name()})")
+        print(f"devices .............. {accel.device_count()} x {accel.device_name()}")
+        mem = accel.total_memory()
+        if mem:
+            print(f"memory/device ........ {mem / 2**30:.1f} GiB")
         print(f"process count ........ {jax.process_count()}")
+        aio = accel.create_op_builder("async_io")
+        if aio is not None:
+            ok = aio.is_compatible()
+            print(f"op async_io .......... {GREEN_OK if ok else RED_NO}")
     except Exception as e:
         print(f"jax .................. {RED_NO} ({e})")
 

@@ -1,3 +1,7 @@
 from .builder import OpBuilder, AsyncIOBuilder
 
-__all__ = ["OpBuilder", "AsyncIOBuilder"]
+# named registry consumed by the accelerator's op-builder dispatch
+# (reference ``op_builder/__init__.py`` builder_closure / ALL_OPS)
+ALL_OPS = {"async_io": AsyncIOBuilder}
+
+__all__ = ["OpBuilder", "AsyncIOBuilder", "ALL_OPS"]

@@ -1,0 +1,77 @@
+"""Accelerator abstraction (reference ``accelerator/abstract_accelerator.py`` +
+``real_accelerator.py`` selection; tests/accelerator/test_ds_init.py role)."""
+
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.accelerator import (DeepSpeedAccelerator, TPU_Accelerator,
+                                       get_accelerator)
+
+
+def test_get_accelerator_singleton_and_surface(devices8):
+    a = get_accelerator()
+    assert a is get_accelerator()
+    assert isinstance(a, TPU_Accelerator)
+    assert a.is_available()
+    assert a.device_count() >= 8
+    assert a.device_name()  # non-empty kind string
+    assert a.communication_backend_name() == "xla"
+    assert isinstance(a.memory_stats(), dict)
+    assert a.is_bf16_supported() and a.is_fp16_supported()
+
+
+def test_accelerator_sync_and_rng(devices8):
+    import jax.numpy as jnp
+
+    a = get_accelerator()
+    x = jnp.arange(8) * 2
+    assert a.synchronize(x) is x or np.asarray(a.synchronize(x)).shape == (8,)
+    key = a.manual_seed(0)
+    key2 = a.manual_seed(0)
+    np.testing.assert_array_equal(np.asarray(key), np.asarray(key2))
+
+
+def test_op_builder_dispatch():
+    a = get_accelerator()
+    b = a.create_op_builder("async_io")
+    assert b is not None and hasattr(b, "is_compatible")
+    assert a.op_builder("nonexistent_op") is None
+
+
+def test_set_accelerator_after_use_raises():
+    with pytest.raises(RuntimeError):
+        deepspeed_tpu.set_accelerator(object())
+
+
+def test_custom_accelerator_subclass_contract(devices8):
+    """A second backend only needs the abstract core."""
+
+    class Fake(DeepSpeedAccelerator):
+        name = "fake"
+
+        def devices(self):
+            return ["d0"]
+
+        def device_count(self):
+            return 1
+
+        def current_device(self):
+            return "d0"
+
+        def device_name(self, device_index=None):
+            return "FakeChip"
+
+        def memory_stats(self, device_index=None):
+            return {"bytes_in_use": 10, "bytes_limit": 100}
+
+        def communication_backend_name(self):
+            return "fake"
+
+        def op_builder(self, name):
+            return None
+
+    f = Fake()
+    assert f.available_memory() == 90
+    assert f.memory_allocated() == 10
+    assert f.create_op_builder("x") is None
