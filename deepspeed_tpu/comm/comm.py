@@ -266,6 +266,48 @@ def broadcast_obj(obj, src=0):
     return pickle.loads(np.asarray(buf).tobytes())
 
 
+def assert_same_across_ranks(values, name="value"):
+    """Cross-rank consistency guard (reference ``runtime/zero/utils.py:73``
+    ``assert_ints_same_as_other_ranks`` + the ZeRO-3 ``safe_mode`` checks,
+    ``partition_parameters.py:898``): every process must hold the same host-side
+    values, else SPMD programs will silently diverge (different shapes compile
+    different programs; different step counts desynchronize collectives).
+
+    ``values``: pytree of ints/floats/arrays compared by fingerprint. Raises
+    ``RuntimeError`` naming the first differing rank. Single-process: no-op.
+    """
+    if jax.process_count() == 1:
+        return
+    from jax.experimental import multihost_utils
+
+    leaves = jax.tree_util.tree_leaves(values)
+    fp = np.zeros(2, np.float64)
+    for i, leaf in enumerate(leaves):
+        a = np.asarray(leaf, np.float64)
+        fp[0] += float(a.sum()) * (i + 1)
+        fp[1] += float(a.size) * (i + 1) + len(leaves)
+    all_fp = multihost_utils.process_allgather(fp)
+    mine = all_fp[jax.process_index()]
+    for r, other in enumerate(all_fp):
+        if not np.allclose(other, mine):
+            raise RuntimeError(
+                f"assert_same_across_ranks('{name}'): rank {r} disagrees with "
+                f"rank {jax.process_index()} (fingerprints {other} vs {mine}) — "
+                f"SPMD divergence")
+
+
+def in_program_rank_check(x, axis_name):
+    """In-program variant: max-minus-min over the axis must be 0 if every
+    device computed the same scalar (the reference's ``CheckOverflow``-style
+    cross-replica validation). Returns a bool scalar usable in ``jnp.where`` /
+    assert-style masking inside jit."""
+    import jax.numpy as jnp
+
+    hi = jax.lax.pmax(x, axis_name)
+    lo = jax.lax.pmin(x, axis_name)
+    return (hi - lo) == jnp.zeros_like(x)
+
+
 @contextmanager
 def comms_profiling(config):
     comms_logger.configure(config)

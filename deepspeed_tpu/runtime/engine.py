@@ -831,6 +831,11 @@ class DeepSpeedEngine:
     # ------------------------------------------------------------------------------
     def save_checkpoint(self, save_dir, tag=None, client_state=None):
         tag = tag or f"global_step{self.global_steps}"
+        # all ranks must save the same tag/step or shard files interleave
+        # (reference engine.py:2781 checkpoint tag validation)
+        dist.assert_same_across_ranks(
+            {"tag": np.frombuffer(tag.encode(), np.uint8),
+             "step": self.global_steps}, name="checkpoint tag")
         if self._offloaded is not None:
             state = {
                 "params": self._offloaded.masters,  # fp32 masters, not bf16 copies

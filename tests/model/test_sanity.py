@@ -1,0 +1,85 @@
+"""Model/integration tier (reference ``tests/model/`` — BingBertSquad /
+Megatron sanity runs): one real end-to-end convergence + resume + serve flow
+on a small-but-not-toy model. Heavier than unit tests; marked slow.
+"""
+
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models import CausalLM, TransformerConfig
+
+pytestmark = pytest.mark.slow
+
+
+def _corpus(vocab, n, s, seed=0):
+    """Synthetic 'language': next token = (3 * tok + 7) % vocab with noise,
+    so a real model can actually learn structure (loss well below uniform)."""
+    rng = np.random.RandomState(seed)
+    first = rng.randint(0, vocab, (n, 1))
+    rows = [first]
+    for _ in range(s - 1):
+        nxt = (3 * rows[-1] + 7) % vocab
+        noise = rng.randint(0, vocab, nxt.shape)
+        mask = rng.rand(*nxt.shape) < 0.1
+        rows.append(np.where(mask, noise, nxt))
+    return np.concatenate(rows, axis=1).astype(np.int32)
+
+
+def test_end_to_end_train_resume_serve(tmp_path, devices8):
+    import jax.numpy as jnp
+
+    vocab, s = 64, 32
+    model_kw = dict(vocab_size=vocab, max_seq_len=s, n_layers=4, n_heads=4,
+                    d_model=64, d_ff=128, compute_dtype=jnp.float32)
+    config = {
+        "train_batch_size": 16,
+        "gradient_accumulation_steps": 2,
+        "optimizer": {"type": "adamw", "params": {"lr": 3e-3}},
+        "scheduler": {"type": "WarmupLR",
+                      "params": {"warmup_num_steps": 5,
+                                 "warmup_max_lr": 3e-3}},
+        "zero_optimization": {"stage": 2},
+        "gradient_clipping": 1.0,
+        "mesh": {"data": 8},
+        "steps_per_print": 10 ** 9,
+    }
+    data = _corpus(vocab, 512, s)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=CausalLM(TransformerConfig(**model_kw)), config=config)
+
+    rng = np.random.RandomState(1)
+    losses = []
+    for step in range(30):
+        rows = rng.randint(0, len(data), 8)
+        losses.append(float(engine.train_batch(
+            batch={"input_ids": data[rows]})))
+    uniform = np.log(vocab)
+    assert losses[-1] < 0.6 * uniform, (losses[0], losses[-1])
+    assert np.mean(losses[-5:]) < np.mean(losses[:5])
+
+    # ---- checkpoint -> resume continues from the same loss level ------------
+    engine.save_checkpoint(str(tmp_path), tag="sanity")
+    resumed, _, _, _ = deepspeed_tpu.initialize(
+        model=CausalLM(TransformerConfig(**model_kw)), config=config)
+    resumed.load_checkpoint(str(tmp_path), tag="sanity")
+    rows = rng.randint(0, len(data), 8)
+    batch = {"input_ids": data[rows]}
+    la = float(engine.eval_batch(batch))
+    lb = float(resumed.eval_batch(batch))
+    np.testing.assert_allclose(la, lb, rtol=1e-5)
+    assert resumed.global_steps == engine.global_steps
+
+    # ---- serve the trained weights ------------------------------------------
+    inf = deepspeed_tpu.init_inference(
+        CausalLM(TransformerConfig(**model_kw)), dtype="float32",
+        max_tokens=s)
+    inf.load_checkpoint(str(tmp_path), tag="sanity")
+    prompt = data[:2, :8]
+    out = inf.generate(prompt, max_new_tokens=8, greedy=True)
+    assert out.shape == (2, 16)
+    # the learned structure shows: greedy continuation mostly follows the rule
+    pred = np.asarray(out[:, 8:])
+    expect = (3 * np.asarray(out[:, 7:-1]) + 7) % vocab
+    agree = float((pred == expect).mean())
+    assert agree > 0.5, agree

@@ -88,3 +88,21 @@ def worker_that_hangs():
     if dist.get_rank() == 1:
         time.sleep(3600)
     dist.barrier()
+
+
+def rank_consistency_pass_and_fail():
+    import numpy as np
+
+    import deepspeed_tpu.comm as dist
+
+    # same values everywhere -> passes
+    dist.assert_same_across_ranks({"step": 7, "shape": np.array([4, 8])},
+                                  name="meta")
+    # rank-varying value -> must raise on every process
+    try:
+        dist.assert_same_across_ranks({"step": dist.get_rank()}, name="step")
+    except RuntimeError as e:
+        assert "SPMD divergence" in str(e)
+    else:
+        raise AssertionError("divergent values were not detected")
+    dist.barrier()

@@ -137,3 +137,33 @@ def test_world_helpers():
     assert dist.get_global_device_count() >= 8
     dist.barrier()  # no-op single process
     assert dist.broadcast_obj({"a": 1}) == {"a": 1}
+
+
+def test_in_program_rank_check(devices8):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    import deepspeed_tpu.comm as dist
+
+    mesh = Mesh(np.array(devices8), ("data",))
+
+    def body(x):
+        same = dist.in_program_rank_check(jnp.sum(x), "data")
+        diverged = dist.in_program_rank_check(
+            jax.lax.axis_index("data").astype(jnp.float32), "data")
+        return same, diverged
+
+    sm = jax.shard_map(body, mesh=mesh, in_specs=P("data"),
+                       out_specs=(P(), P()), axis_names={"data"},
+                       check_vma=False)
+    same, diverged = sm(jnp.ones((8, 4)))
+    assert bool(np.asarray(same).reshape(-1)[0])
+    assert not bool(np.asarray(diverged).reshape(-1)[0])
+
+
+def test_assert_same_across_ranks_single_process_noop():
+    import deepspeed_tpu.comm as dist
+
+    dist.assert_same_across_ranks({"a": 1})  # world_size 1: no-op

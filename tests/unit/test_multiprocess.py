@@ -29,3 +29,8 @@ def test_hang_detection_kills_workers():
     with pytest.raises(AssertionError, match="hung|exited"):
         run_distributed("tests.mp_targets:worker_that_hangs", world_size=2,
                         timeout=45)
+
+
+def test_rank_consistency_guard_two_processes():
+    run_distributed("tests.mp_targets:rank_consistency_pass_and_fail",
+                    world_size=2)
