@@ -1,11 +1,14 @@
 from .layers import Param, split_params_axes
-from .transformer import CausalLM, TransformerConfig, cross_entropy_loss
-from .registry import get_model, MODEL_CONFIGS, gpt2_config, opt_config, bloom_config, llama_config
+from .transformer import CausalLM, MaskedLM, TransformerConfig, cross_entropy_loss
+from .registry import (get_model, MODEL_CONFIGS, gpt2_config, opt_config,
+                       bloom_config, llama_config, bert_config)
 from .simple import SimpleModel, random_batch
 from .spatial import (DSUNet, DSVAE, SpatialConfig, SpatialUNet,
                       SpatialVAEDecoder)
 
 __all__ = [
+    "MaskedLM",
+    "bert_config",
     "DSUNet",
     "DSVAE",
     "SpatialConfig",

@@ -81,17 +81,42 @@ def llama_config(size="7b", **overrides):
     return TransformerConfig(**base)
 
 
+def bert_config(size="base", **overrides):
+    """Encoder presets (BERT paper table 1 geometry): post-norm, bidirectional,
+    learned positions + segment embeddings, gelu, embed LN."""
+    presets = {
+        "tiny": dict(n_layers=2, d_model=128, n_heads=2, d_ff=512, max_seq_len=256),
+        "base": dict(n_layers=12, d_model=768, n_heads=12, d_ff=3072),
+        "large": dict(n_layers=24, d_model=1024, n_heads=16, d_ff=4096),
+    }
+    base = dict(
+        vocab_size=30528,  # wordpiece 30522 padded to a multiple of 64
+        max_seq_len=512, activation="gelu", norm="layernorm",
+        position_embedding="learned", tie_embeddings=True, use_bias=True,
+        prenorm=False, causal=False, embed_layernorm=True, type_vocab_size=2,
+    )
+    base.update(presets[size])
+    base.update(overrides)
+    return TransformerConfig(**base)
+
+
 MODEL_CONFIGS = {
     "gpt2": gpt2_config,
     "opt": opt_config,
     "bloom": bloom_config,
     "llama": llama_config,
+    "bert": bert_config,
 }
 
 
 def get_model(family, size=None, **overrides):
-    """Build a CausalLM by family name, e.g. get_model('gpt2', 'medium')."""
+    """Build a model by family name, e.g. get_model('gpt2', 'medium').
+    Encoder families (bert) return a MaskedLM; the rest a CausalLM."""
+    from .transformer import MaskedLM
+
     if family not in MODEL_CONFIGS:
         raise ValueError(f"Unknown model family '{family}'. Available: {sorted(MODEL_CONFIGS)}")
     kwargs = {} if size is None else {"size": size}
-    return CausalLM(MODEL_CONFIGS[family](**kwargs, **overrides))
+    cfg = MODEL_CONFIGS[family](**kwargs, **overrides)
+    cls = MaskedLM if not cfg.causal else CausalLM
+    return cls(cfg)

@@ -109,7 +109,7 @@ def resnet_block_init(rng, in_ch, out_ch, temb_dim):
         "conv2": conv2d_init(r[1], out_ch, out_ch),
     }
     if temb_dim:
-        p["temb"] = L.linear_init(r[2], temb_dim, out_ch, (("embed",), (None,)))
+        p["temb"] = L.linear_init(r[2], temb_dim, out_ch, ("embed", None))
     if in_ch != out_ch:
         p["skip"] = conv2d_init(r[3], in_ch, out_ch, kernel=1)
     return p
@@ -137,10 +137,10 @@ def spatial_transformer_init(rng, ch, n_heads, context_dim):
     }
     if context_dim:
         p["ln_cross"] = L.layernorm_init(ch)
-        p["cross_q"] = L.linear_init(r[1], ch, ch, (("embed",), ("heads",)))
-        p["cross_k"] = L.linear_init(r[2], context_dim, ch, ((None,), ("heads",)))
-        p["cross_v"] = L.linear_init(r[3], context_dim, ch, ((None,), ("heads",)))
-        p["cross_o"] = L.linear_init(r[4], ch, ch, (("heads",), ("embed",)))
+        p["cross_q"] = L.linear_init(r[1], ch, ch, ("embed", "heads"))
+        p["cross_k"] = L.linear_init(r[2], context_dim, ch, (None, "heads"))
+        p["cross_v"] = L.linear_init(r[3], context_dim, ch, (None, "heads"))
+        p["cross_o"] = L.linear_init(r[4], ch, ch, ("heads", "embed"))
     return p
 
 
@@ -191,9 +191,9 @@ class SpatialUNet:
         r = iter(jax.random.split(rng, 64))
         p = {
             "temb1": L.linear_init(next(r), cfg.base_channels, temb_dim,
-                                   ((None,), (None,))),
+                                   (None, None)),
             "temb2": L.linear_init(next(r), temb_dim, temb_dim,
-                                   ((None,), (None,))),
+                                   (None, None)),
             "conv_in": conv2d_init(next(r), cfg.in_channels, chans[0]),
         }
         down, ch = [], chans[0]

@@ -48,6 +48,10 @@ class TransformerConfig:
     mlp_bias: typing.Optional[bool] = None  # None -> use_bias (GPT-J: attn
     # projections have no bias but the MLP does)
     embed_layernorm: bool = False  # LN right after the embedding (BLOOM)
+    # causal=False -> bidirectional (encoder) attention: BERT-family models
+    causal: bool = True
+    # segment/token-type embeddings (BERT); 0 disables
+    type_vocab_size: int = 0
     use_bias: bool = True
     prenorm: bool = True
     parallel_attn_mlp: bool = False
@@ -250,10 +254,11 @@ def block_apply(cfg, p, x, mask=None, rope=None, alibi=None, deterministic=True,
 
             if seq_manual:
                 # already inside the pipeline's manual region over {pipe, seq}
-                out = ring_attention_manual(q, k, v, kv_mask=kv_mask, causal=True)
+                out = ring_attention_manual(q, k, v, kv_mask=kv_mask,
+                                            causal=cfg.causal)
             else:
                 out = ring_attention(q, k, v, cfg.mesh, kv_mask=kv_mask,
-                                     causal=True)
+                                     causal=cfg.causal)
             out = checkpoint_name(out, "attn_out")
             return o_proj(out)
         # flash path: plain causal attention, no padding mask / alibi / dropout
@@ -264,9 +269,10 @@ def block_apply(cfg, p, x, mask=None, rope=None, alibi=None, deterministic=True,
         if flash_ok:
             from ..ops.flash_attention import flash_attention
 
-            out = flash_attention(q, k, v, causal=True)
+            out = flash_attention(q, k, v, causal=cfg.causal)
         else:
-            dense_mask = mask if mask is not None else L.causal_mask(s, s)
+            dense_mask = mask if mask is not None else (
+                L.causal_mask(s, s) if cfg.causal else None)
             drop_rng = None
             if not deterministic and dropout_rng is not None and cfg.attn_dropout > 0:
                 drop_rng = jax.random.fold_in(dropout_rng, 1)
@@ -476,6 +482,15 @@ class CausalLM:
                     ("seq_table", "embed"),
                 )
             }
+        if cfg.type_vocab_size:
+            params["wtt"] = {
+                "weight": Param(
+                    L.normal_init(jax.random.fold_in(k_pos, 1),
+                                  (cfg.type_vocab_size, cfg.d_model),
+                                  cfg.initializer_range),
+                    (None, "embed"),
+                )
+            }
         if cfg.embed_layernorm:
             params["ln_emb"] = _norm_init(cfg)
         if not cfg.tie_embeddings:
@@ -487,7 +502,7 @@ class CausalLM:
 
     # -- forward ------------------------------------------------------------------
     def backbone(self, params, input_ids, positions=None, attention_mask=None,
-                 deterministic=True, dropout_rng=None):
+                 deterministic=True, dropout_rng=None, token_type_ids=None):
         """Embedding + blocks + final norm -> ([batch, seq, d_model], aux)."""
         cfg = self.config
         b, s = input_ids.shape
@@ -497,19 +512,25 @@ class CausalLM:
         x = L.embedding_apply(params["wte"], input_ids, cfg.compute_dtype)
         if cfg.position_embedding == "learned":
             x = x + jnp.take(params["wpe"]["weight"].astype(cfg.compute_dtype), positions, axis=0)
+        if cfg.type_vocab_size and token_type_ids is not None:
+            x = x + jnp.take(params["wtt"]["weight"].astype(cfg.compute_dtype),
+                             token_type_ids, axis=0)
         if cfg.embed_layernorm:
             x = _norm_apply(cfg, params["ln_emb"], x)
 
-        # mask=None means "plain causal" — lets the flash kernel run; an explicit
-        # padding mask forces the dense path. Under sequence parallelism the
-        # padding mask stays in [b, s] form and rides the ring with K/V.
+        # mask=None means "plain causal (or fully bidirectional for encoders)"
+        # — lets the flash kernel run; an explicit padding mask forces the
+        # dense path. Under sequence parallelism the padding mask stays in
+        # [b, s] form and rides the ring with K/V.
         mask = None
         kv_mask = None
         if attention_mask is not None:
             if cfg.sequence_parallel:
                 kv_mask = attention_mask.astype(bool)
             else:
-                mask = L.causal_mask(s, s) & attention_mask[:, None, None, :].astype(bool)
+                pad = attention_mask[:, None, None, :].astype(bool)
+                mask = (L.causal_mask(s, s) & pad) if cfg.causal else \
+                    jnp.broadcast_to(pad, (b, 1, s, s))
 
         rope = None
         if cfg.position_embedding == "rope":
@@ -576,6 +597,70 @@ class CausalLM:
             dropout_rng=dropout_rng,
         )
         return self.head_ce(params, x, labels) + aux
+
+
+class MaskedLM(CausalLM):
+    """Encoder (BERT-family) over the same backbone: bidirectional attention
+    (``causal=False``), post-norm blocks, token-type embeddings, and the BERT
+    MLM prediction head (dense + gelu + LN + tied decoder with its own bias —
+    the reference's kernel-accelerated BERT training target,
+    ``docs/_tutorials/bert-pretraining.md`` / ``tests/unit/modeling.py``).
+
+    batch: {input_ids, labels, attention_mask?, token_type_ids?}; labels use
+    the HF convention (-100 everywhere except the masked positions).
+    """
+
+    def init(self, rng):
+        cfg = self.config
+        if cfg.causal:
+            raise ValueError("MaskedLM requires causal=False (a bert_config "
+                             "preset from models/registry.py)")
+        params = super().init(rng)
+        k1, k2 = jax.random.split(jax.random.fold_in(rng, 17))
+        params["mlm_transform"] = L.linear_init(
+            k1, cfg.d_model, cfg.d_model, ("embed", None),
+            stddev=cfg.initializer_range)
+        params["mlm_ln"] = L.layernorm_init(cfg.d_model)
+        # decoder reuses wte (tied) but keeps a separate output bias
+        params["mlm_bias"] = {
+            "bias": Param(jnp.zeros((cfg.vocab_size,)), ("vocab",))}
+        return params
+
+    def head(self, params, x):
+        cfg = self.config
+        h = L.linear_apply(params["mlm_transform"], x)
+        h = jax.nn.gelu(h)
+        h = L.layernorm_apply(params["mlm_ln"], h)
+        logits = L.embedding_attend(params["wte"], h)
+        return logits + params["mlm_bias"]["bias"].astype(logits.dtype)
+
+    def head_ce(self, params, x, labels):
+        cfg = self.config
+        h = L.layernorm_apply(params["mlm_ln"],
+                              jax.nn.gelu(L.linear_apply(params["mlm_transform"], x)))
+        if cfg.fused_ce:
+            from ..ops.cross_entropy import fused_cross_entropy
+
+            return fused_cross_entropy(
+                h.reshape(-1, cfg.d_model), params["wte"]["weight"],
+                labels.reshape(-1), params["mlm_bias"]["bias"])
+        logits = L.embedding_attend(params["wte"], h) \
+            + params["mlm_bias"]["bias"].astype(cfg.compute_dtype)
+        return cross_entropy_loss(logits, labels)
+
+    def loss(self, params, batch, deterministic=True, dropout_rng=None):
+        """Masked-token cross entropy; no label shifting (denoising, not AR)."""
+        if "labels" not in batch:
+            raise ValueError("MaskedLM.loss needs explicit 'labels' "
+                             "(-100 outside masked positions)")
+        x, aux = self.backbone(
+            params, batch["input_ids"],
+            attention_mask=batch.get("attention_mask"),
+            positions=batch.get("position_ids"),
+            token_type_ids=batch.get("token_type_ids"),
+            deterministic=deterministic, dropout_rng=dropout_rng,
+        )
+        return self.head_ce(params, x, batch["labels"]) + aux
 
 
 def cross_entropy_loss(logits, labels, ignore_index=-100):
