@@ -94,6 +94,7 @@ def bert_config(size="base", **overrides):
         max_seq_len=512, activation="gelu", norm="layernorm",
         position_embedding="learned", tie_embeddings=True, use_bias=True,
         prenorm=False, causal=False, embed_layernorm=True, type_vocab_size=2,
+        final_layernorm=False,  # post-norm blocks end with LN; BERT has no ln_f
     )
     base.update(presets[size])
     base.update(overrides)

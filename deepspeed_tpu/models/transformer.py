@@ -52,6 +52,8 @@ class TransformerConfig:
     causal: bool = True
     # segment/token-type embeddings (BERT); 0 disables
     type_vocab_size: int = 0
+    # post-norm encoders (BERT) end each block with LN and have no final norm
+    final_layernorm: bool = True
     use_bias: bool = True
     prenorm: bool = True
     parallel_attn_mlp: bool = False
@@ -473,8 +475,9 @@ class CausalLM:
         params = {
             "wte": L.embedding_init(k_emb, cfg.vocab_size, cfg.d_model, cfg.initializer_range),
             "blocks": stack_init(k_blocks, cfg),
-            "ln_f": _norm_init(cfg),
         }
+        if cfg.final_layernorm:
+            params["ln_f"] = _norm_init(cfg)
         if cfg.position_embedding == "learned":
             params["wpe"] = {
                 "weight": Param(
@@ -543,7 +546,8 @@ class CausalLM:
         x, aux = stack_apply(cfg, params["blocks"], x, mask=mask, rope=rope,
                              alibi=alibi, deterministic=deterministic,
                              dropout_rng=dropout_rng, kv_mask=kv_mask)
-        x = _norm_apply(cfg, params["ln_f"], x)
+        if cfg.final_layernorm:
+            x = _norm_apply(cfg, params["ln_f"], x)
         return x, aux
 
     def head(self, params, x):
@@ -626,18 +630,20 @@ class MaskedLM(CausalLM):
             "bias": Param(jnp.zeros((cfg.vocab_size,)), ("vocab",))}
         return params
 
-    def head(self, params, x):
+    def _mlm_transform(self, params, x):
         cfg = self.config
         h = L.linear_apply(params["mlm_transform"], x)
-        h = jax.nn.gelu(h)
-        h = L.layernorm_apply(params["mlm_ln"], h)
+        h = L.ACTIVATIONS[cfg.activation](h)  # BERT: exact-erf gelu
+        return L.layernorm_apply(params["mlm_ln"], h, eps=cfg.layernorm_eps)
+
+    def head(self, params, x):
+        h = self._mlm_transform(params, x)
         logits = L.embedding_attend(params["wte"], h)
         return logits + params["mlm_bias"]["bias"].astype(logits.dtype)
 
     def head_ce(self, params, x, labels):
         cfg = self.config
-        h = L.layernorm_apply(params["mlm_ln"],
-                              jax.nn.gelu(L.linear_apply(params["mlm_transform"], x)))
+        h = self._mlm_transform(params, x)
         if cfg.fused_ce:
             from ..ops.cross_entropy import fused_cross_entropy
 
@@ -648,16 +654,33 @@ class MaskedLM(CausalLM):
             + params["mlm_bias"]["bias"].astype(cfg.compute_dtype)
         return cross_entropy_loss(logits, labels)
 
+    def apply(self, params, input_ids, positions=None, attention_mask=None,
+              deterministic=True, dropout_rng=None, return_aux=False,
+              token_type_ids=None):
+        cfg = self.config
+        if token_type_ids is None and cfg.type_vocab_size:
+            token_type_ids = jnp.zeros_like(input_ids)  # HF default segment 0
+        x, aux = self.backbone(params, input_ids, positions=positions,
+                               attention_mask=attention_mask,
+                               token_type_ids=token_type_ids,
+                               deterministic=deterministic,
+                               dropout_rng=dropout_rng)
+        logits = self.head(params, x)
+        return (logits, aux) if return_aux else logits
+
     def loss(self, params, batch, deterministic=True, dropout_rng=None):
         """Masked-token cross entropy; no label shifting (denoising, not AR)."""
         if "labels" not in batch:
             raise ValueError("MaskedLM.loss needs explicit 'labels' "
                              "(-100 outside masked positions)")
+        token_type_ids = batch.get("token_type_ids")
+        if token_type_ids is None and self.config.type_vocab_size:
+            token_type_ids = jnp.zeros_like(batch["input_ids"])
         x, aux = self.backbone(
             params, batch["input_ids"],
             attention_mask=batch.get("attention_mask"),
             positions=batch.get("position_ids"),
-            token_type_ids=batch.get("token_type_ids"),
+            token_type_ids=token_type_ids,
             deterministic=deterministic, dropout_rng=dropout_rng,
         )
         return self.head_ce(params, x, batch["labels"]) + aux

@@ -119,13 +119,13 @@ class _Reader:
 # ---------------------------------------------------------------------------
 def detect_family(hf_config):
     mt = hf_config.get("model_type", "")
-    if mt in ("gpt2", "opt", "bloom", "llama", "gptj", "gpt_neox"):
+    if mt in ("gpt2", "opt", "bloom", "llama", "gptj", "gpt_neox", "bert"):
         return mt
     if mt == "mistral":
         return "llama"
     raise ValueError(f"Unsupported HF model_type '{mt}' "
                      "(supported: gpt2, opt, bloom, llama, mistral, gptj, "
-                     "gpt_neox)")
+                     "gpt_neox, bert)")
 
 
 def config_from_hf(hf_config, **overrides):
@@ -198,6 +198,22 @@ def config_from_hf(hf_config, **overrides):
             parallel_attn_mlp=g("use_parallel_residual", True),
             parallel_norm_split=g("use_parallel_residual", True),
             layernorm_eps=g("layer_norm_eps", 1e-5),
+        )
+    elif fam == "bert":
+        # post-norm encoder, no final LN, segment embeddings, MLM head
+        # (reference container: containers/bert.py HFBertLayerPolicy)
+        kw = dict(
+            vocab_size=g("vocab_size"),
+            max_seq_len=g("max_position_embeddings", 512),
+            n_layers=g("num_hidden_layers"), n_heads=g("num_attention_heads"),
+            d_model=g("hidden_size"), d_ff=g("intermediate_size"),
+            activation={"gelu": "gelu_exact", "gelu_new": "gelu_new",
+                        "relu": "relu"}[g("hidden_act", "gelu")],
+            norm="layernorm", position_embedding="learned",
+            tie_embeddings=True, use_bias=True, prenorm=False, causal=False,
+            embed_layernorm=True, final_layernorm=False,
+            type_vocab_size=g("type_vocab_size", 2),
+            layernorm_eps=g("layer_norm_eps", 1e-12),
         )
     else:  # llama / mistral
         kw = dict(
@@ -374,7 +390,31 @@ def _neox_block(r, cfg, i):
     }
 
 
+def _bert_block(r, cfg, i):
+    """HF BertLayer (reference container: containers/bert.py). Post-norm:
+    our block computes ln_1(x + attn(x)) / ln_2(x + mlp(x)) — exactly the HF
+    attention.output.LayerNorm / output.LayerNorm placement."""
+    p = f"bert.encoder.layer.{i}" \
+        if r.has(f"bert.encoder.layer.{i}.attention.self.query.weight") \
+        else f"encoder.layer.{i}"
+    return {
+        "ln_1": _ln(r, f"{p}.attention.output.LayerNorm"),
+        "attn": {
+            "q": _linear_t(r, f"{p}.attention.self.query"),
+            "k": _linear_t(r, f"{p}.attention.self.key"),
+            "v": _linear_t(r, f"{p}.attention.self.value"),
+            "o": _linear_t(r, f"{p}.attention.output.dense"),
+        },
+        "ln_2": _ln(r, f"{p}.output.LayerNorm"),
+        "mlp": {
+            "fc": _linear_t(r, f"{p}.intermediate.dense"),
+            "proj": _linear_t(r, f"{p}.output.dense"),
+        },
+    }
+
+
 _BLOCK_FNS = {"gpt2": _gpt2_block, "opt": _opt_block, "bloom": _bloom_block,
+              "bert": _bert_block,
               "llama": _llama_block, "gptj": _gptj_block,
               "gpt_neox": _neox_block}
 
@@ -418,6 +458,27 @@ def _top_level(r, cfg, fam):
         if not cfg.tie_embeddings:
             params["lm_head"] = {
                 "kernel": np.ascontiguousarray(r.get("embed_out.weight").T)}
+    elif fam == "bert":
+        pre = "bert." if r.has("bert.embeddings.word_embeddings.weight") else ""
+        emb = pre + "embeddings."
+        params["wte"] = {"weight": r.get(emb + "word_embeddings.weight")}
+        params["wpe"] = {"weight": r.get(emb + "position_embeddings.weight")}
+        params["wtt"] = {"weight": r.get(emb + "token_type_embeddings.weight")}
+        params["ln_emb"] = _ln(r, emb + "LayerNorm")
+        # MLM head (BertForMaskedLM cls.predictions); plain BertModel
+        # checkpoints lack it — zero-init the transform in that case
+        if r.has("cls.predictions.transform.dense.weight"):
+            params["mlm_transform"] = _linear_t(
+                r, "cls.predictions.transform.dense")
+            params["mlm_ln"] = _ln(r, "cls.predictions.transform.LayerNorm")
+            params["mlm_bias"] = {"bias": r.get("cls.predictions.bias")}
+        else:
+            d, v = cfg.d_model, cfg.vocab_size
+            params["mlm_transform"] = {"kernel": np.eye(d, dtype=np.float32),
+                                       "bias": np.zeros(d, np.float32)}
+            params["mlm_ln"] = {"scale": np.ones(d, np.float32),
+                                "bias": np.zeros(d, np.float32)}
+            params["mlm_bias"] = {"bias": np.zeros(v, np.float32)}
     else:  # llama
         params["wte"] = {"weight": r.get("model.embed_tokens.weight")}
         params["ln_f"] = _ln(r, "model.norm", rms=True)
@@ -462,8 +523,12 @@ def load_hf_checkpoint(path, config=None, dtype=np.float32, shardings=None):
 
 
 def hf_model_from_pretrained(path, dtype=np.float32, **config_overrides):
-    """Build ``(CausalLM, params)`` from an HF checkpoint directory."""
+    """Build ``(model, params)`` from an HF checkpoint directory — CausalLM
+    for decoder families, MaskedLM for bert."""
+    from ..models.transformer import MaskedLM
+
     hf_cfg = json.load(open(os.path.join(path, "config.json")))
     config = config_from_hf(hf_cfg, **config_overrides)
     config, params = load_hf_checkpoint(path, config=config, dtype=dtype)
-    return CausalLM(config), params
+    cls = MaskedLM if not config.causal else CausalLM
+    return cls(config), params

@@ -346,7 +346,10 @@ class DeepSpeedEngine:
         builder AND the fused-step gate — they must never disagree)."""
         use_1f1b = (self.pipe_stages > 1
                     and self._config.pipeline.schedule == "1f1b"
-                    and isinstance(self.params, dict) and "blocks" in self.params)
+                    and isinstance(self.params, dict) and "blocks" in self.params
+                    # the 1F1B head is autoregressive (label shift + ln_f);
+                    # encoder objectives take the GPipe schedule
+                    and getattr(self.module.config, "causal", True))
         if use_1f1b and self.seq_parallel_size > 1:
             if warn:
                 logger.warning(

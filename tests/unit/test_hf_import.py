@@ -157,3 +157,29 @@ def test_decode_path_matches_full_forward(tmp_path, family, devices8):
         nxt = np.asarray(jnp.argmax(logits[:, -1], axis=-1))[:, None]
         cur = jnp.concatenate([cur, jnp.asarray(nxt, jnp.int32)], axis=1)
     np.testing.assert_array_equal(out, np.asarray(cur))
+
+
+def test_bert_import_parity(tmp_path):
+    """Encoder path: BertForMaskedLM logits must match token for token
+    (validates the post-norm placement, segment embeddings, no-final-LN, and
+    the MLM transform head mapping)."""
+    cfg = transformers.BertConfig(
+        num_hidden_layers=2, num_attention_heads=2, hidden_size=32,
+        intermediate_size=64, vocab_size=96, max_position_embeddings=64,
+        type_vocab_size=2, hidden_act="gelu")
+    _seed()
+    hf = transformers.BertForMaskedLM(cfg).eval()
+    path = _save(tmp_path, hf)
+
+    from deepspeed_tpu.models import MaskedLM
+
+    model, params = hf_model_from_pretrained(path)
+    assert isinstance(model, MaskedLM)
+    model.config.compute_dtype = jnp.float32
+    ids = np.random.RandomState(2).randint(0, 96, (2, 12))
+    tt = np.zeros_like(ids)
+    ours = np.asarray(model.apply(params, jnp.asarray(ids)))
+    with torch.no_grad():
+        theirs = hf(torch.tensor(ids),
+                    token_type_ids=torch.tensor(tt)).logits.float().numpy()
+    np.testing.assert_allclose(ours, theirs, atol=2e-4, rtol=1e-3)
