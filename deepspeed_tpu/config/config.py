@@ -236,6 +236,19 @@ class GradientCompressionConfig(ConfigModel):
     bits: int = 8
 
 
+class CurriculumConfig(ConfigModel):
+    """Curriculum learning (reference legacy top-level ``curriculum_learning``
+    section, consumed by the engine at ``engine.py:1675`` for seqlen
+    scheduling). Scheduler keys pass through to ``CurriculumScheduler``."""
+
+    enabled: bool = False
+    curriculum_type: str = "seqlen"
+    min_difficulty: int = 8
+    max_difficulty: int = 1024
+    schedule_type: str = "fixed_linear"
+    schedule_config: dict = {}
+
+
 class DeepSpeedConfig(ConfigModel):
     """Top-level config (reference ``runtime/config.py:674``)."""
 
@@ -264,6 +277,7 @@ class DeepSpeedConfig(ConfigModel):
     comms_logger: CommsLoggerConfig = CommsLoggerConfig
     flops_profiler: FlopsProfilerConfig = FlopsProfilerConfig
     data_types: DataTypesConfig = DataTypesConfig
+    curriculum_learning: CurriculumConfig = CurriculumConfig
     gradient_compression: GradientCompressionConfig = GradientCompressionConfig
     communication_data_type: typing.Optional[str] = None
     wall_clock_breakdown: bool = False

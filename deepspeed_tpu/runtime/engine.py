@@ -182,6 +182,24 @@ class DeepSpeedEngine:
 
         self.monitor = MonitorMaster(self._config)
 
+        # -- curriculum learning (reference engine.py:1675 seqlen scheduling) --------
+        self._curriculum = None
+        cl = self._config.curriculum_learning
+        if cl.enabled:
+            from .data_pipeline import CurriculumScheduler
+
+            self._curriculum = CurriculumScheduler({
+                "curriculum_type": cl.curriculum_type,
+                "min_difficulty": cl.min_difficulty,
+                "max_difficulty": cl.max_difficulty,
+                "schedule_type": cl.schedule_type,
+                "schedule_config": dict(cl.schedule_config),
+            })
+            log_dist(
+                f"Curriculum learning: {cl.curriculum_type} "
+                f"{cl.min_difficulty}->{cl.max_difficulty} ({cl.schedule_type})",
+                ranks=[0])
+
         # -- dataloader --------------------------------------------------------------
         self.training_dataloader = None
         if training_data is not None:
@@ -577,6 +595,25 @@ class DeepSpeedEngine:
             self._report_progress()
         return mean_loss
 
+    def _apply_curriculum(self, batch):
+        """Truncate sequence-dim leaves to the scheduled difficulty (seqlen
+        curriculum, reference ``engine.py:1675``). Each distinct difficulty
+        value compiles once — schedules quantize via ``difficulty_step``."""
+        if self._curriculum is None:
+            return batch
+        diff = int(self._curriculum.update_difficulty(self.global_steps + 1))
+        out = {}
+        for k, v in batch.items():
+            a = np.asarray(v)
+            out[k] = a[:, :diff] if a.ndim >= 2 and a.shape[1] > diff else a
+        return out
+
+    @property
+    def curriculum_difficulty(self):
+        if self._curriculum is None:
+            return None
+        return self._curriculum.state["current_difficulty"]
+
     # ------------------------------------------------------------------------------
     # data placement
     # ------------------------------------------------------------------------------
@@ -626,7 +663,7 @@ class DeepSpeedEngine:
             self.timers(FORWARD_GLOBAL_TIMER).start()
         if self._fwd_bwd_fn is None:
             self._build_fwd_bwd()
-        batch = self._shard_batch(batch)
+        batch = self._shard_batch(self._apply_curriculum(batch))
         self._rng, step_rng = jax.random.split(self._rng)
         loss, grads = self._fwd_bwd_fn(self.params, batch, self._scale, step_rng)
         self._cached = (loss, grads)
@@ -751,7 +788,8 @@ class DeepSpeedEngine:
         self.tput_timer.start()
         micros = []
         for _ in range(self.gradient_accumulation_steps_):
-            micros.append(batch if batch is not None else next(data_iter))
+            micro = batch if batch is not None else next(data_iter)
+            micros.append(self._apply_curriculum(micro))
         if self._can_fuse_train_step():
             mean_loss = self._fused_train_batch(micros)
             self.tput_timer.stop(global_step=True)

@@ -134,3 +134,40 @@ def test_random_ltd_anneals_to_full_with_nonmultiple_seq():
                              step_size=16)
     assert sch.keep_at(10) == 100
     assert sch.keep_at(999) == 100
+
+
+def test_engine_curriculum_truncates_and_anneals(devices8):
+    """The config-driven curriculum hook (reference engine.py:1675): early
+    steps train on short sequences, difficulty anneals up the schedule, and
+    the loss stays finite across the shape changes."""
+    import deepspeed_tpu
+    from deepspeed_tpu.models import CausalLM, TransformerConfig
+    import jax.numpy as jnp
+
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=CausalLM(TransformerConfig(
+            vocab_size=64, max_seq_len=32, n_layers=2, n_heads=2, d_model=16,
+            d_ff=32, compute_dtype=jnp.float32)),
+        config={
+            "train_batch_size": 8,
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+            "zero_optimization": {"stage": 0},
+            "mesh": {"data": 8},
+            "steps_per_print": 10 ** 9,
+            "curriculum_learning": {
+                "enabled": True, "curriculum_type": "seqlen",
+                "min_difficulty": 8, "max_difficulty": 32,
+                "schedule_type": "fixed_linear",
+                "schedule_config": {"total_curriculum_step": 6,
+                                    "difficulty_step": 8},
+            },
+        })
+    rng = np.random.RandomState(0)
+    batch = {"input_ids": rng.randint(0, 64, (8, 32)).astype(np.int32)}
+    seen = []
+    for _ in range(8):
+        loss = engine.train_batch(batch=batch)
+        assert np.isfinite(float(loss))
+        seen.append(engine.curriculum_difficulty)
+    assert seen[0] < seen[-1]          # annealed up
+    assert seen[0] == 8 and seen[-1] == 32
