@@ -84,6 +84,10 @@ class ZeroConfig(ConfigModel):
     """
 
     stage: int = 0
+    # "compiler": trust XLA's SPMD scheduling of the stage-3 param gathers;
+    # "per_layer": force a gather per scanned block inside the layer loop
+    # (explicit schedule — the fetch-coordinator role, bounded live params)
+    zero3_gather_mode: str = "compiler"
     contiguous_gradients: bool = True
     reduce_scatter: bool = True
     reduce_bucket_size: int = 500_000_000

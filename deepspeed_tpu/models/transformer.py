@@ -77,6 +77,14 @@ class TransformerConfig:
     pipeline_stages: int = 1
     pipeline_microbatches: int = 1
     mesh: typing.Any = None  # jax.sharding.Mesh when pipeline_stages > 1
+    # Explicit ZeRO-3 gather schedule (set by the engine from
+    # zero_optimization.zero3_gather_mode="per_layer"): constrain each scanned
+    # block's params to their gathered (data-unsharded) layout INSIDE the layer
+    # loop, so the compiler must gather layer-by-layer — bounded live gathered
+    # params (the reference coordinator's max_live_parameters semantics,
+    # partitioned_param_coordinator.py:230) instead of trusting XLA's schedule.
+    zero3_per_layer_gather: bool = False
+    zero3_gather_specs: typing.Any = None  # per-block spec tree (no layers dim)
     # Sequence parallelism: shard the sequence dim over the ``seq`` mesh axis with
     # ring attention (set by the engine; see parallel/ring_attention.py)
     sequence_parallel: bool = False
@@ -398,6 +406,13 @@ def stack_apply(cfg, stacked_params, x, mask=None, rope=None, alibi=None,
     def scan_fn(carry, xs):
         h, i, aux = carry
         p = xs
+        if cfg.zero3_per_layer_gather and cfg.zero3_gather_specs is not None:
+            from jax.sharding import NamedSharding
+
+            p = jax.tree_util.tree_map(
+                lambda a, s: jax.lax.with_sharding_constraint(
+                    a, NamedSharding(cfg.mesh, s)),
+                p, cfg.zero3_gather_specs)
         rng_i = jax.random.fold_in(dropout_rng, i) if dropout_rng is not None else None
         h, aux_i = body(p, h, rng_i)
         return (h, i + 1, aux + aux_i), None

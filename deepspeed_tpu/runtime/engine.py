@@ -182,6 +182,23 @@ class DeepSpeedEngine:
 
         self.monitor = MonitorMaster(self._config)
 
+        # -- explicit ZeRO-3 gather schedule (per-layer constraint in the scan) ------
+        if (self.zero_stage >= 3
+                and self._config.zero_optimization.zero3_gather_mode == "per_layer"
+                and hasattr(self.module, "config")
+                and hasattr(self.module.config, "zero3_per_layer_gather")
+                and isinstance(self.param_specs, dict)
+                and "blocks" in self.param_specs):
+            gather_specs = jax.tree_util.tree_map(
+                lambda s: P(*(None if a == DATA_AXIS else a
+                              for a in tuple(s)[1:])),
+                self.param_specs["blocks"],
+                is_leaf=lambda x: isinstance(x, P))
+            self.module.config.zero3_per_layer_gather = True
+            self.module.config.zero3_gather_specs = gather_specs
+            log_dist("ZeRO-3 gather mode: per_layer (explicit schedule)",
+                     ranks=[0])
+
         # -- curriculum learning (reference engine.py:1675 seqlen scheduling) --------
         self._curriculum = None
         cl = self._config.curriculum_learning
