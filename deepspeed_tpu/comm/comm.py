@@ -281,10 +281,15 @@ def assert_same_across_ranks(values, name="value"):
     from jax.experimental import multihost_utils
 
     leaves = jax.tree_util.tree_leaves(values)
-    fp = np.zeros(2, np.float64)
+    fp = np.zeros(3, np.float64)
     for i, leaf in enumerate(leaves):
-        a = np.asarray(leaf, np.float64)
-        fp[0] += float(a.sum()) * (i + 1)
+        a = np.asarray(leaf, np.float64).ravel()
+        nan = ~np.isfinite(a)
+        fp[2] += float(nan.sum()) * (i + 1)  # NaN/inf count, not value (NaN != NaN)
+        a = np.where(nan, 0.0, a)
+        # position-weighted: permutations/transposes of the same values must
+        # NOT collide (a plain sum is permutation-invariant)
+        fp[0] += float((a * (np.arange(a.size) + 1.0)).sum()) * (i + 1)
         fp[1] += float(a.size) * (i + 1) + len(leaves)
     all_fp = multihost_utils.process_allgather(fp)
     mine = all_fp[jax.process_index()]

@@ -91,7 +91,7 @@ def bert_config(size="base", **overrides):
     }
     base = dict(
         vocab_size=30528,  # wordpiece 30522 padded to a multiple of 64
-        max_seq_len=512, activation="gelu", norm="layernorm",
+        max_seq_len=512, activation="gelu_exact", norm="layernorm",
         position_embedding="learned", tie_embeddings=True, use_bias=True,
         prenorm=False, causal=False, embed_layernorm=True, type_vocab_size=2,
         final_layernorm=False,  # post-norm blocks end with LN; BERT has no ln_f

@@ -394,10 +394,21 @@ def stack_apply(cfg, stacked_params, x, mask=None, rope=None, alibi=None,
     if cfg.remat:
         body = jax.checkpoint(body, policy=_remat_policy(cfg), static_argnums=())
 
+    def gather_constraint(p):
+        if cfg.zero3_per_layer_gather and cfg.zero3_gather_specs is not None:
+            from jax.sharding import NamedSharding
+
+            return jax.tree_util.tree_map(
+                lambda a, s: jax.lax.with_sharding_constraint(
+                    a, NamedSharding(cfg.mesh, s)),
+                p, cfg.zero3_gather_specs)
+        return p
+
     aux = jnp.zeros((), jnp.float32)
     if not cfg.scan_layers:
         for i in range(cfg.n_layers):
-            p_i = jax.tree_util.tree_map(lambda a: a[i], stacked_params)
+            p_i = gather_constraint(
+                jax.tree_util.tree_map(lambda a: a[i], stacked_params))
             rng_i = jax.random.fold_in(dropout_rng, i) if dropout_rng is not None else None
             x, aux_i = body(p_i, x, rng_i)
             aux = aux + aux_i
@@ -405,14 +416,7 @@ def stack_apply(cfg, stacked_params, x, mask=None, rope=None, alibi=None,
 
     def scan_fn(carry, xs):
         h, i, aux = carry
-        p = xs
-        if cfg.zero3_per_layer_gather and cfg.zero3_gather_specs is not None:
-            from jax.sharding import NamedSharding
-
-            p = jax.tree_util.tree_map(
-                lambda a, s: jax.lax.with_sharding_constraint(
-                    a, NamedSharding(cfg.mesh, s)),
-                p, cfg.zero3_gather_specs)
+        p = gather_constraint(xs)
         rng_i = jax.random.fold_in(dropout_rng, i) if dropout_rng is not None else None
         h, aux_i = body(p, h, rng_i)
         return (h, i + 1, aux + aux_i), None

@@ -73,6 +73,23 @@ def build_1f1b_train_step(model, mesh, n_microbatches, blocks_param_specs=None):
     # specs a TP-sized mesh keeps the block weights model-replicated (valid,
     # just unsharded — direct/test callers)
     tp_manual = TP > 1 and blocks_param_specs is not None
+    if tp_manual:
+        # Every matmul kernel must actually shard over 'model': a replicated
+        # kernel (e.g. a TP-indivisible dim fell back in logical_to_physical)
+        # would compute the FULL output per rank and the row-parallel psum
+        # would then multiply it by TP — silent corruption. All-or-nothing.
+        kernel_specs = [
+            s for path, s in jax.tree_util.tree_flatten_with_path(
+                blocks_param_specs, is_leaf=lambda x: isinstance(x, P))[0]
+            if any(getattr(k, "key", None) == "kernel" for k in path)
+        ]
+        if not kernel_specs or not all("model" in tuple(s) for s in kernel_specs):
+            from ..utils.logging import logger
+
+            logger.warning(
+                "1F1B x TP: not every block kernel shards over 'model' "
+                "(indivisible dims?); keeping weights model-replicated")
+            tp_manual = False
 
     from ..models import layers as Lyr
     from ..models.transformer import block_apply, _norm_apply, _remat_policy

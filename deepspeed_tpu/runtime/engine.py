@@ -383,8 +383,9 @@ class DeepSpeedEngine:
                     and self._config.pipeline.schedule == "1f1b"
                     and isinstance(self.params, dict) and "blocks" in self.params
                     # the 1F1B head is autoregressive (label shift + ln_f);
-                    # encoder objectives take the GPipe schedule
-                    and getattr(self.module.config, "causal", True))
+                    # encoder objectives and no-final-norm models take GPipe
+                    and getattr(self.module.config, "causal", True)
+                    and getattr(self.module.config, "final_layernorm", True))
         if use_1f1b and self.seq_parallel_size > 1:
             if warn:
                 logger.warning(
