@@ -119,13 +119,14 @@ class _Reader:
 # ---------------------------------------------------------------------------
 def detect_family(hf_config):
     mt = hf_config.get("model_type", "")
-    if mt in ("gpt2", "opt", "bloom", "llama", "gptj", "gpt_neox", "bert"):
+    if mt in ("gpt2", "opt", "bloom", "llama", "gptj", "gpt_neox", "bert",
+              "distilbert"):
         return mt
     if mt == "mistral":
         return "llama"
     raise ValueError(f"Unsupported HF model_type '{mt}' "
                      "(supported: gpt2, opt, bloom, llama, mistral, gptj, "
-                     "gpt_neox, bert)")
+                     "gpt_neox, bert, distilbert)")
 
 
 def config_from_hf(hf_config, **overrides):
@@ -214,6 +215,20 @@ def config_from_hf(hf_config, **overrides):
             embed_layernorm=True, final_layernorm=False,
             type_vocab_size=g("type_vocab_size", 2),
             layernorm_eps=g("layer_norm_eps", 1e-12),
+        )
+    elif fam == "distilbert":
+        # BERT minus token types, minus pooler, gelu, 1e-12 LN eps
+        # (reference container: containers/distil_bert.py)
+        kw = dict(
+            vocab_size=g("vocab_size"),
+            max_seq_len=g("max_position_embeddings", 512),
+            n_layers=g("n_layers"), n_heads=g("n_heads"), d_model=g("dim"),
+            d_ff=g("hidden_dim"),
+            activation={"gelu": "gelu_exact", "relu": "relu"}[g("activation", "gelu")],
+            norm="layernorm", position_embedding="learned",
+            tie_embeddings=True, use_bias=True, prenorm=False, causal=False,
+            embed_layernorm=True, final_layernorm=False, type_vocab_size=0,
+            layernorm_eps=1e-12,
         )
     else:  # llama / mistral
         kw = dict(
@@ -413,8 +428,30 @@ def _bert_block(r, cfg, i):
     }
 
 
+def _distilbert_block(r, cfg, i):
+    """HF TransformerBlock (distilbert.transformer.layer.N): post-norm like
+    BERT with sa_layer_norm / output_layer_norm placement."""
+    p = f"distilbert.transformer.layer.{i}" \
+        if r.has(f"distilbert.transformer.layer.{i}.attention.q_lin.weight") \
+        else f"transformer.layer.{i}"
+    return {
+        "ln_1": _ln(r, f"{p}.sa_layer_norm"),
+        "attn": {
+            "q": _linear_t(r, f"{p}.attention.q_lin"),
+            "k": _linear_t(r, f"{p}.attention.k_lin"),
+            "v": _linear_t(r, f"{p}.attention.v_lin"),
+            "o": _linear_t(r, f"{p}.attention.out_lin"),
+        },
+        "ln_2": _ln(r, f"{p}.output_layer_norm"),
+        "mlp": {
+            "fc": _linear_t(r, f"{p}.ffn.lin1"),
+            "proj": _linear_t(r, f"{p}.ffn.lin2"),
+        },
+    }
+
+
 _BLOCK_FNS = {"gpt2": _gpt2_block, "opt": _opt_block, "bloom": _bloom_block,
-              "bert": _bert_block,
+              "bert": _bert_block, "distilbert": _distilbert_block,
               "llama": _llama_block, "gptj": _gptj_block,
               "gpt_neox": _neox_block}
 
@@ -472,6 +509,26 @@ def _top_level(r, cfg, fam):
                 r, "cls.predictions.transform.dense")
             params["mlm_ln"] = _ln(r, "cls.predictions.transform.LayerNorm")
             params["mlm_bias"] = {"bias": r.get("cls.predictions.bias")}
+        else:
+            d, v = cfg.d_model, cfg.vocab_size
+            params["mlm_transform"] = {"kernel": np.eye(d, dtype=np.float32),
+                                       "bias": np.zeros(d, np.float32)}
+            params["mlm_ln"] = {"scale": np.ones(d, np.float32),
+                                "bias": np.zeros(d, np.float32)}
+            params["mlm_bias"] = {"bias": np.zeros(v, np.float32)}
+    elif fam == "distilbert":
+        pre = "distilbert." if r.has("distilbert.embeddings.word_embeddings.weight") \
+            else ""
+        emb = pre + "embeddings."
+        params["wte"] = {"weight": r.get(emb + "word_embeddings.weight")}
+        params["wpe"] = {"weight": r.get(emb + "position_embeddings.weight")}
+        params["ln_emb"] = _ln(r, emb + "LayerNorm")
+        # DistilBertForMaskedLM head: vocab_transform -> gelu -> vocab_layer_norm
+        # -> vocab_projector (tied weight, own bias)
+        if r.has("vocab_transform.weight"):
+            params["mlm_transform"] = _linear_t(r, "vocab_transform")
+            params["mlm_ln"] = _ln(r, "vocab_layer_norm")
+            params["mlm_bias"] = {"bias": r.get("vocab_projector.bias")}
         else:
             d, v = cfg.d_model, cfg.vocab_size
             params["mlm_transform"] = {"kernel": np.eye(d, dtype=np.float32),

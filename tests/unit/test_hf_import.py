@@ -183,3 +183,20 @@ def test_bert_import_parity(tmp_path):
         theirs = hf(torch.tensor(ids),
                     token_type_ids=torch.tensor(tt)).logits.float().numpy()
     np.testing.assert_allclose(ours, theirs, atol=2e-4, rtol=1e-3)
+
+
+def test_distilbert_import_parity(tmp_path):
+    cfg = transformers.DistilBertConfig(
+        n_layers=2, n_heads=2, dim=32, hidden_dim=64, vocab_size=96,
+        max_position_embeddings=64, activation="gelu")
+    _seed()
+    hf = transformers.DistilBertForMaskedLM(cfg).eval()
+    path = _save(tmp_path, hf)
+
+    model, params = hf_model_from_pretrained(path)
+    model.config.compute_dtype = jnp.float32
+    ids = np.random.RandomState(3).randint(0, 96, (2, 12))
+    ours = np.asarray(model.apply(params, jnp.asarray(ids)))
+    with torch.no_grad():
+        theirs = hf(torch.tensor(ids)).logits.float().numpy()
+    np.testing.assert_allclose(ours, theirs, atol=2e-4, rtol=1e-3)
