@@ -33,7 +33,8 @@ def init_cache(cfg, batch_size, max_len, dtype=None):
     }
 
 
-def _attn_with_cache(cfg, p_attn, h, k_cache, v_cache, pos, kv_len, rope=None):
+def _attn_with_cache(cfg, p_attn, h, k_cache, v_cache, pos, kv_len, rope=None,
+                     is_local=None):
     """Attention for q block [b, q, d] against cache[:, :kv_len] after writing the
     new k/v at ``pos``. Returns (out [b, q, d], new k_cache, new v_cache).
 
@@ -62,13 +63,19 @@ def _attn_with_cache(cfg, p_attn, h, k_cache, v_cache, pos, kv_len, rope=None):
     # causal vs the cache: query i (global pos+i) sees cache slots <= pos+i
     kv_idx = jnp.arange(kv_len)[None, :]
     q_idx = pos + jnp.arange(q_len)[:, None]
-    mask = (kv_idx <= q_idx)[None, None, :, :]  # [1, 1, q, kv]
+    allowed = kv_idx <= q_idx
+    if cfg.local_attention_window > 0 and is_local is not None:
+        # banded local layers (GPT-Neo): is_local is a traced per-layer bool
+        band = q_idx - kv_idx < cfg.local_attention_window
+        allowed = allowed & (band | jnp.logical_not(is_local))
+    mask = allowed[None, None, :, :]  # [1, 1, q, kv]
 
     alibi = None
     if cfg.position_embedding == "alibi":
         alibi = _alibi_slice(cfg, q_len, kv_len, pos)
 
-    out = L.dot_product_attention(q, k_full, v_full, mask=mask, alibi_bias=alibi)
+    out = L.dot_product_attention(q, k_full, v_full, mask=mask,
+                                  scale=cfg.attn_scale, alibi_bias=alibi)
     out = L.linear_apply(p_attn["o"], out.reshape(b, q_len, d))
     return out, k_cache, v_cache
 
@@ -97,7 +104,8 @@ def _mlp(cfg, p, h):
     return L.linear_apply(mp["proj"], act(L.linear_apply(mp["fc"], h)))
 
 
-def _block_cached(cfg, p, x, k_cache, v_cache, pos, kv_len, rope=None):
+def _block_cached(cfg, p, x, k_cache, v_cache, pos, kv_len, rope=None,
+                  is_local=None):
     """One block with cache. x: [b, q, d] compute dtype."""
     cast = lambda a: a.astype(cfg.compute_dtype) \
         if jnp.issubdtype(a.dtype, jnp.floating) else a
@@ -110,7 +118,7 @@ def _block_cached(cfg, p, x, k_cache, v_cache, pos, kv_len, rope=None):
 
     def attn(h):
         return _attn_with_cache(cfg, p_cast["attn"], h, k_cache, v_cache, pos,
-                                kv_len, rope=rope)
+                                kv_len, rope=rope, is_local=is_local)
 
     if cfg.parallel_attn_mlp:
         h = _norm_apply(cfg, p_cast["ln_1"], x)
@@ -149,15 +157,31 @@ def forward_with_cache(model, params, input_ids, cache, pos, kv_len):
         rope = L.rotary_embedding(positions, cfg.rotary_dim or cfg.head_dim,
                                   cfg.rope_base)
 
-    def scan_fn(carry, layer):
-        h = carry
-        p_i, kc, vc = layer
-        h, kc, vc = _block_cached(cfg, p_i, h, kc, vc, pos, kv_len, rope=rope)
-        return h, (kc, vc)
+    if cfg.local_attention_window > 0:
+        pat = cfg.attention_layers or ("global", "local")
+        is_local_arr = jnp.asarray(
+            [pat[i % len(pat)] == "local" for i in range(cfg.n_layers)])
 
-    h, (k_new, v_new) = jax.lax.scan(
-        scan_fn, x, (params["blocks"], cache["k"], cache["v"])
-    )
+        def scan_fn(carry, layer):
+            h = carry
+            p_i, kc, vc, loc = layer
+            h, kc, vc = _block_cached(cfg, p_i, h, kc, vc, pos, kv_len,
+                                      rope=rope, is_local=loc)
+            return h, (kc, vc)
+
+        h, (k_new, v_new) = jax.lax.scan(
+            scan_fn, x, (params["blocks"], cache["k"], cache["v"], is_local_arr)
+        )
+    else:
+        def scan_fn(carry, layer):
+            h = carry
+            p_i, kc, vc = layer
+            h, kc, vc = _block_cached(cfg, p_i, h, kc, vc, pos, kv_len, rope=rope)
+            return h, (kc, vc)
+
+        h, (k_new, v_new) = jax.lax.scan(
+            scan_fn, x, (params["blocks"], cache["k"], cache["v"])
+        )
     h = _norm_apply(cfg, params["ln_f"], h)
     if cfg.tie_embeddings:
         logits = L.embedding_attend(params["wte"], h)

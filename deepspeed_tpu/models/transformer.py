@@ -54,6 +54,13 @@ class TransformerConfig:
     type_vocab_size: int = 0
     # post-norm encoders (BERT) end each block with LN and have no final norm
     final_layernorm: bool = True
+    # GPT-Neo-style banded local attention: window size (0 = off) and the
+    # per-layer pattern ("global"/"local" strings, cycled over the layers —
+    # HF GPTNeoConfig.attention_types expanded)
+    local_attention_window: int = 0
+    attention_layers: tuple = ()
+    # attention logit scale; None = 1/sqrt(head_dim). GPT-Neo uses 1.0
+    attn_scale: typing.Optional[float] = None
     use_bias: bool = True
     prenorm: bool = True
     parallel_attn_mlp: bool = False
@@ -265,10 +272,11 @@ def block_apply(cfg, p, x, mask=None, rope=None, alibi=None, deterministic=True,
             if seq_manual:
                 # already inside the pipeline's manual region over {pipe, seq}
                 out = ring_attention_manual(q, k, v, kv_mask=kv_mask,
-                                            causal=cfg.causal)
+                                            causal=cfg.causal,
+                                            scale=cfg.attn_scale)
             else:
                 out = ring_attention(q, k, v, cfg.mesh, kv_mask=kv_mask,
-                                     causal=cfg.causal)
+                                     causal=cfg.causal, scale=cfg.attn_scale)
             out = checkpoint_name(out, "attn_out")
             return o_proj(out)
         # flash path: plain causal attention, no padding mask / alibi / dropout
@@ -279,7 +287,8 @@ def block_apply(cfg, p, x, mask=None, rope=None, alibi=None, deterministic=True,
         if flash_ok:
             from ..ops.flash_attention import flash_attention
 
-            out = flash_attention(q, k, v, causal=cfg.causal)
+            out = flash_attention(q, k, v, causal=cfg.causal,
+                                  scale=cfg.attn_scale)
         else:
             dense_mask = mask if mask is not None else (
                 L.causal_mask(s, s) if cfg.causal else None)
@@ -287,7 +296,8 @@ def block_apply(cfg, p, x, mask=None, rope=None, alibi=None, deterministic=True,
             if not deterministic and dropout_rng is not None and cfg.attn_dropout > 0:
                 drop_rng = jax.random.fold_in(dropout_rng, 1)
             out = L.dot_product_attention(
-                q, k, v, mask=dense_mask, dropout_rate=0.0 if deterministic else cfg.attn_dropout,
+                q, k, v, mask=dense_mask, scale=cfg.attn_scale,
+                dropout_rate=0.0 if deterministic else cfg.attn_dropout,
                 dropout_rng=drop_rng, alibi_bias=alibi,
             )
         out = checkpoint_name(out, "attn_out")
@@ -384,13 +394,36 @@ def stack_apply(cfg, stacked_params, x, mask=None, rope=None, alibi=None,
         if cfg.attn_dropout > 0 and not deterministic:
             raise NotImplementedError("attention dropout not supported with ring attention")
     if cfg.pipeline_stages > 1:
+        if cfg.local_attention_window > 0:
+            raise NotImplementedError(
+                "local_attention_window not supported with pipeline parallelism")
         return _pipeline_stack(cfg, stacked_params, x, mask, rope, alibi,
                                deterministic, dropout_rng)
 
-    body = lambda p, h, rng: block_apply(
-        cfg, p, h, mask=mask, rope=rope, alibi=alibi,
-        deterministic=deterministic, dropout_rng=rng, kv_mask=kv_mask,
-    )
+    # GPT-Neo-style banded local attention: per-layer global/local masks
+    # (HF GPTNeoConfig.attention_types; reference container containers/gptneo.py)
+    local_pattern = None
+    local_mask = None
+    if cfg.local_attention_window > 0:
+        if cfg.sequence_parallel or not cfg.causal:
+            raise NotImplementedError(
+                "local_attention_window requires a causal, non-SP model")
+        s = x.shape[1]
+        qi = jnp.arange(s)[:, None]
+        ki = jnp.arange(s)[None, :]
+        band = (qi >= ki) & (qi - ki < cfg.local_attention_window)
+        gmask = mask if mask is not None else L.causal_mask(s, s)
+        local_mask = gmask & band
+        pat = cfg.attention_layers or ("global", "local")
+        local_pattern = [pat[i % len(pat)] == "local"
+                         for i in range(cfg.n_layers)]
+
+    def body(p, h, rng, m):
+        return block_apply(
+            cfg, p, h, mask=m, rope=rope, alibi=alibi,
+            deterministic=deterministic, dropout_rng=rng, kv_mask=kv_mask,
+        )
+
     if cfg.remat:
         body = jax.checkpoint(body, policy=_remat_policy(cfg), static_argnums=())
 
@@ -405,12 +438,16 @@ def stack_apply(cfg, stacked_params, x, mask=None, rope=None, alibi=None,
         return p
 
     aux = jnp.zeros((), jnp.float32)
-    if not cfg.scan_layers:
+    if not cfg.scan_layers or local_pattern is not None:
+        # unrolled: per-layer mask selection stays a python choice (global
+        # layers keep mask=None -> flash-eligible)
         for i in range(cfg.n_layers):
             p_i = gather_constraint(
                 jax.tree_util.tree_map(lambda a: a[i], stacked_params))
             rng_i = jax.random.fold_in(dropout_rng, i) if dropout_rng is not None else None
-            x, aux_i = body(p_i, x, rng_i)
+            m_i = local_mask if (local_pattern is not None and local_pattern[i]) \
+                else mask
+            x, aux_i = body(p_i, x, rng_i, m_i)
             aux = aux + aux_i
         return x, aux
 
@@ -418,7 +455,7 @@ def stack_apply(cfg, stacked_params, x, mask=None, rope=None, alibi=None,
         h, i, aux = carry
         p = gather_constraint(xs)
         rng_i = jax.random.fold_in(dropout_rng, i) if dropout_rng is not None else None
-        h, aux_i = body(p, h, rng_i)
+        h, aux_i = body(p, h, rng_i, mask)
         return (h, i + 1, aux + aux_i), None
 
     (x, _, aux), _ = jax.lax.scan(

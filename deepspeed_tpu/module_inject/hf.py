@@ -120,13 +120,13 @@ class _Reader:
 def detect_family(hf_config):
     mt = hf_config.get("model_type", "")
     if mt in ("gpt2", "opt", "bloom", "llama", "gptj", "gpt_neox", "bert",
-              "distilbert"):
+              "distilbert", "gpt_neo"):
         return mt
     if mt == "mistral":
         return "llama"
     raise ValueError(f"Unsupported HF model_type '{mt}' "
                      "(supported: gpt2, opt, bloom, llama, mistral, gptj, "
-                     "gpt_neox, bert, distilbert)")
+                     "gpt_neox, bert, distilbert, gpt_neo)")
 
 
 def config_from_hf(hf_config, **overrides):
@@ -215,6 +215,21 @@ def config_from_hf(hf_config, **overrides):
             embed_layernorm=True, final_layernorm=False,
             type_vocab_size=g("type_vocab_size", 2),
             layernorm_eps=g("layer_norm_eps", 1e-12),
+        )
+    elif fam == "gpt_neo":
+        # GPT-2-shaped but nn.Linear weights, no qkv bias, and alternating
+        # global/banded-local attention (reference container: containers/gptneo.py)
+        d = g("hidden_size")
+        att = g("attention_layers") or []
+        kw = dict(
+            vocab_size=g("vocab_size"), max_seq_len=g("max_position_embeddings", 2048),
+            n_layers=g("num_layers"), n_heads=g("num_heads"), d_model=d,
+            d_ff=g("intermediate_size") or 4 * d,
+            activation="gelu_new", norm="layernorm", position_embedding="learned",
+            tie_embeddings=True, use_bias=True, mlp_bias=True, prenorm=True,
+            local_attention_window=g("window_size", 256) if "local" in att else 0,
+            attention_layers=tuple(att), attn_scale=1.0,  # Neo: UNSCALED logits
+            layernorm_eps=g("layer_norm_epsilon", 1e-5),
         )
     elif fam == "distilbert":
         # BERT minus token types, minus pooler, gelu, 1e-12 LN eps
@@ -428,6 +443,34 @@ def _bert_block(r, cfg, i):
     }
 
 
+def _neo_block(r, cfg, i):
+    """HF GPTNeoBlock: nn.Linear weights (transpose), q/k/v have NO bias but
+    out_proj does — zero-filled qkv biases keep the block uniform."""
+    p = f"transformer.h.{i}" if r.has(f"transformer.h.{i}.ln_1.weight") \
+        else f"h.{i}"
+    z = np.zeros((cfg.d_model,), np.float32)
+
+    def qkv(name):
+        w = _linear_t(r, f"{p}.attn.attention.{name}", bias=False)
+        w["bias"] = z
+        return w
+
+    return {
+        "ln_1": _ln(r, f"{p}.ln_1"),
+        "attn": {
+            "q": qkv("q_proj"),
+            "k": qkv("k_proj"),
+            "v": qkv("v_proj"),
+            "o": _linear_t(r, f"{p}.attn.attention.out_proj"),
+        },
+        "ln_2": _ln(r, f"{p}.ln_2"),
+        "mlp": {
+            "fc": _linear_t(r, f"{p}.mlp.c_fc"),
+            "proj": _linear_t(r, f"{p}.mlp.c_proj"),
+        },
+    }
+
+
 def _distilbert_block(r, cfg, i):
     """HF TransformerBlock (distilbert.transformer.layer.N): post-norm like
     BERT with sa_layer_norm / output_layer_norm placement."""
@@ -452,6 +495,7 @@ def _distilbert_block(r, cfg, i):
 
 _BLOCK_FNS = {"gpt2": _gpt2_block, "opt": _opt_block, "bloom": _bloom_block,
               "bert": _bert_block, "distilbert": _distilbert_block,
+              "gpt_neo": _neo_block,
               "llama": _llama_block, "gptj": _gptj_block,
               "gpt_neox": _neox_block}
 
@@ -465,7 +509,7 @@ def _first(r, *names):
 
 def _top_level(r, cfg, fam):
     params = {}
-    if fam == "gpt2":
+    if fam in ("gpt2", "gpt_neo"):
         params["wte"] = {"weight": _first(r, "transformer.wte.weight", "wte.weight")}
         params["wpe"] = {"weight": _first(r, "transformer.wpe.weight", "wpe.weight")}
         lnf = "transformer.ln_f" if r.has("transformer.ln_f.weight") else "ln_f"

@@ -200,3 +200,24 @@ def test_distilbert_import_parity(tmp_path):
     with torch.no_grad():
         theirs = hf(torch.tensor(ids)).logits.float().numpy()
     np.testing.assert_allclose(ours, theirs, atol=2e-4, rtol=1e-3)
+
+
+def test_gpt_neo_import_parity(tmp_path):
+    """Alternating global/local (banded) attention: parity at a sequence
+    LONGER than the window so the band actually bites."""
+    cfg = transformers.GPTNeoConfig(
+        num_layers=2, num_heads=2, hidden_size=32, vocab_size=96,
+        max_position_embeddings=64, window_size=4,
+        attention_types=[[["global", "local"], 1]])
+    _seed()
+    hf = transformers.GPTNeoForCausalLM(cfg).eval()
+    path = _save(tmp_path, hf)
+
+    model, params = hf_model_from_pretrained(path)
+    assert model.config.local_attention_window == 4
+    model.config.compute_dtype = jnp.float32
+    ids = np.random.RandomState(4).randint(0, 96, (2, 16))  # 16 > window 4
+    ours = np.asarray(model.apply(params, jnp.asarray(ids)))
+    with torch.no_grad():
+        theirs = hf(torch.tensor(ids)).logits.float().numpy()
+    np.testing.assert_allclose(ours, theirs, atol=2e-4, rtol=1e-3)
