@@ -134,3 +134,38 @@ def test_engine_roundtrip_across_mesh_change(tmp_path, devices8):
     l1 = float(e1.eval_batch(batch))
     l2 = float(e2.eval_batch(batch))
     assert abs(l1 - l2) < 1e-4, (l1, l2)
+
+
+def test_engine_roundtrip_across_pipe_resize(tmp_path, devices8):
+    """3D reshape: save on pipe=2 x dp=4 (layer stack sharded over pipe),
+    load on dp=8 — and back. The reference's reshape_3d_utils territory."""
+    rngnp = np.random.RandomState(1)
+    batch = {"input_ids": rngnp.randint(0, 1024, (8, 32)).astype(np.int32)}
+
+    def mk(meshcfg, gas):
+        model = get_model("llama", "tiny", compute_dtype=jnp.float32)
+        eng, _, _, _ = deepspeed_tpu.initialize(model=model, config={
+            "train_batch_size": 8,
+            "train_micro_batch_size_per_gpu": 1 if gas > 1 else None,
+            "gradient_accumulation_steps": gas,
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+            "zero_optimization": {"stage": 1}, "mesh": meshcfg,
+            "steps_per_print": 10 ** 9})
+        return eng
+
+    e1 = mk({"data": 4, "pipe": 2}, 2)
+    e1.train_batch(batch=batch)
+    e1.save_checkpoint(str(tmp_path), tag="p")
+
+    e2 = mk({"data": 8}, 1)
+    e2.load_checkpoint(str(tmp_path), tag="p")
+    l1 = float(e1.eval_batch(batch))
+    l2 = float(e2.eval_batch(batch))
+    assert abs(l1 - l2) < 1e-4, (l1, l2)
+
+    # and back onto a pipe mesh
+    e2.save_checkpoint(str(tmp_path), tag="q")
+    e3 = mk({"data": 4, "pipe": 2}, 2)
+    e3.load_checkpoint(str(tmp_path), tag="q")
+    l3 = float(e3.eval_batch(batch))
+    assert abs(l2 - l3) < 1e-4, (l2, l3)

@@ -1,0 +1,99 @@
+"""One-process perf sweep for the headline bench shape (GPT-2 350M, seq 1024).
+
+Runs every configuration variant in a SINGLE process (one tunnel claim, one
+jax runtime) and prints a table — use this to pick bench.py defaults:
+
+    python tools/sweep_bench.py
+    BENCH_SWEEP="batch,attn" python tools/sweep_bench.py   # subset
+"""
+
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def measure(engine, batch, steps=8):
+    import jax
+
+    engine.train_batch(batch=batch)  # compile + warm
+    engine.train_batch(batch=batch)
+    leaf = jax.tree_util.tree_leaves(engine.params)[0]
+    np.asarray(jax.device_get(leaf.ravel()[0]))
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        engine.train_batch(batch=batch)
+    leaf = jax.tree_util.tree_leaves(engine.params)[0]
+    np.asarray(jax.device_get(leaf.ravel()[0]))
+    dt = (time.perf_counter() - t0) / steps
+    return batch["input_ids"].size / dt  # tokens/s
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    import deepspeed_tpu
+    from deepspeed_tpu.models import CausalLM, TransformerConfig
+
+    n_params = 354.9e6
+    peak = 197e12  # v5e bf16
+
+    base_model = dict(
+        vocab_size=50304, max_seq_len=1024, n_layers=24, n_heads=16,
+        d_model=1024, d_ff=4096, compute_dtype=jnp.bfloat16,
+        remat=True, remat_policy="minimal", scan_layers=True, fused_ce=True,
+        attention_impl="xla")
+    base_cfg = {
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-4, "weight_decay": 0.01}},
+        "bf16": {"enabled": True},
+        "zero_optimization": {"stage": 0},
+        "gradient_clipping": 1.0,
+        "steps_per_print": 10 ** 9,
+    }
+
+    variants = [
+        # (name, model overrides, batch size)
+        ("base-b12", {}, 12),
+        ("b16", {}, 16),
+        ("b8", {}, 8),
+        ("flash-b12", {"attention_impl": "flash"}, 12),
+        ("noscan-b12", {"scan_layers": False}, 12),
+        ("densece-b12", {"fused_ce": False}, 12),
+        ("remat-dots-b12", {"remat_policy": "dots_with_no_batch_dims"}, 12),
+        ("noclip-b12", {}, 12),  # gradient_clipping removed below
+        ("flash-b16", {"attention_impl": "flash"}, 16),
+    ]
+    sel = os.environ.get("BENCH_SWEEP")
+    if sel:
+        keys = sel.split(",")
+        variants = [v for v in variants if any(k in v[0] for k in keys)]
+
+    rng = np.random.RandomState(0)
+    print(f"{'variant':<16} {'tok/s':>10} {'MFU':>7}")
+    best = (None, 0.0)
+    for name, m_over, b in variants:
+        try:
+            cfg = dict(base_cfg, train_batch_size=b)
+            if name.startswith("noclip"):
+                cfg["gradient_clipping"] = 0.0
+            model = CausalLM(TransformerConfig(**{**base_model, **m_over}))
+            engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=cfg)
+            batch = {"input_ids": rng.randint(
+                0, 50304, (b, 1024)).astype(np.int32)}
+            tps = measure(engine, batch)
+            mfu = tps * 6 * n_params / peak
+            print(f"{name:<16} {tps:>10.0f} {mfu:>7.4f}", flush=True)
+            if tps > best[1]:
+                best = (name, tps)
+            del engine
+        except Exception as e:
+            print(f"{name:<16} FAILED: {type(e).__name__}: {str(e)[:80]}",
+                  flush=True)
+    print(f"\nbest: {best[0]} at {best[1]:.0f} tok/s")
+
+
+if __name__ == "__main__":
+    main()
