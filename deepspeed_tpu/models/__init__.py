@@ -1,5 +1,6 @@
 from .layers import Param, split_params_axes
-from .transformer import CausalLM, MaskedLM, TransformerConfig, cross_entropy_loss
+from .transformer import (CausalLM, MaskedLM, TextEncoder,
+                          TransformerConfig, cross_entropy_loss)
 from .registry import (get_model, MODEL_CONFIGS, gpt2_config, opt_config,
                        bloom_config, llama_config, bert_config)
 from .simple import SimpleModel, random_batch
@@ -8,6 +9,7 @@ from .spatial import (DSUNet, DSVAE, SpatialConfig, SpatialUNet,
 
 __all__ = [
     "MaskedLM",
+    "TextEncoder",
     "bert_config",
     "DSUNet",
     "DSVAE",

@@ -742,6 +742,27 @@ class MaskedLM(CausalLM):
         return self.head_ce(params, x, batch["labels"]) + aux
 
 
+class TextEncoder(CausalLM):
+    """Headless conditioning encoder (CLIP text model shape): causal prenorm
+    transformer whose OUTPUT is the final hidden states, consumed by a
+    diffusion UNet's cross-attention (reference container:
+    ``module_inject/containers/clip.py`` for the stable-diffusion text
+    encoder). No LM head; ``tie_embeddings`` keeps init head-free."""
+
+    def apply(self, params, input_ids, positions=None, attention_mask=None,
+              deterministic=True, dropout_rng=None, return_aux=False):
+        x, aux = self.backbone(params, input_ids, positions=positions,
+                               attention_mask=attention_mask,
+                               deterministic=deterministic,
+                               dropout_rng=dropout_rng)
+        return (x, aux) if return_aux else x  # hidden states, not logits
+
+    def loss(self, params, batch, deterministic=True, dropout_rng=None):
+        raise NotImplementedError(
+            "TextEncoder is a conditioning encoder (no LM objective); train "
+            "the underlying backbone as a CausalLM if you need an LM loss")
+
+
 def cross_entropy_loss(logits, labels, ignore_index=-100):
     """Token-mean cross entropy in fp32; -100 labels masked out."""
     logits = logits.astype(jnp.float32)

@@ -124,6 +124,8 @@ def detect_family(hf_config):
         return mt
     if mt == "mistral":
         return "llama"
+    if mt in ("clip", "clip_text_model"):
+        return "clip_text"
     raise ValueError(f"Unsupported HF model_type '{mt}' "
                      "(supported: gpt2, opt, bloom, llama, mistral, gptj, "
                      "gpt_neox, bert, distilbert, gpt_neo)")
@@ -215,6 +217,22 @@ def config_from_hf(hf_config, **overrides):
             embed_layernorm=True, final_layernorm=False,
             type_vocab_size=g("type_vocab_size", 2),
             layernorm_eps=g("layer_norm_eps", 1e-12),
+        )
+    elif fam == "clip_text":
+        # CLIP text encoder (reference container: containers/clip.py): causal
+        # prenorm, quick_gelu, learned positions, final LN, headless
+        tc = hf_config.get("text_config", hf_config)
+        g = tc.get
+        kw = dict(
+            vocab_size=g("vocab_size"),
+            max_seq_len=g("max_position_embeddings", 77),
+            n_layers=g("num_hidden_layers"), n_heads=g("num_attention_heads"),
+            d_model=g("hidden_size"), d_ff=g("intermediate_size"),
+            activation={"quick_gelu": "quick_gelu", "gelu": "gelu_exact"}[
+                g("hidden_act", "quick_gelu")],
+            norm="layernorm", position_embedding="learned",
+            tie_embeddings=True, use_bias=True, prenorm=True,
+            layernorm_eps=g("layer_norm_eps", 1e-5),
         )
     elif fam == "gpt_neo":
         # GPT-2-shaped but nn.Linear weights, no qkv bias, and alternating
@@ -471,6 +489,26 @@ def _neo_block(r, cfg, i):
     }
 
 
+def _clip_text_block(r, cfg, i):
+    """HF CLIPEncoderLayer under text_model. prenorm: layer_norm1 -> attn,
+    layer_norm2 -> mlp."""
+    p = f"text_model.encoder.layers.{i}"
+    return {
+        "ln_1": _ln(r, f"{p}.layer_norm1"),
+        "attn": {
+            "q": _linear_t(r, f"{p}.self_attn.q_proj"),
+            "k": _linear_t(r, f"{p}.self_attn.k_proj"),
+            "v": _linear_t(r, f"{p}.self_attn.v_proj"),
+            "o": _linear_t(r, f"{p}.self_attn.out_proj"),
+        },
+        "ln_2": _ln(r, f"{p}.layer_norm2"),
+        "mlp": {
+            "fc": _linear_t(r, f"{p}.mlp.fc1"),
+            "proj": _linear_t(r, f"{p}.mlp.fc2"),
+        },
+    }
+
+
 def _distilbert_block(r, cfg, i):
     """HF TransformerBlock (distilbert.transformer.layer.N): post-norm like
     BERT with sa_layer_norm / output_layer_norm placement."""
@@ -495,7 +533,7 @@ def _distilbert_block(r, cfg, i):
 
 _BLOCK_FNS = {"gpt2": _gpt2_block, "opt": _opt_block, "bloom": _bloom_block,
               "bert": _bert_block, "distilbert": _distilbert_block,
-              "gpt_neo": _neo_block,
+              "gpt_neo": _neo_block, "clip_text": _clip_text_block,
               "llama": _llama_block, "gptj": _gptj_block,
               "gpt_neox": _neox_block}
 
@@ -560,6 +598,11 @@ def _top_level(r, cfg, fam):
             params["mlm_ln"] = {"scale": np.ones(d, np.float32),
                                 "bias": np.zeros(d, np.float32)}
             params["mlm_bias"] = {"bias": np.zeros(v, np.float32)}
+    elif fam == "clip_text":
+        emb = "text_model.embeddings."
+        params["wte"] = {"weight": r.get(emb + "token_embedding.weight")}
+        params["wpe"] = {"weight": r.get(emb + "position_embedding.weight")}
+        params["ln_f"] = _ln(r, "text_model.final_layer_norm")
     elif fam == "distilbert":
         pre = "distilbert." if r.has("distilbert.embeddings.word_embeddings.weight") \
             else ""
@@ -625,11 +668,17 @@ def load_hf_checkpoint(path, config=None, dtype=np.float32, shardings=None):
 
 def hf_model_from_pretrained(path, dtype=np.float32, **config_overrides):
     """Build ``(model, params)`` from an HF checkpoint directory — CausalLM
-    for decoder families, MaskedLM for bert."""
-    from ..models.transformer import MaskedLM
+    for decoder families, MaskedLM for bert, TextEncoder for CLIP text."""
+    from ..models.transformer import MaskedLM, TextEncoder
 
     hf_cfg = json.load(open(os.path.join(path, "config.json")))
+    fam = detect_family(hf_cfg)
     config = config_from_hf(hf_cfg, **config_overrides)
     config, params = load_hf_checkpoint(path, config=config, dtype=dtype)
-    cls = MaskedLM if not config.causal else CausalLM
+    if fam == "clip_text":
+        cls = TextEncoder
+    elif not config.causal:
+        cls = MaskedLM
+    else:
+        cls = CausalLM
     return cls(config), params
