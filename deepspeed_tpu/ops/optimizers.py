@@ -263,17 +263,21 @@ _IGNORED_ARGS = {"torch_adam", "fused", "set_grad_none", "amsgrad", "freeze_step
 def get_optimizer(name, params=None):
     """Resolve an optimizer by config name (reference ``engine.py:1207``)."""
     key = name.lower().replace("_", "")
-    # 1-bit variants fall back to their exact counterparts until the quantized
-    # collective lands (reference OnebitAdam -> Adam numerics when compression off).
-    if key in ("onebitadam", "zerooneadam"):
-        logger.warning(f"{name}: error-compensated compression not yet enabled; using exact Adam")
-        key = "adam"
-    if key == "onebitlamb":
-        logger.warning(f"{name}: error-compensated compression not yet enabled; using exact Lamb")
-        key = "lamb"
+    kwargs = dict(params or {})
+    # 1-bit variants: the staged compressed-momentum optimizers (ops/onebit.py).
+    # The engine runs their compression stage when the mesh allows (pure-dp,
+    # ZeRO<=1); elsewhere they degrade to exact numerics (update() == Adam/Lamb),
+    # matching the reference's compression-off behavior.
+    if key in ("onebitadam", "zerooneadam", "onebitlamb"):
+        from .onebit import OnebitAdam, OnebitLamb
+
+        cls = OnebitLamb if key == "onebitlamb" else OnebitAdam
+        ob_kwargs = {k: v for k, v in kwargs.items()
+                     if k in ("lr", "betas", "eps", "weight_decay",
+                              "freeze_step")}
+        return cls(**ob_kwargs)
     if key not in OPTIMIZERS:
         raise ValueError(f"Unknown optimizer '{name}'. Available: {sorted(OPTIMIZERS)}")
-    kwargs = dict(params or {})
     for bad in list(kwargs):
         if bad in _IGNORED_ARGS:
             kwargs.pop(bad)

@@ -303,6 +303,7 @@ class DeepSpeedEngine:
         """Reference ``engine.py:1157`` _configure_optimizer: client optimizer wins,
         else build from config; then "wrap" = attach sharded state specs (or hand
         masters+state to the host/NVMe offload manager, the ZeRO-Offload path)."""
+        self._onebit_active = False
         if self.client_optimizer is not None:
             self.optimizer = self.client_optimizer
         else:
@@ -337,13 +338,51 @@ class DeepSpeedEngine:
             )
             return
 
+        # -- 1-bit (compressed-momentum) engine path --------------------------------
+        from ..ops.onebit import OnebitAdam as _OnebitBase
+
+        self._onebit_active = False
+        if isinstance(self.optimizer, _OnebitBase):
+            pure_dp = (self.mp_world_size == 1 and self.pipe_stages == 1
+                       and self.seq_parallel_size == 1
+                       and self.mesh.shape.get(EXPERT_AXIS, 1) == 1)
+            dp = self.mesh.shape[DATA_AXIS]
+            self._onebit_active = (pure_dp and dp > 1 and self.zero_stage <= 1
+                                   and not self.fp16_enabled)
+            if self._onebit_active:
+                log_dist(
+                    f"1-bit optimizer: compressed momentum engages after "
+                    f"freeze_step={self.optimizer.freeze_step} "
+                    f"(train_batch path, dp={dp})", ranks=[0])
+            else:
+                logger.warning(
+                    "1-bit optimizer: compression requires a pure data-parallel "
+                    "mesh, ZeRO<=1, bf16/fp32; running with exact numerics "
+                    "(the reference's compression-off behavior)")
+
         state_shape = jax.eval_shape(self.optimizer.init, self.params)
-        opt_state_specs = self._opt_state_specs(state_shape)
+        if self._onebit_active:
+            # worker/server error feedback is per-device state; keep the
+            # optimizer moments replicated so every device applies the same
+            # reduced-momentum update
+            opt_state_specs = jax.tree_util.tree_map(lambda _: P(), state_shape)
+        else:
+            opt_state_specs = self._opt_state_specs(state_shape)
         self._opt_shardings = named(self.mesh, opt_state_specs)
         with self.mesh:
             self.optimizer_state = jax.jit(
                 self.optimizer.init, out_shardings=self._opt_shardings
             )(self.params)
+        if self._onebit_active:
+            dp = self.mesh.shape[DATA_AXIS]
+            L = self.num_parameters
+            self._onebit_lpad = -(-L // dp) * dp
+            data_sh = NamedSharding(self.mesh, P(DATA_AXIS))
+            self._onebit_we = jax.device_put(
+                np.zeros(dp * self._onebit_lpad, np.float32), data_sh)
+            self._onebit_se = jax.device_put(
+                np.zeros(self._onebit_lpad, np.float32), data_sh)
+            self._onebit_fns = {}
 
     def _opt_state_specs(self, state_shape):
         """Param-shaped leaves get ZeRO-1+ data-sharded specs; scalars replicate."""
@@ -632,6 +671,124 @@ class DeepSpeedEngine:
             return None
         return self._curriculum.state["current_difficulty"]
 
+    def _build_onebit_step(self, stage, batch_tree):
+        """One compiled program per 1-bit stage (reference ``onebit/adam.py``
+        warmup vs compressed): everything — local grads, grad accumulation,
+        the compressed momentum allreduce, and the update — runs inside ONE
+        shard_map over ``data``. The stage is picked HOST-side from
+        global_steps (freeze_step is static), so no collective sits inside a
+        conditional."""
+        from jax.flatten_util import ravel_pytree
+
+        from ..comm.compressed import compressed_allreduce_local
+
+        gas = self.gradient_accumulation_steps_
+        opt = self.optimizer
+        L_pad = self._onebit_lpad
+        bits = self._config.gradient_compression.bits \
+            if self._config.gradient_compression.enabled else 1
+
+        def local_grads(params, batches, rng):
+            def gfn(p, micro, r):
+                loss = self.module.loss(p, micro, deterministic=False,
+                                        dropout_rng=r)
+                return loss
+
+            grad_fn = jax.value_and_grad(gfn)
+            if gas == 1:
+                loss, g = grad_fn(params, batches, rng)
+            else:
+                rngs = jax.random.split(rng, gas)
+                zeros = jax.tree_util.tree_map(
+                    lambda p: jnp.zeros(p.shape, p.dtype), params)
+
+                def body(carry, xs):
+                    acc, lsum = carry
+                    micro, r = xs
+                    l, g = grad_fn(params, micro, r)
+                    return (jax.tree_util.tree_map(jnp.add, acc, g),
+                            lsum + l), None
+
+                (g, lsum), _ = jax.lax.scan(
+                    body, (zeros, jnp.zeros((), jnp.float32)), (batches, rngs))
+                g = jax.tree_util.tree_map(lambda a: a / gas, g)
+                loss = lsum / gas
+            return loss, g
+
+        def body(params, state, we, se, batches, rng, lr):
+            loss, g = local_grads(params, batches, rng)
+            loss = jax.lax.pmean(loss, DATA_AXIS)
+            if stage == "warmup":
+                g = jax.tree_util.tree_map(
+                    lambda a: jax.lax.pmean(a.astype(jnp.float32), DATA_AXIS), g)
+                new_params, new_state = opt.update(
+                    g, state, params, lr=lr, wd_mask=self._wd_mask)
+                return new_params, new_state, we, se, loss
+            m_tree = opt.local_momentum(
+                jax.tree_util.tree_map(lambda a: a.astype(jnp.float32), g),
+                state)
+            flat, unravel = ravel_pytree(m_tree)
+            flat = jnp.pad(flat, (0, L_pad - flat.size))
+            m_red, we, se = compressed_allreduce_local(
+                flat, we, se, DATA_AXIS, bits=bits)
+            new_params, new_state = opt.apply_compressed(
+                unravel(m_red[:self.num_parameters]), state, params,
+                lr=lr, wd_mask=self._wd_mask)
+            return new_params, new_state, we, se, loss
+
+        batch_in_specs = jax.tree_util.tree_map(
+            lambda a: P(None, DATA_AXIS) if gas > 1 else P(DATA_AXIS),
+            batch_tree)
+        param_specs = jax.tree_util.tree_map(lambda _: P(), self.params)
+        state_specs = jax.tree_util.tree_map(lambda _: P(), self.optimizer_state)
+        sm = jax.shard_map(
+            body, mesh=self.mesh,
+            in_specs=(param_specs, state_specs, P(DATA_AXIS), P(DATA_AXIS),
+                      batch_in_specs, P(), P()),
+            out_specs=(param_specs, state_specs, P(DATA_AXIS), P(DATA_AXIS),
+                       P()),
+            axis_names={DATA_AXIS}, check_vma=False)
+        with self.mesh:
+            return jax.jit(sm, donate_argnums=(0, 1, 2, 3))
+
+    def _onebit_train_batch(self, micros):
+        gas = self.gradient_accumulation_steps_
+        dp = self.mesh.shape[DATA_AXIS]
+        if gas == 1:
+            batches = {k: jnp.asarray(np.asarray(micros[0][k]))
+                       for k in micros[0]}
+        else:
+            batches = {k: jnp.asarray(np.stack(
+                [np.asarray(m[k]) for m in micros])) for k in micros[0]}
+        rows_axis = 1 if gas > 1 else 0
+        for k, v in batches.items():
+            if v.shape[rows_axis] % dp:
+                raise ConfigError(
+                    f"Batch leaf '{k}' has {v.shape[rows_axis]} rows, not "
+                    f"divisible by the data-parallel mesh axis ({dp})")
+        stage = "warmup" if self.global_steps < self.optimizer.freeze_step \
+            else "compressed"
+        key = (stage, jax.tree_util.tree_structure(batches),
+               tuple(np.asarray(v).shape for v in batches.values()))
+        if key not in self._onebit_fns:
+            self._onebit_fns[key] = self._build_onebit_step(stage, batches)
+        self._rng, step_rng = jax.random.split(self._rng)
+        lr = self._current_lr()
+        (self.params, self.optimizer_state, self._onebit_we, self._onebit_se,
+         loss) = self._onebit_fns[key](
+            self.params, self.optimizer_state, self._onebit_we,
+            self._onebit_se, batches, step_rng, jnp.asarray(lr, jnp.float32))
+        self.micro_steps += gas
+        self.global_steps += 1
+        if self.lr_scheduler is not None:
+            self.lr_scheduler.step()
+        if self.global_steps % self._config.steps_per_print == 0:
+            self.monitor.write_events(
+                [("Train/lr", lr, self.global_steps),
+                 ("Train/loss", float(loss), self.global_steps)])
+            self._report_progress()
+        return loss
+
     # ------------------------------------------------------------------------------
     # data placement
     # ------------------------------------------------------------------------------
@@ -808,6 +965,10 @@ class DeepSpeedEngine:
         for _ in range(self.gradient_accumulation_steps_):
             micro = batch if batch is not None else next(data_iter)
             micros.append(self._apply_curriculum(micro))
+        if self._onebit_active:
+            mean_loss = self._onebit_train_batch(micros)
+            self.tput_timer.stop(global_step=True)
+            return mean_loss
         if self._can_fuse_train_step():
             mean_loss = self._fused_train_batch(micros)
             self.tput_timer.stop(global_step=True)

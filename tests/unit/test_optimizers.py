@@ -145,9 +145,13 @@ def test_update_is_jittable_and_bf16_params():
     assert new_params["w"].dtype == jnp.bfloat16
 
 
-def test_onebit_fallback_and_unknown():
-    opt = get_optimizer("OneBitAdam", {"lr": 1e-3})
-    assert isinstance(opt, Adam)
+def test_onebit_resolution_and_unknown():
+    from deepspeed_tpu.ops.onebit import OnebitAdam, OnebitLamb
+
+    opt = get_optimizer("OneBitAdam", {"lr": 1e-3, "freeze_step": 7})
+    assert isinstance(opt, OnebitAdam)
+    assert opt.freeze_step == 7
+    assert isinstance(get_optimizer("onebit_lamb", {}), OnebitLamb)
     with pytest.raises(ValueError):
         get_optimizer("nope", {})
 
