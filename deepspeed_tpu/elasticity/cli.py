@@ -1,0 +1,46 @@
+"""``ds_tpu_elastic`` — elastic batch calculator CLI (reference ``bin/ds_elastic``):
+resolve a config's elasticity section into the final batch size, the
+compatible device counts, and (optionally) the per-device micro batch at a
+given world size.
+
+    ds_tpu_elastic -c ds_config.json
+    ds_tpu_elastic -c ds_config.json -w 64
+"""
+
+import argparse
+import json
+import sys
+
+from .elasticity import ElasticityError, compute_elastic_config
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("-c", "--config", required=True,
+                   help="DeepSpeed config JSON with an elasticity section")
+    p.add_argument("-w", "--world-size", type=int, default=0,
+                   help="also validate this device count and derive the "
+                        "micro batch")
+    args = p.parse_args(argv)
+
+    ds_config = json.load(open(args.config))
+    try:
+        if args.world_size > 0:
+            batch, valid, micro = compute_elastic_config(
+                ds_config, world_size=args.world_size, return_microbatch=True)
+        else:
+            batch, valid = compute_elastic_config(ds_config)
+            micro = None
+    except ElasticityError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+
+    print(f"final train_batch_size : {batch}")
+    print(f"compatible device counts: {sorted(valid)}")
+    if micro is not None:
+        print(f"micro batch @ world={args.world_size}: {micro}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
