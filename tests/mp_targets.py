@@ -27,7 +27,8 @@ def global_mesh_psum():
     import jax.numpy as jnp
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-    devs = np.array(jax.devices()).reshape(8)
+    n = len(jax.devices())
+    devs = np.array(jax.devices()).reshape(n)
     mesh = Mesh(devs, ("data",))
     sharding = NamedSharding(mesh, P("data"))
 
@@ -35,9 +36,10 @@ def global_mesh_psum():
         start = idx[0].start or 0
         return np.arange(start, start + 1, dtype=np.float32)
 
-    x = jax.make_array_from_callback((8,), sharding, cb)
+    x = jax.make_array_from_callback((n,), sharding, cb)
     total = jax.jit(lambda a: jnp.sum(a), out_shardings=NamedSharding(mesh, P()))(x)
-    np.testing.assert_allclose(np.asarray(jax.device_get(total)), 28.0)
+    np.testing.assert_allclose(np.asarray(jax.device_get(total)),
+                               n * (n - 1) / 2.0)
 
 
 def sharded_checkpoint_two_hosts():

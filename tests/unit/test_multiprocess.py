@@ -34,3 +34,10 @@ def test_hang_detection_kills_workers():
 def test_rank_consistency_guard_two_processes():
     run_distributed("tests.mp_targets:rank_consistency_pass_and_fail",
                     world_size=2)
+
+
+def test_global_mesh_psum_four_processes():
+    """world_size=4: the rendezvous + global mesh scale past the pairwise
+    case (the reference's DistributedTest runs world sizes up to 4)."""
+    run_distributed("tests.mp_targets:global_mesh_psum", world_size=4,
+                    timeout=120)
