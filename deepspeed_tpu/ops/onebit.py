@@ -40,14 +40,26 @@ class OnebitAdam(TPUOptimizer):
             "exp_avg": _tree_zeros_like(params),
             "exp_avg_sq": _tree_zeros_like(params),
             "step": jnp.zeros((), jnp.int32),
+            # step at which the variance was last tracked by an exact round —
+            # the bias-correction horizon for the compressed stage
+            "v_step": jnp.zeros((), jnp.int32),
         }
 
     def in_warmup(self, state):
         return state["step"] < self.freeze_step
 
+    def wants_exact_step(self, step):
+        """Host-side stage pick for the engine: True -> exact program."""
+        return step < self.freeze_step
+
     def update(self, grads, state, params, lr=None, wd_mask=None):
-        """Warmup path == exact Adam (grads already mean-reduced)."""
-        return self._adam.update(grads, state, params, lr=lr, wd_mask=wd_mask)
+        """Exact path == Adam (grads already mean-reduced); tracks v_step."""
+        adam_state = {k: state[k] for k in ("exp_avg", "exp_avg_sq", "step")}
+        new_params, s2 = self._adam.update(grads, adam_state, params, lr=lr,
+                                           wd_mask=wd_mask)
+        s2 = dict(s2)
+        s2["v_step"] = s2["step"]
+        return new_params, s2
 
     # -- compressed stage (engine calls these around the compressed collective)
     def local_momentum(self, grads, state):
@@ -74,8 +86,10 @@ class OnebitAdam(TPUOptimizer):
         step = state["step"] + 1
         mask = _mask_like(wd_mask, params)
         c1 = 1.0 - self.b1 ** step.astype(jnp.float32)
-        c2 = 1.0 - self.b2 ** jnp.minimum(
-            step, self.freeze_step).astype(jnp.float32)
+        # v was last tracked at v_step (warmup end, or the latest 0/1-Adam
+        # variance refresh) — correct with THAT horizon, not the current step
+        c2 = 1.0 - self.b2 ** jnp.maximum(
+            state["v_step"], 1).astype(jnp.float32)
 
         def leaf(p, m, v, decay):
             upd = (m / c1) / (jnp.sqrt(v / c2) + self.eps)
@@ -86,7 +100,7 @@ class OnebitAdam(TPUOptimizer):
         new_params = jax.tree_util.tree_map(
             leaf, params, m_reduced, state["exp_avg_sq"], mask)
         new_state = {"exp_avg": m_reduced, "exp_avg_sq": state["exp_avg_sq"],
-                     "step": step}
+                     "step": step, "v_step": state["v_step"]}
         return new_params, new_state
 
 
@@ -99,3 +113,31 @@ class OnebitLamb(OnebitAdam):
         u_norm = jnp.linalg.norm(upd.ravel())
         return jnp.where((w_norm > 0) & (u_norm > 0),
                          w_norm / jnp.maximum(u_norm, 1e-30), 1.0)
+
+
+class ZeroOneAdam(OnebitAdam):
+    """0/1 Adam (reference ``onebit/zoadam.py``): compression starts almost
+    immediately, and instead of freezing the variance forever, an EXACT
+    synchronization round runs every ``var_update_interval`` steps — the
+    variance (and momentum) refresh from true mean gradients, then compressed
+    momentum resumes against the refreshed ``v``.
+
+    The reference schedules these refreshes with growing intervals
+    (``var_freeze_step`` + interval scaling); here the interval is a fixed
+    knob — the engine picks the exact-sync program whenever
+    ``step % var_update_interval == 0`` (host-side, so no collective sits in
+    a conditional). ``freeze_step`` keeps its warmup meaning and defaults
+    low."""
+
+    def __init__(self, lr=1e-3, betas=(0.9, 0.999), eps=1e-8,
+                 weight_decay=0.0, freeze_step=2, var_update_interval=16):
+        super().__init__(lr=lr, betas=betas, eps=eps,
+                         weight_decay=weight_decay, freeze_step=freeze_step)
+        self.var_update_interval = max(1, int(var_update_interval))
+
+    def wants_exact_step(self, step):
+        """True when ``step`` (0-based global step) should run the exact
+        (uncompressed) program: warmup AND periodic variance refreshes."""
+        if step < self.freeze_step:
+            return True
+        return (step % self.var_update_interval) == 0

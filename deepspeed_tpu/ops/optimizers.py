@@ -269,12 +269,14 @@ def get_optimizer(name, params=None):
     # ZeRO<=1); elsewhere they degrade to exact numerics (update() == Adam/Lamb),
     # matching the reference's compression-off behavior.
     if key in ("onebitadam", "zerooneadam", "onebitlamb"):
-        from .onebit import OnebitAdam, OnebitLamb
+        from .onebit import OnebitAdam, OnebitLamb, ZeroOneAdam
 
-        cls = OnebitLamb if key == "onebitlamb" else OnebitAdam
-        ob_kwargs = {k: v for k, v in kwargs.items()
-                     if k in ("lr", "betas", "eps", "weight_decay",
-                              "freeze_step")}
+        cls = {"onebitadam": OnebitAdam, "onebitlamb": OnebitLamb,
+               "zerooneadam": ZeroOneAdam}[key]
+        allowed = ("lr", "betas", "eps", "weight_decay", "freeze_step")
+        if key == "zerooneadam":
+            allowed += ("var_update_interval",)
+        ob_kwargs = {k: v for k, v in kwargs.items() if k in allowed}
         return cls(**ob_kwargs)
     if key not in OPTIMIZERS:
         raise ValueError(f"Unknown optimizer '{name}'. Available: {sorted(OPTIMIZERS)}")

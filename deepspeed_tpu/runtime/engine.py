@@ -766,7 +766,7 @@ class DeepSpeedEngine:
                 raise ConfigError(
                     f"Batch leaf '{k}' has {v.shape[rows_axis]} rows, not "
                     f"divisible by the data-parallel mesh axis ({dp})")
-        stage = "warmup" if self.global_steps < self.optimizer.freeze_step \
+        stage = "warmup" if self.optimizer.wants_exact_step(self.global_steps) \
             else "compressed"
         key = (stage, jax.tree_util.tree_structure(batches),
                tuple(np.asarray(v).shape for v in batches.values()))

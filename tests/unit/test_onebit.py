@@ -215,3 +215,48 @@ def test_engine_onebit_falls_back_on_tp_mesh(devices8):
     batch = {"input_ids": rng.randint(0, 64, (8, 16)).astype(np.int32)}
     losses = [float(eng.train_batch(batch=batch)) for _ in range(3)]
     assert losses[-1] < losses[0]
+
+
+def test_zero_one_adam_variance_refresh(devices8):
+    """0/1 Adam: compression starts after a tiny warmup, and every
+    var_update_interval steps an exact round refreshes the variance (the
+    engine picks the program host-side). The refresh must actually move the
+    bias-correction horizon (v_step) and training keeps converging."""
+    import deepspeed_tpu
+    from deepspeed_tpu.models import CausalLM, TransformerConfig
+    from deepspeed_tpu.ops.onebit import ZeroOneAdam
+
+    eng, _, _, _ = deepspeed_tpu.initialize(
+        model=CausalLM(TransformerConfig(
+            vocab_size=64, max_seq_len=32, n_layers=2, n_heads=2, d_model=32,
+            d_ff=64, compute_dtype=jnp.float32)),
+        config={
+            "train_batch_size": 8,
+            "optimizer": {"type": "zero_one_adam",
+                          "params": {"lr": 5e-3, "freeze_step": 2,
+                                     "var_update_interval": 4}},
+            "zero_optimization": {"stage": 0},
+            "mesh": {"data": 8},
+            "steps_per_print": 10 ** 9,
+        })
+    assert isinstance(eng.optimizer, ZeroOneAdam)
+    assert eng._onebit_active
+
+    # stage schedule: steps 0,1 warmup; 4, 8 exact refresh; rest compressed
+    sched = [eng.optimizer.wants_exact_step(s) for s in range(10)]
+    assert sched == [True, True, False, False, True, False, False, False,
+                     True, False]
+
+    rng = np.random.RandomState(3)
+    batch = {"input_ids": rng.randint(0, 64, (8, 16)).astype(np.int32)}
+    losses = []
+    v_steps = []
+    for _ in range(10):
+        losses.append(float(eng.train_batch(batch=batch)))
+        v_steps.append(int(eng.optimizer_state["v_step"]))
+    assert losses[-1] < losses[0]
+    # v_step advanced at each exact round (steps 2, then refreshes at 5, 9)
+    assert v_steps[1] == 2          # after warmup
+    assert v_steps[4] == 5          # refresh at global step 4 -> v_step 5
+    assert v_steps[8] == 9          # refresh at global step 8
+    assert v_steps[7] == v_steps[5] == v_steps[4]  # frozen between refreshes
