@@ -253,6 +253,16 @@ class CurriculumConfig(ConfigModel):
     schedule_config: dict = {}
 
 
+class ProgressiveLayerDropConfig(ConfigModel):
+    """Reference ``progressive_layer_drop`` section (``engine.py:680``,
+    ``runtime/progressive_layer_drop.py``): stochastic depth with the
+    theta(t) = (1-theta_bar) exp(-gamma t) + theta_bar keep schedule."""
+
+    enabled: bool = False
+    theta: float = 0.5
+    gamma: float = 0.001
+
+
 class DeepSpeedConfig(ConfigModel):
     """Top-level config (reference ``runtime/config.py:674``)."""
 
@@ -282,6 +292,7 @@ class DeepSpeedConfig(ConfigModel):
     flops_profiler: FlopsProfilerConfig = FlopsProfilerConfig
     data_types: DataTypesConfig = DataTypesConfig
     curriculum_learning: CurriculumConfig = CurriculumConfig
+    progressive_layer_drop: ProgressiveLayerDropConfig = ProgressiveLayerDropConfig
     gradient_compression: GradientCompressionConfig = GradientCompressionConfig
     communication_data_type: typing.Optional[str] = None
     wall_clock_breakdown: bool = False

@@ -378,7 +378,8 @@ def stack_init(rng, cfg):
 
 
 def stack_apply(cfg, stacked_params, x, mask=None, rope=None, alibi=None,
-                deterministic=True, dropout_rng=None, kv_mask=None):
+                deterministic=True, dropout_rng=None, kv_mask=None,
+                pld_theta=None):
     """Run the L blocks; returns ``(x, aux_loss)``. scan_layers=True: one compiled
     block iterated L times (compile-time constant in depth); False: unrolled python
     loop (better for very shallow nets / per-layer sharding experiments)."""
@@ -397,6 +398,9 @@ def stack_apply(cfg, stacked_params, x, mask=None, rope=None, alibi=None,
         if cfg.local_attention_window > 0:
             raise NotImplementedError(
                 "local_attention_window not supported with pipeline parallelism")
+        if pld_theta is not None:
+            raise NotImplementedError(
+                "progressive layer drop not supported with pipeline parallelism")
         return _pipeline_stack(cfg, stacked_params, x, mask, rope, alibi,
                                deterministic, dropout_rng)
 
@@ -437,6 +441,18 @@ def stack_apply(cfg, stacked_params, x, mask=None, rope=None, alibi=None,
                 p, cfg.zero3_gather_specs)
         return p
 
+    def pld_select(i, h_new, h_prev, aux_i, rng_i):
+        """Progressive layer drop (reference ``progressive_layer_drop.py``):
+        keep layer i with prob 1 - (i/L)(1 - theta); a dropped layer passes
+        the residual stream through untouched (no rescale, as in the paper).
+        """
+        if pld_theta is None or deterministic or dropout_rng is None:
+            return h_new, aux_i
+        keep_p = 1.0 - (i.astype(jnp.float32) / cfg.n_layers) * (1.0 - pld_theta)
+        keep = jax.random.bernoulli(jax.random.fold_in(rng_i, 9), keep_p)
+        return (jnp.where(keep, h_new, h_prev),
+                jnp.where(keep, aux_i, jnp.zeros_like(aux_i)))
+
     aux = jnp.zeros((), jnp.float32)
     if not cfg.scan_layers or local_pattern is not None:
         # unrolled: per-layer mask selection stays a python choice (global
@@ -447,7 +463,8 @@ def stack_apply(cfg, stacked_params, x, mask=None, rope=None, alibi=None,
             rng_i = jax.random.fold_in(dropout_rng, i) if dropout_rng is not None else None
             m_i = local_mask if (local_pattern is not None and local_pattern[i]) \
                 else mask
-            x, aux_i = body(p_i, x, rng_i, m_i)
+            h_new, aux_i = body(p_i, x, rng_i, m_i)
+            x, aux_i = pld_select(jnp.asarray(i), h_new, x, aux_i, rng_i)
             aux = aux + aux_i
         return x, aux
 
@@ -455,7 +472,8 @@ def stack_apply(cfg, stacked_params, x, mask=None, rope=None, alibi=None,
         h, i, aux = carry
         p = gather_constraint(xs)
         rng_i = jax.random.fold_in(dropout_rng, i) if dropout_rng is not None else None
-        h, aux_i = body(p, h, rng_i, mask)
+        h_new, aux_i = body(p, h, rng_i, mask)
+        h, aux_i = pld_select(i, h_new, h, aux_i, rng_i)
         return (h, i + 1, aux + aux_i), None
 
     (x, _, aux), _ = jax.lax.scan(
@@ -561,7 +579,8 @@ class CausalLM:
 
     # -- forward ------------------------------------------------------------------
     def backbone(self, params, input_ids, positions=None, attention_mask=None,
-                 deterministic=True, dropout_rng=None, token_type_ids=None):
+                 deterministic=True, dropout_rng=None, token_type_ids=None,
+                 pld_theta=None):
         """Embedding + blocks + final norm -> ([batch, seq, d_model], aux)."""
         cfg = self.config
         b, s = input_ids.shape
@@ -601,7 +620,8 @@ class CausalLM:
 
         x, aux = stack_apply(cfg, params["blocks"], x, mask=mask, rope=rope,
                              alibi=alibi, deterministic=deterministic,
-                             dropout_rng=dropout_rng, kv_mask=kv_mask)
+                             dropout_rng=dropout_rng, kv_mask=kv_mask,
+                             pld_theta=pld_theta)
         if cfg.final_layernorm:
             x = _norm_apply(cfg, params["ln_f"], x)
         return x, aux
@@ -641,9 +661,11 @@ class CausalLM:
         return (logits, aux) if return_aux else logits
 
     # -- loss ---------------------------------------------------------------------
-    def loss(self, params, batch, deterministic=True, dropout_rng=None):
+    def loss(self, params, batch, deterministic=True, dropout_rng=None,
+             pld_theta=None):
         """Next-token cross entropy. batch: {input_ids, labels?, attention_mask?};
-        labels default to input_ids shifted; label -100 = ignored (HF convention)."""
+        labels default to input_ids shifted; label -100 = ignored (HF convention).
+        ``pld_theta``: traced progressive-layer-drop keep parameter (engine)."""
         cfg = self.config
         input_ids = batch["input_ids"]
         labels = batch.get("labels")
@@ -654,7 +676,7 @@ class CausalLM:
         x, aux = self.backbone(
             params, input_ids, attention_mask=batch.get("attention_mask"),
             positions=batch.get("position_ids"), deterministic=deterministic,
-            dropout_rng=dropout_rng,
+            dropout_rng=dropout_rng, pld_theta=pld_theta,
         )
         return self.head_ce(params, x, labels) + aux
 

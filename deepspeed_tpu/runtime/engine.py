@@ -199,6 +199,18 @@ class DeepSpeedEngine:
             log_dist("ZeRO-3 gather mode: per_layer (explicit schedule)",
                      ranks=[0])
 
+        # -- progressive layer drop (reference engine.py:680 PLD hook) ---------------
+        self._pld = None
+        pld_cfg = self._config.progressive_layer_drop
+        if pld_cfg.enabled:
+            from .extras import ProgressiveLayerDrop
+
+            self._pld = ProgressiveLayerDrop(theta=pld_cfg.theta,
+                                             gamma=pld_cfg.gamma)
+            log_dist(
+                f"Progressive layer drop: theta_bar={pld_cfg.theta} "
+                f"gamma={pld_cfg.gamma}", ranks=[0])
+
         # -- curriculum learning (reference engine.py:1675 seqlen scheduling) --------
         self._curriculum = None
         cl = self._config.curriculum_learning
@@ -553,11 +565,16 @@ class DeepSpeedEngine:
         grads' HBM round-trip between the backward and the update."""
         gas = self.gradient_accumulation_steps_
 
-        def train_step(params, opt_state, batches, scale, good_steps, rng, lr):
+        pld_enabled = self._pld is not None
+
+        def train_step(params, opt_state, batches, scale, good_steps, rng, lr,
+                       pld_theta):
             new_rng, step_rng = jax.random.split(rng)
 
             def scaled_loss(p, batch, r):
-                loss = self.module.loss(p, batch, deterministic=False, dropout_rng=r)
+                loss = self.module.loss(
+                    p, batch, deterministic=False, dropout_rng=r,
+                    **({"pld_theta": pld_theta} if pld_enabled else {}))
                 return loss * scale.astype(loss.dtype) / gas, loss
 
             grad_fn = jax.value_and_grad(scaled_loss, has_aux=True)
@@ -627,10 +644,14 @@ class DeepSpeedEngine:
             batches = {k: jax.device_put(jnp.asarray(stacked[k]), shardings[k])
                        for k in keys}
         lr = self._current_lr()
+        pld_theta = jnp.asarray(
+            self._pld.update_state(self.global_steps) if self._pld else 1.0,
+            jnp.float32)
         (self.params, self.optimizer_state, self._scale, self._good_steps,
          overflow, grad_norm, mean_loss, self._rng) = self._train_step_fn(
             self.params, self.optimizer_state, batches, self._scale,
             self._good_steps, self._rng, jnp.asarray(lr, jnp.float32),
+            pld_theta,
         )
         self.micro_steps += gas
         self.global_steps += 1

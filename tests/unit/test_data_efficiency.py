@@ -171,3 +171,51 @@ def test_engine_curriculum_truncates_and_anneals(devices8):
         seen.append(engine.curriculum_difficulty)
     assert seen[0] < seen[-1]          # annealed up
     assert seen[0] == 8 and seen[-1] == 32
+
+
+def test_engine_progressive_layer_drop(devices8):
+    """PLD wired through the fused step: theta(0)=1 makes step 1 IDENTICAL to
+    a no-PLD engine (keep prob 1 everywhere); theta then decays toward
+    theta_bar and training stays finite with layers dropping."""
+    import deepspeed_tpu
+    from deepspeed_tpu.models import CausalLM, TransformerConfig
+    import jax
+    import jax.numpy as jnp
+
+    def mk(pld):
+        model = CausalLM(TransformerConfig(
+            vocab_size=64, max_seq_len=32, n_layers=4, n_heads=2, d_model=16,
+            d_ff=32, compute_dtype=jnp.float32))
+        cfg = {
+            "train_batch_size": 8,
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+            "zero_optimization": {"stage": 0},
+            "mesh": {"data": 8},
+            "steps_per_print": 10 ** 9,
+        }
+        if pld:
+            cfg["progressive_layer_drop"] = {"enabled": True, "theta": 0.5,
+                                             "gamma": 0.5}
+        eng, _, _, _ = deepspeed_tpu.initialize(model=model, config=cfg)
+        return eng
+
+    e_pld = mk(True)
+    e_ref = mk(False)
+    e_pld.params = jax.tree_util.tree_map(
+        lambda v, s: jax.device_put(np.asarray(v), s),
+        e_ref.params, jax.tree_util.tree_map(lambda a: a.sharding,
+                                             e_pld.params))
+
+    rng = np.random.RandomState(0)
+    batch = {"input_ids": rng.randint(0, 64, (8, 16)).astype(np.int32)}
+    l_pld_0 = float(e_pld.train_batch(batch=batch))
+    l_ref_0 = float(e_ref.train_batch(batch=batch))
+    np.testing.assert_allclose(l_pld_0, l_ref_0, rtol=2e-5)  # theta(0) = 1
+
+    thetas = [e_pld._pld.get_theta()]
+    for _ in range(5):
+        loss = float(e_pld.train_batch(batch=batch))
+        assert np.isfinite(loss)
+        thetas.append(e_pld._pld.get_theta())
+    assert thetas[-1] < thetas[0]           # decaying toward theta_bar
+    assert thetas[-1] > 0.5                 # bounded below by theta_bar
