@@ -158,9 +158,9 @@ def forward_with_cache(model, params, input_ids, cache, pos, kv_len):
                                   cfg.rope_base)
 
     if cfg.local_attention_window > 0:
-        pat = cfg.attention_layers or ("global", "local")
-        is_local_arr = jnp.asarray(
-            [pat[i % len(pat)] == "local" for i in range(cfg.n_layers)])
+        from .transformer import local_attention_flags
+
+        is_local_arr = jnp.asarray(local_attention_flags(cfg))
 
         def scan_fn(carry, layer):
             h = carry

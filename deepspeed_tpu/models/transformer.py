@@ -377,6 +377,14 @@ def stack_init(rng, cfg):
     )
 
 
+def local_attention_flags(cfg):
+    """Per-layer is-local booleans for banded local attention (HF GPT-Neo
+    attention_types cycling). The ONE place the pattern expands — shared by
+    the training masks and the KV-cache decode path so they cannot drift."""
+    pat = cfg.attention_layers or ("global", "local")
+    return [pat[i % len(pat)] == "local" for i in range(cfg.n_layers)]
+
+
 def stack_apply(cfg, stacked_params, x, mask=None, rope=None, alibi=None,
                 deterministic=True, dropout_rng=None, kv_mask=None,
                 pld_theta=None):
@@ -418,9 +426,7 @@ def stack_apply(cfg, stacked_params, x, mask=None, rope=None, alibi=None,
         band = (qi >= ki) & (qi - ki < cfg.local_attention_window)
         gmask = mask if mask is not None else L.causal_mask(s, s)
         local_mask = gmask & band
-        pat = cfg.attention_layers or ("global", "local")
-        local_pattern = [pat[i % len(pat)] == "local"
-                         for i in range(cfg.n_layers)]
+        local_pattern = local_attention_flags(cfg)
 
     def body(p, h, rng, m):
         return block_apply(
@@ -746,7 +752,8 @@ class MaskedLM(CausalLM):
         logits = self.head(params, x)
         return (logits, aux) if return_aux else logits
 
-    def loss(self, params, batch, deterministic=True, dropout_rng=None):
+    def loss(self, params, batch, deterministic=True, dropout_rng=None,
+             pld_theta=None):
         """Masked-token cross entropy; no label shifting (denoising, not AR)."""
         if "labels" not in batch:
             raise ValueError("MaskedLM.loss needs explicit 'labels' "
@@ -760,6 +767,7 @@ class MaskedLM(CausalLM):
             positions=batch.get("position_ids"),
             token_type_ids=token_type_ids,
             deterministic=deterministic, dropout_rng=dropout_rng,
+            pld_theta=pld_theta,
         )
         return self.head_ce(params, x, batch["labels"]) + aux
 

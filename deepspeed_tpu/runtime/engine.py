@@ -203,13 +203,28 @@ class DeepSpeedEngine:
         self._pld = None
         pld_cfg = self._config.progressive_layer_drop
         if pld_cfg.enabled:
-            from .extras import ProgressiveLayerDrop
+            import inspect
 
-            self._pld = ProgressiveLayerDrop(theta=pld_cfg.theta,
-                                             gamma=pld_cfg.gamma)
-            log_dist(
-                f"Progressive layer drop: theta_bar={pld_cfg.theta} "
-                f"gamma={pld_cfg.gamma}", ranks=[0])
+            supported = ("pld_theta"
+                         in inspect.signature(self.module.loss).parameters)
+            if not supported:
+                logger.warning(
+                    "progressive_layer_drop enabled but %s.loss has no "
+                    "pld_theta parameter; PLD is OFF",
+                    type(self.module).__name__)
+            elif self._onebit_active or self._offloaded is not None \
+                    or self.pipe_stages > 1:
+                logger.warning(
+                    "progressive_layer_drop only engages on the fused "
+                    "train_batch path (not 1-bit/offload/pipeline); PLD is OFF")
+            else:
+                from .extras import ProgressiveLayerDrop
+
+                self._pld = ProgressiveLayerDrop(theta=pld_cfg.theta,
+                                                 gamma=pld_cfg.gamma)
+                log_dist(
+                    f"Progressive layer drop: theta_bar={pld_cfg.theta} "
+                    f"gamma={pld_cfg.gamma}", ranks=[0])
 
         # -- curriculum learning (reference engine.py:1675 seqlen scheduling) --------
         self._curriculum = None
@@ -736,18 +751,31 @@ class DeepSpeedEngine:
                 loss = lsum / gas
             return loss, g
 
+        clip = self._config.gradient_clipping
+
         def body(params, state, we, se, batches, rng, lr):
             loss, g = local_grads(params, batches, rng)
             loss = jax.lax.pmean(loss, DATA_AXIS)
             if stage == "warmup":
                 g = jax.tree_util.tree_map(
                     lambda a: jax.lax.pmean(a.astype(jnp.float32), DATA_AXIS), g)
+                if clip > 0:  # exact global-norm clip, matching the adamw path
+                    g, _ = clip_grads_by_global_norm(g, clip)
                 new_params, new_state = opt.update(
                     g, state, params, lr=lr, wd_mask=self._wd_mask)
                 return new_params, new_state, we, se, loss
-            m_tree = opt.local_momentum(
-                jax.tree_util.tree_map(lambda a: a.astype(jnp.float32), g),
-                state)
+            g = jax.tree_util.tree_map(lambda a: a.astype(jnp.float32), g)
+            if clip > 0:
+                # compressed stage: the exact global-grad norm would need the
+                # uncompressed pmean (defeating the compression), so clip each
+                # local grad by sqrt(pmean ||g_local||^2) — an upper bound on
+                # the mean-grad norm, so spikes are still bounded
+                sq = sum(jnp.sum(jnp.square(a))
+                         for a in jax.tree_util.tree_leaves(g))
+                norm = jnp.sqrt(jax.lax.pmean(sq, DATA_AXIS))
+                factor = jnp.minimum(1.0, clip / jnp.maximum(norm, 1e-12))
+                g = jax.tree_util.tree_map(lambda a: a * factor, g)
+            m_tree = opt.local_momentum(g, state)
             flat, unravel = ravel_pytree(m_tree)
             flat = jnp.pad(flat, (0, L_pad - flat.size))
             m_red, we, se = compressed_allreduce_local(
@@ -790,7 +818,7 @@ class DeepSpeedEngine:
         stage = "warmup" if self.optimizer.wants_exact_step(self.global_steps) \
             else "compressed"
         key = (stage, jax.tree_util.tree_structure(batches),
-               tuple(np.asarray(v).shape for v in batches.values()))
+               tuple(tuple(v.shape) for v in batches.values()))
         if key not in self._onebit_fns:
             self._onebit_fns[key] = self._build_onebit_step(stage, batches)
         self._rng, step_rng = jax.random.split(self._rng)
