@@ -174,6 +174,13 @@ class Autotuner:
         live = [r for r in results if r.status == "estimated"]
         live.sort(key=lambda r: r.est_time)
         for res in live[:measured_topk]:
+            # drop the previous candidates' executables/buffers first — dozens
+            # of live compiled engines on an emulated many-device CPU platform
+            # starve the scheduler (observed as spurious collective aborts)
+            import gc
+
+            gc.collect()
+            jax.clear_caches()
             engine = self._build_engine(res.config)
             tokens = (engine.micro_batch_size * engine.dp_world_size
                       * batch["input_ids"].shape[1]
@@ -181,12 +188,15 @@ class Autotuner:
             sub = {k: v[: engine.micro_batch_size * engine.dp_world_size]
                    for k, v in batch.items()}
             engine.train_batch(batch=sub)  # compile+warm
+            jax.block_until_ready(engine.params)
             t0 = time.perf_counter()
             for _ in range(measure_steps):
                 engine.train_batch(batch=sub)
+            jax.block_until_ready(engine.params)
             dt = (time.perf_counter() - t0) / measure_steps
             res.measured_tokens_per_s = tokens / dt
             res.status = "measured"
+            del engine
 
         measured = [r for r in results if r.status == "measured"]
         best = max(measured, key=lambda r: r.measured_tokens_per_s) \
