@@ -79,7 +79,13 @@ class TransformerConfig:
     remat: bool = False
     remat_policy: str = "nothing_saveable"  # nothing_saveable | dots_with_no_batch_dims
     compute_dtype: typing.Any = jnp.bfloat16
-    attention_impl: str = "xla"  # xla | flash (pallas)
+    attention_impl: str = "xla"  # xla | flash (pallas) | block_sparse (pallas)
+    # block_sparse settings (reference sparse_attention_utils.py integration
+    # role): pattern name + block size + pattern kwargs
+    sparse_pattern: str = "fixed"  # dense|fixed|bigbird|bslongformer|variable
+    sparse_block: int = 128
+    sparse_pattern_config: typing.Any = None  # dict of pattern kwargs
+    attention_interpret: bool = False  # pallas interpret mode (CPU tests)
     # Pipeline parallelism (set by the engine from mesh/config; see parallel/pipeline.py)
     pipeline_stages: int = 1
     pipeline_microbatches: int = 1
@@ -279,11 +285,15 @@ def block_apply(cfg, p, x, mask=None, rope=None, alibi=None, deterministic=True,
                                      causal=cfg.causal, scale=cfg.attn_scale)
             out = checkpoint_name(out, "attn_out")
             return o_proj(out)
-        # flash path: plain causal attention, no padding mask / alibi / dropout
-        flash_ok = (
-            cfg.attention_impl == "flash" and alibi is None and mask is None
-            and (deterministic or cfg.attn_dropout == 0.0)
-        )
+        # pallas paths: plain attention only — padding mask / alibi / dropout
+        # force the dense fallback
+        kernel_ok = (alibi is None and mask is None
+                     and (deterministic or cfg.attn_dropout == 0.0))
+        if cfg.attention_impl == "block_sparse" and kernel_ok:
+            out = _block_sparse_attn(cfg, s)(q, k, v)
+            out = checkpoint_name(out, "attn_out")
+            return o_proj(out)
+        flash_ok = cfg.attention_impl == "flash" and kernel_ok
         if flash_ok:
             from ..ops.flash_attention import flash_attention
 
@@ -375,6 +385,35 @@ def stack_init(rng, cfg):
     return jax.tree_util.tree_map(
         prepend_layers, stacked, is_leaf=lambda x: isinstance(x, Param)
     )
+
+
+_SPARSE_ATTN_CACHE = {}
+
+
+def _block_sparse_attn(cfg, seq):
+    """Config-driven block-sparse attention kernel, cached per shape/pattern
+    (layout preprocessing is host-side numpy; the kernel itself is traced).
+    The reference reaches this through ``SparseAttentionUtils`` model surgery;
+    here it is an ``attention_impl`` choice."""
+    from ..ops import sparse_attention as SA
+    from ..ops.pallas.block_sparse_attention import BlockSparseAttention
+
+    key = (cfg.sparse_pattern, cfg.sparse_block,
+           repr(cfg.sparse_pattern_config), seq, cfg.causal,
+           cfg.attn_scale, cfg.attention_interpret)
+    if key not in _SPARSE_ATTN_CACHE:
+        cls = {
+            "dense": SA.DenseSparsityConfig,
+            "fixed": SA.FixedSparsityConfig,
+            "bigbird": SA.BigBirdSparsityConfig,
+            "bslongformer": SA.BSLongformerSparsityConfig,
+            "variable": SA.VariableSparsityConfig,
+        }[cfg.sparse_pattern]
+        sp = cls(block=cfg.sparse_block, **dict(cfg.sparse_pattern_config or {}))
+        _SPARSE_ATTN_CACHE[key] = BlockSparseAttention(
+            sp, seq, causal=cfg.causal, scale=cfg.attn_scale,
+            interpret=cfg.attention_interpret)
+    return _SPARSE_ATTN_CACHE[key]
 
 
 def local_attention_flags(cfg):
