@@ -168,6 +168,9 @@ class HybridEngineConfig(ConfigModel):
 
     enabled: bool = False
     max_out_tokens: int = 512
+    # rollout prompts pad to a multiple of this so PPO batches with varying
+    # prompt lengths share compiled programs (1 disables)
+    prompt_bucket_size: int = 64
 
 
 class CheckpointConfig(ConfigModel):

@@ -72,48 +72,62 @@ class DeepSpeedHybridEngine(DeepSpeedEngine):
         """
         input_ids = jnp.asarray(input_ids, jnp.int32)
         b, prompt_len = input_ids.shape
-        max_len = prompt_len + max_new_tokens
         model = self.module
         he_cfg = self._config.hybrid_engine
         if max_new_tokens > he_cfg.max_out_tokens:
             raise ConfigError(
                 f"generate: max_new_tokens {max_new_tokens} exceeds "
                 f"hybrid_engine.max_out_tokens {he_cfg.max_out_tokens}")
-        if max_len > model.config.max_seq_len:
+        if prompt_len + max_new_tokens > model.config.max_seq_len:
             raise ConfigError(
-                f"generate: {max_len} exceeds model max_seq_len "
-                f"{model.config.max_seq_len}")
+                f"generate: {prompt_len + max_new_tokens} exceeds model "
+                f"max_seq_len {model.config.max_seq_len}")
         if rng is None:
             self._rng, rng = jax.random.split(self._rng)
         if isinstance(temperature, (int, float)) and temperature == 0.0:
             greedy = True
 
-        key = (b, prompt_len, max_new_tokens, bool(greedy), int(top_k))
+        # prompt-length bucketing, same scheme as the serving engine: rollout
+        # prompts vary per PPO batch, and each distinct length must not
+        # recompile (pad right, thread the true length as a traced scalar)
+        bucket = max(int(he_cfg.prompt_bucket_size), 1)
+        padded_len = min(-(-prompt_len // bucket) * bucket,
+                         model.config.max_seq_len - max_new_tokens)
+        padded_len = max(padded_len, prompt_len)
+        max_len = padded_len + max_new_tokens
+        ids_in = jnp.pad(input_ids, ((0, 0), (0, padded_len - prompt_len))) \
+            if padded_len > prompt_len else input_ids
+        true_len = jnp.asarray(prompt_len, jnp.int32)
+
+        key = (b, padded_len, max_new_tokens, bool(greedy), int(top_k))
         if key not in self._gen_cache:
             from ..models.decoding import decode_tokens, prefill_and_first_token
 
             dtype = self.compute_dtype
 
-            def rollout(params, ids, rng, temperature):
+            def rollout(params, ids, rng, temperature, true_len):
                 cast = jax.tree_util.tree_map(lambda a: a.astype(dtype), params)
                 rng, r0 = jax.random.split(rng)
                 tok, cache = prefill_and_first_token(
                     model, cast, ids, r0, temperature, max_len=max_len,
-                    greedy=greedy, top_k=top_k, dtype=dtype)
-                pieces = [ids, tok[:, None]]
+                    greedy=greedy, top_k=top_k, dtype=dtype, true_len=true_len)
+                toks = None
                 if max_new_tokens > 1:
                     toks = decode_tokens(
                         model, cast, cache, tok, rng, temperature,
-                        prompt_len=prompt_len, max_len=max_len,
+                        prompt_len=true_len, max_len=max_len,
                         steps=max_new_tokens - 1, greedy=greedy, top_k=top_k)
-                    pieces.append(jnp.transpose(toks))
-                return jnp.concatenate(pieces, axis=1)
+                return tok, toks
 
             with self.mesh:
                 self._gen_cache[key] = jax.jit(rollout)
         gen = self._gen_cache[key]
-        return gen(self._gen_params(), input_ids, rng,
-                   jnp.asarray(temperature, jnp.float32))
+        tok, toks = gen(self._gen_params(), ids_in, rng,
+                        jnp.asarray(temperature, jnp.float32), true_len)
+        pieces = [input_ids, tok[:, None]]
+        if toks is not None:
+            pieces.append(jnp.transpose(toks))
+        return jnp.concatenate(pieces, axis=1)
 
     def sequence_logprobs(self, input_ids, prompt_len):
         """Per-token logprobs of the generated suffix under the CURRENT params

@@ -132,3 +132,45 @@ def test_generate_with_lora_differs(devices8):
     eng.set_lora(None)
     again = np.asarray(eng.generate(prompts, max_new_tokens=8, greedy=True))
     np.testing.assert_array_equal(base, again)  # masters untouched
+
+
+def test_hybrid_generate_prompt_bucketing(devices8):
+    """Rollout prompts of different lengths within a bucket share ONE compiled
+    program, and bucketed output equals the unbucketed output."""
+    import dataclasses
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models import CausalLM, TransformerConfig
+
+    def mk(bucket):
+        model = CausalLM(TransformerConfig(
+            vocab_size=64, max_seq_len=64, n_layers=2, n_heads=2, d_model=32,
+            d_ff=64, compute_dtype=jnp.float32))
+        eng, _, _, _ = deepspeed_tpu.initialize(model=model, config={
+            "train_batch_size": 8,
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+            "zero_optimization": {"stage": 0},
+            "mesh": {"data": 8},
+            "hybrid_engine": {"enabled": True, "max_out_tokens": 16,
+                              "prompt_bucket_size": bucket},
+            "steps_per_print": 10 ** 9,
+        })
+        return eng
+
+    e_b = mk(16)
+    e_raw = mk(1)
+    e_raw.params = jax.tree_util.tree_map(
+        lambda v, s: jax.device_put(np.asarray(v), s),
+        e_b.params, jax.tree_util.tree_map(lambda a: a.sharding, e_raw.params))
+
+    r = np.random.RandomState(0)
+    p6 = r.randint(0, 64, (2, 6)).astype(np.int32)
+    p11 = r.randint(0, 64, (2, 11)).astype(np.int32)
+    o6 = e_b.generate(p6, max_new_tokens=4, greedy=True)
+    o11 = e_b.generate(p11, max_new_tokens=4, greedy=True)
+    assert len(e_b._gen_cache) == 1  # lengths 6 and 11 share the 16-bucket
+
+    r6 = e_raw.generate(p6, max_new_tokens=4, greedy=True)
+    r11 = e_raw.generate(p11, max_new_tokens=4, greedy=True)
+    np.testing.assert_array_equal(np.asarray(o6), np.asarray(r6))
+    np.testing.assert_array_equal(np.asarray(o11), np.asarray(r11))
