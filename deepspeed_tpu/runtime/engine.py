@@ -280,6 +280,12 @@ class DeepSpeedEngine:
             f"gas={self.gradient_accumulation_steps_})",
             ranks=[0],
         )
+        if self._config.dump_state:
+            # reference engine.py dump_state: print the resolved config
+            import json as _json
+
+            log_dist("config state:\n" + _json.dumps(
+                self._config.to_dict(), indent=2, default=str), ranks=[0])
 
     # ------------------------------------------------------------------------------
     # init helpers
@@ -686,6 +692,14 @@ class DeepSpeedEngine:
                  ("Train/loss", float(mean_loss), self.global_steps)]
             )
             self._report_progress()
+            if self._config.memory_breakdown:
+                # reference see_memory_usage role, via the accelerator seam
+                from ..accelerator import get_accelerator
+
+                a = get_accelerator()
+                log_dist(
+                    f"memory: {a.memory_allocated() / 2**30:.2f} GiB in use / "
+                    f"{a.total_memory() / 2**30:.2f} GiB", ranks=[0])
         return mean_loss
 
     def _apply_curriculum(self, batch):
