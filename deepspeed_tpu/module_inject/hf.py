@@ -13,8 +13,10 @@ Memory discipline: tensors are read one at a time from safetensors / torch
 pickles, stacked layer-major into the scan layout, and can be placed shard-wise
 (``shardings`` arg) so the full model never needs to exist unsharded on device.
 
-Families covered (reference containers for parity): gpt2, opt, bloom, llama
-(+ mistral via the llama path). Each entry documents its quirks in place.
+Families covered (reference containers for parity and beyond): gpt2, opt,
+bloom, llama (+ mistral/qwen2 via llama-shaped paths), gpt-j, gpt-neo(x),
+falcon, bert, distilbert, clip text. Each entry documents its quirks in
+place.
 """
 
 import json
@@ -120,7 +122,7 @@ class _Reader:
 def detect_family(hf_config):
     mt = hf_config.get("model_type", "")
     if mt in ("gpt2", "opt", "bloom", "llama", "gptj", "gpt_neox", "bert",
-              "distilbert", "gpt_neo"):
+              "distilbert", "gpt_neo", "falcon", "qwen2"):
         return mt
     if mt == "mistral":
         return "llama"
@@ -128,7 +130,7 @@ def detect_family(hf_config):
         return "clip_text"
     raise ValueError(f"Unsupported HF model_type '{mt}' "
                      "(supported: gpt2, opt, bloom, llama, mistral, gptj, "
-                     "gpt_neox, bert, distilbert, gpt_neo)")
+                     "gpt_neox, bert, distilbert, gpt_neo, falcon, qwen2, clip)")
 
 
 def config_from_hf(hf_config, **overrides):
@@ -217,6 +219,39 @@ def config_from_hf(hf_config, **overrides):
             embed_layernorm=True, final_layernorm=False,
             type_vocab_size=g("type_vocab_size", 2),
             layernorm_eps=g("layer_norm_eps", 1e-12),
+        )
+    elif fam == "qwen2":
+        # llama-shaped with attention bias on q/k/v only (o and MLP unbiased)
+        kw = dict(
+            vocab_size=g("vocab_size"), max_seq_len=g("max_position_embeddings", 2048),
+            n_layers=g("num_hidden_layers"), n_heads=g("num_attention_heads"),
+            n_kv_heads=g("num_key_value_heads"), d_model=g("hidden_size"),
+            d_ff=g("intermediate_size"),
+            activation="swiglu", norm="rmsnorm", position_embedding="rope",
+            rope_base=g("rope_theta", 10000.0),
+            tie_embeddings=g("tie_word_embeddings", False),
+            use_bias=True, mlp_bias=False, prenorm=True,
+            layernorm_eps=g("rms_norm_eps", 1e-6),
+        )
+    elif fam == "falcon":
+        # falcon-7b style: parallel attention with ONE shared input layernorm,
+        # multi-query attention, no biases, rope
+        if g("new_decoder_architecture", False):
+            raise ValueError("falcon new_decoder_architecture (40b-style "
+                             "grouped qkv) is not supported")
+        if g("alibi", False):
+            raise ValueError("falcon alibi variant not supported (rope only)")
+        d = g("hidden_size")
+        kw = dict(
+            vocab_size=g("vocab_size"), max_seq_len=2048,
+            n_layers=g("num_hidden_layers"), n_heads=g("num_attention_heads"),
+            n_kv_heads=1 if g("multi_query", True) else g("num_attention_heads"),
+            d_model=d, d_ff=4 * d,
+            activation="gelu_exact", norm="layernorm", position_embedding="rope",
+            rope_base=g("rope_theta", 10000.0),
+            tie_embeddings=True, use_bias=bool(g("bias", False)),
+            prenorm=True, parallel_attn_mlp=bool(g("parallel_attn", True)),
+            layernorm_eps=g("layer_norm_epsilon", 1e-5),
         )
     elif fam == "clip_text":
         # CLIP text encoder (reference container: containers/clip.py): causal
@@ -395,6 +430,59 @@ def _identity_ln(d):
             "bias": np.zeros((d,), np.float32)}
 
 
+def _qwen2_block(r, cfg, i):
+    """llama layout but q/k/v carry biases while o and the MLP do not —
+    use_bias=True means the o slot needs a zero bias."""
+    p = f"model.layers.{i}"
+    o = _linear_t(r, f"{p}.self_attn.o_proj", bias=False)
+    o["bias"] = np.zeros((cfg.d_model,), np.float32)
+    return {
+        "ln_1": _ln(r, f"{p}.input_layernorm", rms=True),
+        "attn": {
+            "q": _linear_t(r, f"{p}.self_attn.q_proj"),
+            "k": _linear_t(r, f"{p}.self_attn.k_proj"),
+            "v": _linear_t(r, f"{p}.self_attn.v_proj"),
+            "o": o,
+        },
+        "ln_2": _ln(r, f"{p}.post_attention_layernorm", rms=True),
+        "mlp": {
+            "gate": _linear_t(r, f"{p}.mlp.gate_proj", bias=False),
+            "up": _linear_t(r, f"{p}.mlp.up_proj", bias=False),
+            "down": _linear_t(r, f"{p}.mlp.down_proj", bias=False),
+        },
+    }
+
+
+def _falcon_block(r, cfg, i):
+    """falcon-7b style: fused query_key_value [(h + 2) * hd, d] splits into
+    q [d] + k [hd] + v [hd] (multi-query), ONE shared input layernorm feeding
+    the parallel attn+mlp (our parallel_norm_split=False reads ln_1 only —
+    ln_2 gets identity weights)."""
+    p = f"transformer.h.{i}"
+    w = np.ascontiguousarray(
+        r.get(f"{p}.self_attention.query_key_value.weight").T)  # [d, (h+2)hd]
+    d = cfg.d_model
+    kv = cfg.kv_heads * cfg.head_dim
+    q_w = cfg.n_heads * cfg.head_dim
+    return {
+        "ln_1": _ln(r, f"{p}.input_layernorm"),
+        "attn": {
+            "q": {"kernel": w[:, :q_w]},
+            "k": {"kernel": w[:, q_w:q_w + kv]},
+            "v": {"kernel": w[:, q_w + kv:]},
+            "o": {"kernel": np.ascontiguousarray(
+                r.get(f"{p}.self_attention.dense.weight").T)},
+        },
+        "ln_2": _identity_ln(d),
+        "mlp": {
+            "fc": {"kernel": np.ascontiguousarray(
+                r.get(f"{p}.mlp.dense_h_to_4h.weight").T)},
+            "proj": {"kernel": np.ascontiguousarray(
+                r.get(f"{p}.mlp.dense_4h_to_h.weight").T)},
+        },
+    }
+
+
 def _gptj_block(r, cfg, i):
     # parallel block with one shared LN: our tree still carries ln_2 (unused in
     # the shared-LN parallel path) — fill it with the identity
@@ -534,6 +622,7 @@ def _distilbert_block(r, cfg, i):
 _BLOCK_FNS = {"gpt2": _gpt2_block, "opt": _opt_block, "bloom": _bloom_block,
               "bert": _bert_block, "distilbert": _distilbert_block,
               "gpt_neo": _neo_block, "clip_text": _clip_text_block,
+              "qwen2": _qwen2_block, "falcon": _falcon_block,
               "llama": _llama_block, "gptj": _gptj_block,
               "gpt_neox": _neox_block}
 
@@ -623,7 +712,10 @@ def _top_level(r, cfg, fam):
             params["mlm_ln"] = {"scale": np.ones(d, np.float32),
                                 "bias": np.zeros(d, np.float32)}
             params["mlm_bias"] = {"bias": np.zeros(v, np.float32)}
-    else:  # llama
+    elif fam == "falcon":
+        params["wte"] = {"weight": r.get("transformer.word_embeddings.weight")}
+        params["ln_f"] = _ln(r, "transformer.ln_f")
+    else:  # llama / qwen2
         params["wte"] = {"weight": r.get("model.embed_tokens.weight")}
         params["ln_f"] = _ln(r, "model.norm", rms=True)
         if not cfg.tie_embeddings:

@@ -281,3 +281,26 @@ def test_spatial_pipeline_end_to_end(tmp_path):
     img = vae.decode(np.asarray(latents - 0.1 * np.asarray(eps)))
     assert img.shape == (1, 16, 16, 3)
     assert np.isfinite(np.asarray(img)).all()
+
+
+def test_qwen2_import_parity(tmp_path):
+    cfg = transformers.Qwen2Config(
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        hidden_size=32, intermediate_size=64, vocab_size=96,
+        max_position_embeddings=64, rope_theta=10000.0,
+        tie_word_embeddings=False)
+    _seed()
+    hf = transformers.Qwen2ForCausalLM(cfg).eval()
+    ids = np.random.RandomState(7).randint(0, 96, (2, 10))
+    _parity(_save(tmp_path, hf), hf, ids)
+
+
+def test_falcon_import_parity(tmp_path):
+    cfg = transformers.FalconConfig(
+        num_hidden_layers=2, num_attention_heads=4, hidden_size=32,
+        vocab_size=96, multi_query=True, new_decoder_architecture=False,
+        parallel_attn=True, bias=False, alibi=False)
+    _seed()
+    hf = transformers.FalconForCausalLM(cfg).eval()
+    ids = np.random.RandomState(8).randint(0, 96, (2, 10))
+    _parity(_save(tmp_path, hf), hf, ids)
