@@ -115,7 +115,13 @@ class InferenceEngine:
                                                     group_size=group_size)
                     out = {k: v for k, v in tree.items() if k != "kernel"}
                     sh = shardings["kernel"]
-                    out["kernel_q"] = jax.device_put(q, sh)
+                    if bits == 4 and q.shape[-2] % 2 == 0:
+                        from ..ops.quantizer import pack_int4
+
+                        # nibble-packed: 4 bits/weight in HBM
+                        out["kernel_q4"] = jax.device_put(pack_int4(q), sh)
+                    else:
+                        out["kernel_q"] = jax.device_put(q, sh)
                     out["kernel_scale"] = scale
                     return out
                 return {k: walk(v, shardings[k], f"{name}/{k}")
@@ -126,8 +132,9 @@ class InferenceEngine:
         self.params = dict(self.params)
         self.params["blocks"] = walk(self.params["blocks"],
                                      self.param_shardings["blocks"])
-        log_dist(f"int8 weight-only quantization applied to block kernels "
-                 f"(bits={bits}, group_size={group_size})", ranks=[0])
+        log_dist(f"int{bits} weight-only quantization applied to block kernels "
+                 f"(group_size={group_size}"
+                 f"{', nibble-packed' if bits == 4 else ''})", ranks=[0])
 
     def load_checkpoint(self, load_dir, tag=None):
         """Load trained weights (npz layout from the training engine); TP

@@ -144,7 +144,14 @@ def linear_apply_rowparallel(p, x, axis):
 
 
 def linear_apply(p, x, compute_dtype=None):
-    if "kernel_q" in p:
+    if "kernel_q4" in p:
+        # int4 weight-only serving: nibble-packed uint8 streams from HBM at
+        # 4 bits/weight; unpack + dequant fuse into the matmul
+        from ..ops.quantizer import dequantize_per_channel, unpack_int4
+
+        kernel = dequantize_per_channel(unpack_int4(p["kernel_q4"]),
+                                        p["kernel_scale"], x.dtype)
+    elif "kernel_q" in p:
         # int8 weight-only serving: dequant fuses into the matmul, the weight
         # streams from HBM at 8 bits (ops/quantizer.py quantize_per_channel)
         from ..ops.quantizer import dequantize_per_channel

@@ -95,3 +95,24 @@ def dequantize_per_channel(q, scale, dtype):
     in_dim, out = q.shape[-2], q.shape[-1]
     qg = q.astype(dtype).reshape(lead + (groups, in_dim // groups, out))
     return (qg * scale.astype(dtype)).reshape(q.shape)
+
+
+def pack_int4(q):
+    """int4 values (int8 storage in [-8, 7], [..., in, out], even in-dim) ->
+    one uint8 per PAIR of in-dim weights ([..., in/2, out]) — the true 4-bit
+    HBM footprint the reference's int4 kernels get (``quantize.cu``)."""
+    if q.shape[-2] % 2:
+        raise ValueError(f"int4 packing needs an even in-dim, got {q.shape}")
+    u = (q.astype(jnp.int16) + 8).astype(jnp.uint8)  # [0, 15]
+    lo = u[..., 0::2, :]
+    hi = u[..., 1::2, :]
+    return ((hi << 4) | lo).astype(jnp.uint8)
+
+
+def unpack_int4(packed):
+    """[..., in/2, out] uint8 -> int4-valued int8 [..., in, out]."""
+    lo = (packed & jnp.uint8(0xF)).astype(jnp.int8) - 8
+    hi = (packed >> 4).astype(jnp.int8) - 8
+    pairs = jnp.stack([lo, hi], axis=-2)  # [..., in/2, 2, out]
+    return pairs.reshape(packed.shape[:-2]
+                         + (packed.shape[-2] * 2, packed.shape[-1]))
