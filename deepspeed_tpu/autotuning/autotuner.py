@@ -171,6 +171,7 @@ class Autotuner:
                 continue
             res.status = "estimated"
 
+        engine = None  # drop the last estimation-phase engine before measuring
         live = [r for r in results if r.status == "estimated"]
         live.sort(key=lambda r: r.est_time)
         for res in live[:measured_topk]:

@@ -105,6 +105,7 @@ class InferenceEngine:
 
         bits = self._config.quant.bits
         group_size = self._config.quant.group_size
+        counts = {"packed": 0, "int8": 0}
 
         def walk(tree, shardings, name=""):
             if isinstance(tree, dict):
@@ -120,8 +121,10 @@ class InferenceEngine:
 
                         # nibble-packed: 4 bits/weight in HBM
                         out["kernel_q4"] = jax.device_put(pack_int4(q), sh)
+                        counts["packed"] += 1
                     else:
                         out["kernel_q"] = jax.device_put(q, sh)
+                        counts["int8"] += 1
                     out["kernel_scale"] = scale
                     return out
                 return {k: walk(v, shardings[k], f"{name}/{k}")
@@ -132,9 +135,14 @@ class InferenceEngine:
         self.params = dict(self.params)
         self.params["blocks"] = walk(self.params["blocks"],
                                      self.param_shardings["blocks"])
-        log_dist(f"int{bits} weight-only quantization applied to block kernels "
-                 f"(group_size={group_size}"
-                 f"{', nibble-packed' if bits == 4 else ''})", ranks=[0])
+        packed_note = f", {counts['packed']} nibble-packed" \
+            if counts["packed"] else ""
+        fallback_note = f", {counts['int8']} int8-stored" \
+            if bits == 4 and counts["int8"] else ""
+        log_dist(f"int{bits} weight-only quantization applied to "
+                 f"{sum(counts.values())} block kernels "
+                 f"(group_size={group_size}{packed_note}{fallback_note})",
+                 ranks=[0])
 
     def load_checkpoint(self, load_dir, tag=None):
         """Load trained weights (npz layout from the training engine); TP

@@ -241,16 +241,26 @@ def config_from_hf(hf_config, **overrides):
                              "grouped qkv) is not supported")
         if g("alibi", False):
             raise ValueError("falcon alibi variant not supported (rope only)")
+        if not g("multi_query", True):
+            # HF's non-multi-query fused qkv interleaves q/k/v PER HEAD — a
+            # contiguous split would silently scramble the projections
+            raise ValueError("falcon multi_query=False layout not supported")
+        if not g("parallel_attn", True):
+            # sequential blocks read post_attention_layernorm, which the
+            # parallel-attn mapping replaces with identity weights
+            raise ValueError("falcon parallel_attn=False not supported")
+        if g("bias", False):
+            raise ValueError("falcon bias=True checkpoints not supported "
+                             "(the mapping carries no bias tensors)")
         d = g("hidden_size")
         kw = dict(
             vocab_size=g("vocab_size"), max_seq_len=2048,
             n_layers=g("num_hidden_layers"), n_heads=g("num_attention_heads"),
-            n_kv_heads=1 if g("multi_query", True) else g("num_attention_heads"),
-            d_model=d, d_ff=4 * d,
+            n_kv_heads=1, d_model=d, d_ff=4 * d,
             activation="gelu_exact", norm="layernorm", position_embedding="rope",
             rope_base=g("rope_theta", 10000.0),
-            tie_embeddings=True, use_bias=bool(g("bias", False)),
-            prenorm=True, parallel_attn_mlp=bool(g("parallel_attn", True)),
+            tie_embeddings=True, use_bias=False,
+            prenorm=True, parallel_attn_mlp=True,
             layernorm_eps=g("layer_norm_epsilon", 1e-5),
         )
     elif fam == "clip_text":
