@@ -35,6 +35,10 @@ class DeepSpeedInferenceConfig(ConfigModel):
     # program per LENGTH BUCKET instead of one per distinct prompt length
     # (recompile-free TTFT for varying prompts). 1 disables bucketing.
     prompt_bucket_size: int = 64
+    # generate() pads the BATCH dim up to a multiple of this (padded rows are
+    # dropped from the output). 1 disables; opt in when request batch sizes
+    # vary — row padding costs compute but saves the recompile.
+    batch_bucket_size: int = 1
     quant: QuantizationConfig = None
     replace_with_kernel_inject: bool = False  # accepted for config compat; no-op
     seed: int = 0

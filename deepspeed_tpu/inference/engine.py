@@ -178,13 +178,26 @@ class InferenceEngine:
     # forward / generate (reference engine.forward :560, patched _generate :588)
     # ------------------------------------------------------------------------------
     def forward(self, input_ids):
-        """Full-sequence logits (no cache) — scoring/perplexity path."""
+        """Full-sequence logits (no cache) — scoring/perplexity path.
+
+        Causal models bucket the sequence dim (right padding cannot reach
+        earlier positions under a causal mask), so varying scoring lengths
+        share compiled programs; the pad columns are sliced off."""
         input_ids = jnp.asarray(input_ids)
+        b, s = input_ids.shape
+        bucket = max(int(self._config.prompt_bucket_size), 1)
+        causal = getattr(self.module.config, "causal", True)
+        padded = s
+        if causal and s % bucket:
+            padded = min(-(-s // bucket) * bucket, self._config.max_tokens)
+            padded = max(padded, s)
+            input_ids = jnp.pad(input_ids, ((0, 0), (0, padded - s)))
         if self._prefill_fn is None:
             with self.mesh:
                 self._prefill_fn = jax.jit(
                     lambda p, ids: self.module.apply(p, ids))
-        return self._prefill_fn(self.params, input_ids)
+        logits = self._prefill_fn(self.params, input_ids)
+        return logits[:, :s] if padded > s else logits
 
     def __call__(self, input_ids):
         return self.forward(input_ids)
@@ -221,6 +234,19 @@ class InferenceEngine:
         # IS greedy (and must stay exact argmax, not logits/1e-6 + noise).
         if isinstance(temperature, (int, float)) and temperature == 0.0:
             greedy = True
+
+        # Batch-size BUCKETING (opt-in): pad the row dim to the next bucket by
+        # repeating row 0 (garbage rows decode too; their outputs are dropped)
+        # so varying request batch sizes share compiled programs.
+        b_real = b
+        b_bucket = max(int(self._config.batch_bucket_size), 1)
+        if b % b_bucket:
+            padded_b = -(-b // b_bucket) * b_bucket
+            input_ids = jnp.concatenate(
+                [input_ids,
+                 jnp.broadcast_to(input_ids[:1],
+                                  (padded_b - b,) + input_ids.shape[1:])])
+            b = padded_b
 
         # Prompt-length BUCKETING: right-pad the prompt to the next bucket and
         # pass the true length as a traced scalar, so a TTFT-critical serving
@@ -271,6 +297,8 @@ class InferenceEngine:
             toks = decode_fn(self.params, cache, first, r2, temp, true_len)  # [steps, b]
             out.append(jnp.transpose(toks))
         result = jnp.concatenate(out, axis=1)
+        if b_real < b:
+            result = result[:b_real]
         if eos_token_id is not None:
             result = _truncate_after_eos(np.asarray(result), prompt_len, eos_token_id)
         return result

@@ -340,3 +340,42 @@ def test_int4_pack_roundtrip_and_serving():
     ids = np.random.RandomState(1).randint(0, 128, (2, 8)).astype(np.int32)
     out = eng.generate(ids, max_new_tokens=4, greedy=True)
     assert out.shape == (2, 12)
+
+
+def test_batch_bucketing_and_scorer_bucketing():
+    """Opt-in batch-row bucketing: 3 rows pad to the 4-bucket, share one
+    program with a 4-row call, and outputs equal the unbucketed engine's.
+    The scorer pads the seq dim (causal: pad columns can't leak) and
+    returns exact logits."""
+    import deepspeed_tpu
+    from deepspeed_tpu.models import get_model
+    import jax.numpy as jnp
+
+    kw = dict(vocab_size=128, max_seq_len=64, compute_dtype=jnp.float32)
+    model = get_model("gpt2", "tiny", **kw)
+    eng = deepspeed_tpu.init_inference(model, dtype="float32", max_tokens=64,
+                                       prompt_bucket_size=16,
+                                       batch_bucket_size=4)
+    raw = deepspeed_tpu.init_inference(model, dtype="float32", max_tokens=64,
+                                       prompt_bucket_size=1,
+                                       batch_bucket_size=1)
+    raw.params = eng.params
+
+    r = np.random.RandomState(9)
+    p3 = r.randint(0, 128, (3, 6)).astype(np.int32)
+    p4 = r.randint(0, 128, (4, 6)).astype(np.int32)
+    o3 = eng.generate(p3, max_new_tokens=4, greedy=True)
+    o4 = eng.generate(p4, max_new_tokens=4, greedy=True)
+    assert o3.shape == (3, 10) and o4.shape == (4, 10)
+    assert len(eng._prefill_cache) == 1  # rows 3 and 4 share the 4-bucket
+
+    np.testing.assert_array_equal(
+        np.asarray(o3), np.asarray(raw.generate(p3, max_new_tokens=4,
+                                                greedy=True)))
+
+    # scorer: seq 10 pads to 16, logits exact vs unbucketed
+    ids = r.randint(0, 128, (2, 10)).astype(np.int32)
+    la = np.asarray(eng.forward(ids))
+    lb = np.asarray(raw.forward(ids))
+    assert la.shape == lb.shape == (2, 10, 128)
+    np.testing.assert_allclose(la, lb, rtol=2e-5, atol=2e-6)
