@@ -116,3 +116,38 @@ def test_bert_engine_trains(devices8):
             "input_ids": masked, "labels": labels,
             "token_type_ids": np.zeros_like(masked)})))
     assert losses[-1] < losses[0]
+
+
+def test_bert_fill_mask_serving():
+    """init_inference serves an encoder: forward() returns MLM logits and the
+    masked-position argmax recovers a learnable pattern after brief training."""
+    import deepspeed_tpu
+
+    cfg = _tiny()
+    MASK = 127
+
+    # teach a trivial rule: every masked position's answer is token 7
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=MaskedLM(cfg),
+        config={"train_batch_size": 8,
+                "optimizer": {"type": "adamw", "params": {"lr": 5e-3}},
+                "zero_optimization": {"stage": 0}, "mesh": {"data": 8},
+                "steps_per_print": 10 ** 9})
+    rng = np.random.RandomState(0)
+    for _ in range(20):
+        ids = rng.randint(0, 64, (8, 16)).astype(np.int32)
+        labels = np.full_like(ids, -100)
+        pos = rng.randint(0, 16, (8, 2))
+        for r in range(8):
+            ids[r, pos[r]] = MASK
+            labels[r, pos[r]] = 7
+        engine.train_batch(batch={"input_ids": ids, "labels": labels})
+
+    inf = deepspeed_tpu.init_inference(MaskedLM(cfg), dtype="float32",
+                                       max_tokens=32)
+    inf.params = engine.params
+    ids = rng.randint(0, 64, (2, 16)).astype(np.int32)
+    ids[:, 5] = MASK
+    logits = np.asarray(inf.forward(ids))
+    assert logits.shape == (2, 16, 128)
+    assert (logits[:, 5].argmax(-1) == 7).all()
