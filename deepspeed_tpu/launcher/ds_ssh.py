@@ -1,0 +1,56 @@
+"""``ds_tpu_ssh`` — run a command on every hostfile host (reference
+``bin/ds_ssh``): the pod-wide shell helper for checking env, clearing caches,
+or pulling logs.
+
+    ds_tpu_ssh -H hostfile "python -c 'import jax; print(jax.devices())'"
+    ds_tpu_ssh -H hostfile --include worker-[0-1] -- nvidia-smi-equivalent
+"""
+
+import argparse
+import subprocess
+import sys
+
+from .runner import fetch_hostfile, parse_inclusion_exclusion
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("-H", "--hostfile", required=True)
+    p.add_argument("--include", type=str, default="",
+                   help="host filter (reference --include syntax)")
+    p.add_argument("--exclude", type=str, default="")
+    p.add_argument("--ssh_port", type=int, default=22)
+    p.add_argument("--sequential", action="store_true",
+                   help="one host at a time instead of concurrently")
+    p.add_argument("command", nargs=argparse.REMAINDER,
+                   help="command to run on every host")
+    args = p.parse_args(argv)
+    cmd = " ".join(c for c in args.command if c != "--").strip()
+    if not cmd:
+        p.error("no command given")
+
+    pool = fetch_hostfile(args.hostfile)
+    if not pool:
+        print(f"error: no hosts in {args.hostfile}", file=sys.stderr)
+        return 1
+    hosts = list(parse_inclusion_exclusion(pool, args.include, args.exclude))
+    procs = []
+    rc = 0
+    for host in hosts:
+        ssh_cmd = ["ssh", "-o", "StrictHostKeyChecking=no",
+                   "-p", str(args.ssh_port), host, cmd]
+        if args.sequential:
+            r = subprocess.run(ssh_cmd)
+            print(f"[{host}] exit {r.returncode}")
+            rc = rc or r.returncode
+        else:
+            procs.append((host, subprocess.Popen(ssh_cmd)))
+    for host, proc in procs:
+        proc.wait()
+        print(f"[{host}] exit {proc.returncode}")
+        rc = rc or proc.returncode
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

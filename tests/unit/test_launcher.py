@@ -121,3 +121,26 @@ def test_launcher_kills_peers_when_one_worker_dies(tmp_path):
         env=dict(_os.environ, PYTHONPATH=repo), cwd=repo, timeout=120)
     assert rc == 3
     assert time.time() - t0 < 60  # did not wait for the sleeping peer
+
+
+def test_ds_ssh_builds_per_host_commands(tmp_path, monkeypatch):
+    """ds_tpu_ssh (reference bin/ds_ssh): one ssh per (filtered) host."""
+    from deepspeed_tpu.launcher import ds_ssh
+
+    hf = tmp_path / "hosts"
+    hf.write_text("w0 slots=4\nw1 slots=4\nw2 slots=4\n")
+    calls = []
+
+    class FakeProc:
+        returncode = 0
+
+        def wait(self):
+            return 0
+
+    monkeypatch.setattr(ds_ssh.subprocess, "Popen",
+                        lambda cmd: calls.append(cmd) or FakeProc())
+    rc = ds_ssh.main(["-H", str(hf), "--exclude", "w1", "--", "echo", "hi"])
+    assert rc == 0
+    assert len(calls) == 2
+    assert calls[0][-2:] == ["w0", "echo hi"]
+    assert calls[1][-2:] == ["w2", "echo hi"]
