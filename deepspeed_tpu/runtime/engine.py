@@ -272,6 +272,7 @@ class DeepSpeedEngine:
         self._apply_fn = None
         self._train_step_fn = None
         self._eval_fn = None
+        self._train_mode = True
 
         log_dist(
             f"DeepSpeedEngine: mesh={dict(self.mesh.shape)} zero_stage={self.zero_stage} "
@@ -498,7 +499,9 @@ class DeepSpeedEngine:
 
         def fwd_bwd(params, batch, scale, rng):
             def scaled_loss(p):
-                loss = self.module.loss(p, batch, deterministic=False, dropout_rng=rng)
+                loss = self.module.loss(p, batch,
+                                        deterministic=not self._train_mode,
+                                        dropout_rng=rng)
                 # reference scales by 1/gas at backward (engine.py:1793) and by the
                 # fp16 loss scale inside the scaler
                 return loss * scale.astype(loss.dtype) / gas, loss
@@ -594,7 +597,8 @@ class DeepSpeedEngine:
 
             def scaled_loss(p, batch, r):
                 loss = self.module.loss(
-                    p, batch, deterministic=False, dropout_rng=r,
+                    p, batch, deterministic=not self._train_mode,
+                    dropout_rng=r,
                     **({"pld_theta": pld_theta} if pld_enabled else {}))
                 return loss * scale.astype(loss.dtype) / gas, loss
 
@@ -740,7 +744,8 @@ class DeepSpeedEngine:
 
         def local_grads(params, batches, rng):
             def gfn(p, micro, r):
-                loss = self.module.loss(p, micro, deterministic=False,
+                loss = self.module.loss(p, micro,
+                                        deterministic=not self._train_mode,
                                         dropout_rng=r)
                 return loss
 
@@ -1072,6 +1077,37 @@ class DeepSpeedEngine:
 
     def get_lr(self):
         return [self._current_lr()]
+
+    def set_lr(self, lr):
+        """Override the learning rate (reference engine ``set_lr``): updates
+        the scheduler's base lr when one is attached, else the optimizer's.
+        Takes effect next step — lr is a traced runtime argument, so no
+        recompile."""
+        if self.lr_scheduler is not None and hasattr(self.lr_scheduler, "set_lr"):
+            self.lr_scheduler.set_lr(lr)
+        elif self.lr_scheduler is not None:
+            raise ValueError(
+                f"{type(self.lr_scheduler).__name__} does not support set_lr; "
+                "drive the schedule through its own params")
+        else:
+            self.optimizer.lr = lr
+
+    def train(self, mode=True):
+        """torch-style mode flag (reference engine.train/eval): eval mode makes
+        ``forward``/``train_batch`` run deterministically (no dropout/PLD).
+        Flipping the mode rebuilds the compiled step programs (the flag is
+        baked into the trace)."""
+        mode = bool(mode)
+        if mode != self._train_mode:
+            self._train_mode = mode
+            self._fwd_bwd_fn = None
+            self._train_step_fn = None
+            if getattr(self, "_onebit_active", False):
+                self._onebit_fns = {}
+        return self
+
+    def eval(self):
+        return self.train(False)
 
     def _report_progress(self):
         """Reference ``engine.py:2167`` _report_progress."""

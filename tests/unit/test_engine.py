@@ -205,3 +205,38 @@ def test_tp_mesh_training(devices8):
     mlp = engine.params["blocks"]["mlp"]["fc"]["kernel"]
     assert not mlp.sharding.is_fully_replicated
     assert all(np.isfinite(losses))
+
+
+def test_train_eval_mode_and_set_lr():
+    """torch-style engine.train()/eval() + set_lr (reference engine surface).
+
+    With dropout on, eval mode must be deterministic while train mode varies
+    across steps; set_lr changes the applied lr without recompiling."""
+    model = tiny_lm()
+    model.config.dropout = 0.2
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=base_config())
+    rng = np.random.RandomState(0)
+    batch = {"input_ids": rng.randint(0, 128, (8, 16)).astype(np.int32)}
+
+    engine.eval()
+    l1 = float(engine.forward(batch))
+    engine._cached = None  # discard (no backward)
+    l2 = float(engine.forward(batch))
+    engine._cached = None
+    assert l1 == l2  # deterministic in eval mode
+
+    engine.train()
+    l3 = float(engine.forward(batch))
+    engine.backward(l3)
+    engine.step()
+    assert np.isfinite(l3)
+
+    engine.set_lr(1e-6)
+    assert engine.get_lr() == [1e-6]
+    before = np.asarray(jax.tree_util.tree_leaves(engine.params)[1]).copy()
+    loss = engine.forward(batch)
+    engine.backward(loss)
+    engine.step()
+    after = np.asarray(jax.tree_util.tree_leaves(engine.params)[1])
+    # a 1e-6 lr barely moves the weights
+    assert np.abs(after - before).max() < 1e-4
