@@ -1145,6 +1145,25 @@ class DeepSpeedEngine:
             return 0.0
         return float(global_grad_norm(self._acc_grads))
 
+    def consolidated_16bit_state_dict(self):
+        """Live consolidated weights in the compute dtype (reference
+        ``_zero3_consolidated_16bit_state_dict``, ``engine.py:3127``): gathers
+        every (possibly ZeRO-3/TP-sharded) param to host as one numpy tree.
+        Rank 0 returns the dict; other processes return None. Small/medium
+        models only — a 13B tree will not fit one host; use the sharded
+        checkpoint + ``consolidate`` offline tool instead."""
+        params = self._offloaded.masters if self._offloaded is not None \
+            else self.params
+        if dist.get_rank() != 0 and jax.process_count() > 1:
+            # participate in any cross-host gathers, drop the result
+            jax.tree_util.tree_map(lambda a: np.asarray(jax.device_get(a)),
+                                   params)
+            return None
+        cast = np.dtype(jnp.dtype(self.compute_dtype).name) \
+            if self.compute_dtype != jnp.float32 else np.float32
+        return jax.tree_util.tree_map(
+            lambda a: np.asarray(jax.device_get(a)).astype(cast), params)
+
     # ------------------------------------------------------------------------------
     # checkpointing (reference engine.py:2493 load / :2798 save)
     # ------------------------------------------------------------------------------

@@ -240,3 +240,20 @@ def test_train_eval_mode_and_set_lr():
     after = np.asarray(jax.tree_util.tree_leaves(engine.params)[1])
     # a 1e-6 lr barely moves the weights
     assert np.abs(after - before).max() < 1e-4
+
+
+def test_consolidated_16bit_state_dict(devices8):
+    """Live consolidation (reference _zero3_consolidated_16bit_state_dict):
+    ZeRO-3-sharded params come back as one host numpy tree in compute dtype,
+    equal to the device values."""
+    cfg = base_config()
+    cfg["zero_optimization"] = {"stage": 3, "param_persistence_threshold": 16}
+    engine, _, _, _ = deepspeed_tpu.initialize(model=tiny_lm(), config=cfg)
+    sd = engine.consolidated_16bit_state_dict()
+    leaves_host = jax.tree_util.tree_leaves(sd)
+    leaves_dev = jax.tree_util.tree_leaves(engine.params)
+    assert len(leaves_host) == len(leaves_dev)
+    for h, d in zip(leaves_host, leaves_dev):
+        assert isinstance(h, np.ndarray) and h.shape == d.shape
+        np.testing.assert_allclose(
+            h.astype(np.float32), np.asarray(d, np.float32), rtol=1e-3)
