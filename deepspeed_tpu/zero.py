@@ -1,0 +1,72 @@
+"""``deepspeed_tpu.zero`` — user-facing ZeRO helpers (reference
+``deepspeed.zero`` surface: ``Init`` context, ``GatheredParameters``).
+
+The heavy machinery behind the reference names does not exist here because the
+sharding design makes it unnecessary — these are the thin, real equivalents:
+
+- ``zero.Init``: in the reference, a monkey-patching context that partitions
+  params at module construction (``partition_parameters.py:601``). Here params
+  are BORN sharded — ``initialize()`` traces ``model.init`` and materializes
+  straight into the ZeRO layout — so ``Init`` is a no-op context kept for
+  migration compatibility (wrapping model construction in it is harmless).
+- ``zero.GatheredParameters``: host access to (possibly ZeRO-3/TP-sharded)
+  params (reference ``partition_parameters.py:1500``). Enter gathers to a
+  mutable numpy tree; with ``write_back=True``, exit re-places the (edited)
+  tree into the original device shardings.
+"""
+
+import numpy as np
+
+import jax
+
+
+class Init:
+    """No-op migration shim: params are born sharded (see module docstring)."""
+
+    def __init__(self, *args, **kwargs):
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+class GatheredParameters:
+    """Gather engine params (or any jax-array pytree) to host for inspection
+    or surgery.
+
+    with zero.GatheredParameters(engine, write_back=True) as host:
+        host["wte"]["weight"][0] = 0.0   # numpy, mutable
+    # exit: edits are device_put back into the original shardings
+    """
+
+    def __init__(self, params_or_engine, write_back=False):
+        self._engine = None
+        if hasattr(params_or_engine, "params"):
+            self._engine = params_or_engine
+            self._params = params_or_engine.params
+        else:
+            self._params = params_or_engine
+        self.write_back = write_back
+        self._host = None
+
+    def __enter__(self):
+        self._host = jax.tree_util.tree_map(
+            lambda a: np.array(jax.device_get(a)), self._params)
+        return self._host
+
+    def __exit__(self, exc_type, exc, tb):
+        if exc_type is None and self.write_back:
+            placed = jax.tree_util.tree_map(
+                lambda h, a: jax.device_put(
+                    np.asarray(h, dtype=a.dtype), a.sharding),
+                self._host, self._params)
+            if self._engine is not None:
+                self._engine.params = placed
+            else:
+                # caller holds the tree; mutate leaves in place is impossible
+                # for jax arrays, so expose the result for pickup
+                self.result = placed
+        return False
