@@ -1145,6 +1145,11 @@ class DeepSpeedEngine:
             return 0.0
         return float(global_grad_norm(self._acc_grads))
 
+    def module_state_dict(self):
+        """Reference ``engine.module_state_dict``: the module's weights as a
+        host tree (consolidated across shards)."""
+        return self.consolidated_16bit_state_dict()
+
     def consolidated_16bit_state_dict(self):
         """Live consolidated weights in the compute dtype (reference
         ``_zero3_consolidated_16bit_state_dict``, ``engine.py:3127``): gathers
