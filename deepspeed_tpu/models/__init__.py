@@ -2,7 +2,9 @@ from .layers import Param, split_params_axes
 from .transformer import (CausalLM, MaskedLM, TextEncoder,
                           TransformerConfig, cross_entropy_loss)
 from .registry import (get_model, MODEL_CONFIGS, gpt2_config, opt_config,
-                       bloom_config, llama_config, bert_config)
+                       bloom_config, llama_config, bert_config,
+                       mistral_config, gptj_config, neox_config,
+                       falcon_config, gpt_neo_config)
 from .simple import SimpleModel, random_batch
 from .spatial import (DSUNet, DSVAE, SpatialConfig, SpatialUNet,
                       SpatialVAEDecoder)

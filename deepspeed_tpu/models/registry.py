@@ -81,6 +81,102 @@ def llama_config(size="7b", **overrides):
     return TransformerConfig(**base)
 
 
+def mistral_config(size="7b", **overrides):
+    """LLaMA-shaped with GQA + 32k rope base (Mistral paper)."""
+    presets = {
+        "tiny": dict(n_layers=2, d_model=128, n_heads=4, n_kv_heads=2,
+                     d_ff=352, max_seq_len=256, vocab_size=1024),
+        "7b": dict(n_layers=32, d_model=4096, n_heads=32, n_kv_heads=8,
+                   d_ff=14336, max_seq_len=32768),
+    }
+    base = dict(
+        vocab_size=32000, activation="swiglu", norm="rmsnorm",
+        position_embedding="rope", rope_base=10000.0, tie_embeddings=False,
+        use_bias=False, prenorm=True, layernorm_eps=1e-5,
+    )
+    base.update(presets[size])
+    base.update(overrides)
+    return TransformerConfig(**base)
+
+
+def gptj_config(size="6b", **overrides):
+    """Parallel attn+mlp, shared LN, partial rotary, biased untied head."""
+    presets = {
+        "tiny": dict(n_layers=2, d_model=128, n_heads=4, d_ff=512,
+                     max_seq_len=256, vocab_size=1024, rotary_dim=16),
+        "6b": dict(n_layers=28, d_model=4096, n_heads=16, d_ff=16384,
+                   rotary_dim=64),
+    }
+    base = dict(
+        vocab_size=50400, max_seq_len=2048, activation="gelu_new",
+        norm="layernorm", position_embedding="rope", rotary_interleaved=True,
+        tie_embeddings=False, head_bias=True, use_bias=False, mlp_bias=True,
+        prenorm=True, parallel_attn_mlp=True,
+    )
+    base.update(presets[size])
+    base.update(overrides)
+    return TransformerConfig(**base)
+
+
+def neox_config(size="20b", **overrides):
+    """GPT-NeoX: parallel residual with separate norms, partial rotary."""
+    presets = {
+        "tiny": dict(n_layers=2, d_model=128, n_heads=4, d_ff=512,
+                     max_seq_len=256, vocab_size=1024, rotary_dim=8),
+        "20b": dict(n_layers=44, d_model=6144, n_heads=64, d_ff=24576,
+                    rotary_dim=24),
+    }
+    base = dict(
+        vocab_size=50432, max_seq_len=2048, activation="gelu_exact",
+        norm="layernorm", position_embedding="rope", tie_embeddings=False,
+        use_bias=True, prenorm=True, parallel_attn_mlp=True,
+        parallel_norm_split=True,
+    )
+    base.update(presets[size])
+    base.update(overrides)
+    return TransformerConfig(**base)
+
+
+def falcon_config(size="7b", **overrides):
+    """Falcon-7b geometry: parallel attn, one shared LN, multi-query, rope."""
+    presets = {
+        "tiny": dict(n_layers=2, d_model=128, n_heads=4, d_ff=512,
+                     max_seq_len=256, vocab_size=1024),
+        "7b": dict(n_layers=32, d_model=4544, n_heads=71, d_ff=18176),
+    }
+    base = dict(
+        vocab_size=65024, max_seq_len=2048, activation="gelu_exact",
+        norm="layernorm", position_embedding="rope", n_kv_heads=1,
+        tie_embeddings=True, use_bias=False, prenorm=True,
+        parallel_attn_mlp=True,
+    )
+    base.update(presets[size])
+    base.update(overrides)
+    return TransformerConfig(**base)
+
+
+def gpt_neo_config(size="1.3b", **overrides):
+    """GPT-Neo: GPT-2-shaped with alternating banded local attention and
+    UNSCALED attention logits."""
+    presets = {
+        "tiny": dict(n_layers=2, d_model=128, n_heads=4, d_ff=512,
+                     max_seq_len=256, vocab_size=1024,
+                     local_attention_window=64),
+        "1.3b": dict(n_layers=24, d_model=2048, n_heads=16, d_ff=8192),
+        "2.7b": dict(n_layers=32, d_model=2560, n_heads=20, d_ff=10240),
+    }
+    base = dict(
+        vocab_size=50257, max_seq_len=2048, activation="gelu_new",
+        norm="layernorm", position_embedding="learned", tie_embeddings=True,
+        use_bias=True, mlp_bias=True, prenorm=True,
+        local_attention_window=256, attention_layers=("global", "local"),
+        attn_scale=1.0,
+    )
+    base.update(presets[size])
+    base.update(overrides)
+    return TransformerConfig(**base)
+
+
 def bert_config(size="base", **overrides):
     """Encoder presets (BERT paper table 1 geometry): post-norm, bidirectional,
     learned positions + segment embeddings, gelu, embed LN."""
@@ -106,6 +202,11 @@ MODEL_CONFIGS = {
     "opt": opt_config,
     "bloom": bloom_config,
     "llama": llama_config,
+    "mistral": mistral_config,
+    "gptj": gptj_config,
+    "gpt_neox": neox_config,
+    "gpt_neo": gpt_neo_config,
+    "falcon": falcon_config,
     "bert": bert_config,
 }
 

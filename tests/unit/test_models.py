@@ -144,3 +144,31 @@ def test_num_params_analytic_close():
     actual = sum(int(np.prod(v.shape)) for v in jax.tree_util.tree_leaves(values))
     est = cfg.num_params()
     assert abs(actual - est) / actual < 0.1
+
+
+def test_registry_new_family_presets_forward():
+    """Every registry family builds at tiny size and runs a forward pass with
+    its architectural quirks active."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from deepspeed_tpu.models import get_model
+    from deepspeed_tpu.models.layers import split_params_axes
+
+    rng = np.random.RandomState(0)
+    for fam, check in [
+        ("mistral", lambda c: c.n_kv_heads == 2 and c.activation == "swiglu"),
+        ("gptj", lambda c: c.parallel_attn_mlp and c.head_bias),
+        ("gpt_neox", lambda c: c.parallel_norm_split),
+        ("falcon", lambda c: c.n_kv_heads == 1 and c.parallel_attn_mlp),
+        ("gpt_neo", lambda c: c.local_attention_window == 64
+         and c.attn_scale == 1.0),
+    ]:
+        m = get_model(fam, "tiny", compute_dtype=jnp.float32)
+        assert check(m.config), fam
+        values, _ = split_params_axes(m.init(jax.random.PRNGKey(0)))
+        ids = jnp.asarray(rng.randint(0, 1024, (2, 16)), jnp.int32)
+        logits = m.apply(values, ids)
+        assert logits.shape == (2, 16, m.config.vocab_size), fam
+        assert np.isfinite(np.asarray(logits, np.float32)).all(), fam
