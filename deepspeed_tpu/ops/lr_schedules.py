@@ -37,6 +37,15 @@ class LRSchedule:
     def load_state_dict(self, sd):
         self.last_step = sd["last_step"]
 
+    def set_lr(self, lr):
+        """Override the schedule's peak/base lr (engine.set_lr plumbing);
+        subclasses whose shape has no single base lr override or refuse."""
+        for attr in ("warmup_max_lr", "max_lr", "min_lr"):
+            if hasattr(self, attr):
+                setattr(self, attr, lr)
+                return
+        raise ValueError(f"{type(self).__name__} has no overridable base lr")
+
 
 class WarmupLR(LRSchedule):
     """Linear/log warmup then constant (reference ``lr_schedules.py`` WarmupLR)."""

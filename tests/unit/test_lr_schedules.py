@@ -68,3 +68,14 @@ def test_registry_and_step_api():
     assert s2.last_step == 12
     with pytest.raises(ValueError):
         get_lr_schedule("bogus")
+
+
+def test_set_lr_override():
+    from deepspeed_tpu.ops.lr_schedules import WarmupLR
+
+    s = WarmupLR(warmup_min_lr=0.0, warmup_max_lr=1e-3, warmup_num_steps=4)
+    for _ in range(10):
+        s.step()
+    assert abs(s.get_last_lr()[0] - 1e-3) < 1e-9
+    s.set_lr(5e-4)
+    assert abs(s.get_last_lr()[0] - 5e-4) < 1e-9
