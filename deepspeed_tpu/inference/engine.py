@@ -262,9 +262,11 @@ class InferenceEngine:
             ids_in = input_ids
         true_len = jnp.asarray(prompt_len, jnp.int32)
 
-        key = (b, padded_len, max_new_tokens, bool(greedy), int(top_k))
+        key = (b, padded_len, max_new_tokens, bool(greedy), int(top_k),
+               eos_token_id)
         if key not in self._prefill_cache:
-            from ..models.decoding import decode_tokens, prefill_and_first_token
+            from ..models.decoding import (decode_tokens, decode_tokens_until,
+                                           prefill_and_first_token)
 
             model = self.module
 
@@ -275,6 +277,13 @@ class InferenceEngine:
                     true_len=true_len)
 
             def decode(params, cache, tok, rng, temperature, true_len):
+                if eos_token_id is not None:
+                    # early exit inside the compiled loop once every row hit eos
+                    return decode_tokens_until(
+                        model, params, cache, tok, rng, temperature,
+                        prompt_len=true_len, max_len=max_len,
+                        steps=max_new_tokens - 1, greedy=greedy, top_k=top_k,
+                        eos_token_id=int(eos_token_id))
                 return decode_tokens(
                     model, params, cache, tok, rng, temperature,
                     prompt_len=true_len, max_len=max_len,

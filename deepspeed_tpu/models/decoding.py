@@ -251,3 +251,36 @@ def decode_tokens(model, params, cache, tok, rng, temperature, *, prompt_len,
     (cache, _, _), toks = jax.lax.scan(step, (cache, tok, rng),
                                        jnp.arange(steps))
     return toks
+
+
+def decode_tokens_until(model, params, cache, tok, rng, temperature, *,
+                        prompt_len, max_len, steps, greedy, top_k,
+                        eos_token_id):
+    """Early-stopping decode: a ``while_loop`` that exits as soon as EVERY row
+    has emitted ``eos_token_id`` (the reference's generate-stops-at-eos
+    behavior, but inside the compiled program — short answers don't pay for
+    ``max_new_tokens`` iterations). Rows that finished keep emitting eos.
+    Returns [steps, b] (positions past a row's eos filled with eos)."""
+    b = tok.shape[0]
+    out0 = jnp.full((steps, b), eos_token_id, jnp.int32)
+    done0 = tok == eos_token_id
+
+    def cond(carry):
+        i, done, *_ = carry
+        return jnp.logical_and(i < steps, jnp.logical_not(jnp.all(done)))
+
+    def body(carry):
+        i, done, cache, tok, rng, out = carry
+        rng, r = jax.random.split(rng)
+        logits, cache = forward_with_cache(
+            model, params, tok[:, None], cache, prompt_len + i, max_len)
+        nxt = sample_token(logits[:, 0], r, temperature=temperature,
+                           top_k=top_k, greedy=greedy)
+        nxt = jnp.where(done, jnp.asarray(eos_token_id, jnp.int32), nxt)
+        out = out.at[i].set(nxt)
+        done = jnp.logical_or(done, nxt == eos_token_id)
+        return (i + 1, done, cache, nxt, rng, out)
+
+    (_, _, cache, _, _, out) = jax.lax.while_loop(
+        cond, body, (jnp.zeros((), jnp.int32), done0, cache, tok, rng, out0))
+    return out

@@ -379,3 +379,55 @@ def test_batch_bucketing_and_scorer_bucketing():
     lb = np.asarray(raw.forward(ids))
     assert la.shape == lb.shape == (2, 10, 128)
     np.testing.assert_allclose(la, lb, rtol=2e-5, atol=2e-6)
+
+
+def test_eos_early_stop_decode_matches_scan():
+    """decode_tokens_until (in-program early exit) must equal the plain scan
+    decode up to each row's first eos, with eos filled after — and the
+    engine's generate(eos_token_id=...) path uses it."""
+    import deepspeed_tpu
+    from deepspeed_tpu.models import CausalLM
+    from deepspeed_tpu.models.decoding import (decode_tokens,
+                                               decode_tokens_until,
+                                               prefill_and_first_token)
+
+    cfg = cfg_variant()
+    model = CausalLM(cfg)
+    values, _ = split_params_axes(model.init(jax.random.PRNGKey(0)))
+    r = np.random.RandomState(0)
+    ids = jnp.asarray(r.randint(0, 64, (3, 6)), jnp.int32)
+    steps = 10
+
+    tok, cache = prefill_and_first_token(
+        model, values, ids, jax.random.PRNGKey(1), 1.0, max_len=32,
+        greedy=True, top_k=0, dtype=jnp.float32)
+    ref = np.asarray(decode_tokens(
+        model, values, cache, tok, jax.random.PRNGKey(2), 1.0,
+        prompt_len=6, max_len=32, steps=steps, greedy=True, top_k=0))
+
+    # pick an eos that actually appears mid-stream for at least one row
+    flat = ref.T  # [b, steps]
+    eos = int(flat[0][steps // 2])
+    tok2, cache2 = prefill_and_first_token(
+        model, values, ids, jax.random.PRNGKey(1), 1.0, max_len=32,
+        greedy=True, top_k=0, dtype=jnp.float32)
+    got = np.asarray(decode_tokens_until(
+        model, values, cache2, tok2, jax.random.PRNGKey(2), 1.0,
+        prompt_len=6, max_len=32, steps=steps, greedy=True, top_k=0,
+        eos_token_id=eos)).T
+
+    for row_ref, row_got, t0 in zip(flat, got, np.asarray(tok)):
+        if t0 == eos:
+            assert (row_got == eos).all()
+            continue
+        hits = np.where(row_ref == eos)[0]
+        cut = hits[0] + 1 if hits.size else steps
+        np.testing.assert_array_equal(row_got[:cut], row_ref[:cut])
+        assert (row_got[cut:] == eos).all()
+
+    # engine path: generate with eos compiles the until-decode and returns
+    eng = deepspeed_tpu.init_inference(model, dtype="float32", max_tokens=64)
+    eng.params = values
+    out = eng.generate(np.asarray(ids), max_new_tokens=8, greedy=True,
+                       eos_token_id=eos)
+    assert out.shape == (3, 14)
