@@ -169,3 +169,29 @@ def test_engine_roundtrip_across_pipe_resize(tmp_path, devices8):
     e3.load_checkpoint(str(tmp_path), tag="q")
     l3 = float(e3.eval_batch(batch))
     assert abs(l2 - l3) < 1e-4, (l2, l3)
+
+
+def test_async_engine_roundtrip_and_error_surfacing(tmp_path, devices8):
+    """Async sharded engine (the Nebula-engine durability contract): commit
+    joins the writer and re-raises background failures; a good save round
+    trips exactly."""
+    from deepspeed_tpu.checkpoint.sharded import AsyncShardedCheckpointEngine
+
+    mesh = build_mesh(MeshConfig(data=8), devices=devices8)
+    state = _mk_state(mesh, P("data", None))
+    eng = AsyncShardedCheckpointEngine()
+    eng.save(state, str(tmp_path / "ok"), meta={"step": 3})
+    assert eng.commit("t")
+    out, meta = eng.load(str(tmp_path / "ok"), template=state,
+                         shardings={"w": NamedSharding(mesh, P("data", None)),
+                                    "scalar": NamedSharding(mesh, P())})
+    assert meta["step"] == 3
+    _tree_equal(state, out)
+
+    # unwritable destination: the failure surfaces at commit, not silently
+    blocked = tmp_path / "blocked"
+    blocked.write_text("a file where a directory must go")
+    eng2 = AsyncShardedCheckpointEngine()
+    eng2.save(state, str(blocked / "ckpt"))
+    with pytest.raises(RuntimeError, match="async checkpoint write failed"):
+        eng2.commit("t")
