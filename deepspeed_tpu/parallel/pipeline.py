@@ -77,10 +77,21 @@ def pipeline_stack_apply(cfg, stacked_params, x, *, mesh, n_microbatches,
     side = side if side is not None else {}
 
     # [b, ...] -> [M, mb, ...] for activations and every batched side input; keep the
-    # microbatch rows sharded over data.
+    # microbatch rows sharded over data. When the manual region includes ``seq``
+    # the sequence dim (index 2 after microbatching) must be seq-sharded HERE as
+    # well: this constraint's transpose runs in the backward, and if its layout
+    # disagrees with the shard_map boundary spec the SPMD partitioner falls back
+    # to an involuntary full rematerialization (replicate-then-reshard) of the
+    # cotangent every step.
+    seq_size = mesh.shape.get(SEQ_AXIS, 1)
+
     def to_microbatches(a):
         a = a.reshape((M, b // M) + a.shape[1:])
-        spec = P(*((None, DATA_AXIS) + (None,) * (a.ndim - 2)))
+        entries = [None, DATA_AXIS] + [None] * (a.ndim - 2)
+        if (seq_manual and seq_size > 1 and a.ndim >= 3
+                and a.shape[2] % seq_size == 0):
+            entries[2] = SEQ_AXIS
+        spec = P(*entries)
         return jax.lax.with_sharding_constraint(a, jax.sharding.NamedSharding(mesh, spec))
 
     # Cross the shard_map boundary in f32: for replicated (P()) inputs, reverse-mode

@@ -124,3 +124,40 @@ def test_pipeline_rejects_indivisible_layers(devices8):
     with pytest.raises(ValueError, match="not divisible"):
         with jax.set_mesh(mesh):
             model.loss(values, _batch())
+
+
+def test_sp_pipeline_no_involuntary_remat(devices8, capfd):
+    """The SP x PP backward must not trigger XLA's involuntary full
+    rematerialization (spmd_partitioner.cc): the microbatching constraint and
+    the {pipe, seq} shard_map boundary must agree on the activation layout, or
+    every step pays a full-tensor replicate-then-reshard of the cotangent.
+
+    Pins the round-2 MULTICHIP finding (seq=2 pipe=2 mesh, warning in
+    jit(train_step)/transpose(jvp())/sharding_constraint).
+    """
+    enable_cache = jax.config.jax_enable_compilation_cache
+    jax.config.update("jax_enable_compilation_cache", False)  # force real compile
+    try:
+        mesh = build_mesh(MeshConfig(pipe=2, seq=2), devices=devices8)
+        dp = mesh.shape["data"]
+        cfg = tiny_cfg(n_layers=2, d_model=64, n_heads=4,
+                       attention_impl="ring", pipeline_stages=2)
+        model = CausalLM(cfg)
+        config = {
+            "train_batch_size": 4 * dp,
+            "train_micro_batch_size_per_gpu": 2,
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+            "zero_optimization": {"stage": 1},
+            "steps_per_print": 10**6,
+        }
+        engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=config, mesh=mesh)
+        loss = engine.train_batch(batch=_batch(b=4 * dp, s=16))
+        assert np.isfinite(float(loss))
+    finally:
+        jax.config.update("jax_enable_compilation_cache", enable_cache)
+
+    captured = capfd.readouterr()
+    assert "Involuntary full rematerialization" not in captured.err, (
+        "SP x PP backward resharding regressed: XLA fell back to full-tensor "
+        "rematerialization; check to_microbatches vs the shard_map boundary specs"
+    )
