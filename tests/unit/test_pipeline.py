@@ -140,8 +140,9 @@ def test_sp_pipeline_no_involuntary_remat(devices8, capfd):
     try:
         mesh = build_mesh(MeshConfig(pipe=2, seq=2), devices=devices8)
         dp = mesh.shape["data"]
-        cfg = tiny_cfg(n_layers=2, d_model=64, n_heads=4,
-                       attention_impl="ring", pipeline_stages=2)
+        # ring attention is selected via sequence_parallel, which initialize()
+        # sets from the seq=2 mesh; attention_impl does not take "ring"
+        cfg = tiny_cfg(n_layers=2, d_model=64, n_heads=4, pipeline_stages=2)
         model = CausalLM(cfg)
         config = {
             "train_batch_size": 4 * dp,

@@ -1,0 +1,139 @@
+"""Serving benchmark: p50 TTFT (prefill) + steady-state decode throughput.
+
+Matches the BASELINE.json serving metric ("init_inference p50 TTFT"; reference
+flow ``inference/engine.py:560`` — model load, kernel inject, generate). Loads a
+registry model via ``deepspeed_tpu.init_inference`` and measures, per
+(model size x quant mode x prompt bucket):
+
+- TTFT: wall time of ``generate(max_new_tokens=1)`` — prefill + first-token
+  sample + host readback, i.e. what a serving frontend actually waits for.
+  Reported as p50/p95 over ``--repeats``.
+- decode tok/s: ``(b * D) / (t(generate(1 + D)) - t(generate(1)))`` —
+  the compiled decode loop's steady-state rate, dispatch overhead excluded.
+
+Usage (single chip):
+    python tools/bench_serving.py --family gpt2 --sizes small,medium \
+        --prompts 128,512,1000 --modes bf16,int8,int4 --new-tokens 64
+
+Emits one JSON line per row (machine-readable) then a summary table.
+BENCH_FORCE_CPU=1 runs the same pipeline on the host CPU (smoke/debug only;
+rows are marked "platform": "cpu").
+"""
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def build_engine(family, size, mode, max_tokens):
+    """Returns (engine, n_params) — n_params counted BEFORE quantization
+    (int4 packs two weights per element; the packed tree undercounts)."""
+    import jax
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models.layers import split_params_axes
+    from deepspeed_tpu.models.registry import get_model
+
+    # max_seq_len must cover prompt + generation for the KV cache
+    model = get_model(family, size, max_seq_len=max_tokens)
+    shapes = split_params_axes(jax.eval_shape(model.init, jax.random.PRNGKey(0)))[0]
+    n_params = sum(int(np.prod(x.shape)) for x in jax.tree_util.tree_leaves(shapes))
+    config = {
+        "dtype": "bfloat16",
+        "max_tokens": max_tokens,
+        "prompt_bucket_size": 64,
+    }
+    if mode in ("int8", "int4"):
+        config["quant"] = {"enabled": True, "bits": 8 if mode == "int8" else 4}
+    elif mode != "bf16":
+        raise ValueError(f"unknown mode {mode}")
+    return deepspeed_tpu.init_inference(model=model, config=config), n_params
+
+
+def bench_one(engine, prompt_len, new_tokens, batch, repeats, rng):
+    """Returns (ttft_p50_ms, ttft_p95_ms, decode_tok_s)."""
+    vocab = engine.module.config.vocab_size
+    ids = rng.randint(0, vocab, (batch, prompt_len)).astype(np.int32)
+
+    def run(n):
+        t0 = time.perf_counter()
+        out = engine.generate(ids, max_new_tokens=n, greedy=True)
+        np.asarray(out)  # host readback = the fence (block_until_ready is
+        # unreliable through the axon tunnel, see bench.py)
+        return time.perf_counter() - t0
+
+    run(1)            # compile prefill
+    run(1 + new_tokens)  # compile decode loop
+
+    ttfts = [run(1) for _ in range(repeats)]
+    fulls = [run(1 + new_tokens) for _ in range(max(repeats // 2, 2))]
+    ttft_p50 = statistics.median(ttfts)
+    ttft_p95 = sorted(ttfts)[min(len(ttfts) - 1, int(0.95 * len(ttfts)))]
+    decode_s = statistics.median(fulls) - ttft_p50
+    decode_tok_s = (batch * new_tokens) / decode_s if decode_s > 0 else float("inf")
+    return ttft_p50 * 1e3, ttft_p95 * 1e3, decode_tok_s
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--family", default="gpt2")
+    ap.add_argument("--sizes", default="small,medium")
+    ap.add_argument("--prompts", default="128,512,1000")
+    ap.add_argument("--modes", default="bf16,int8,int4")
+    ap.add_argument("--new-tokens", type=int, default=64)
+    ap.add_argument("--batch", type=int, default=1)
+    ap.add_argument("--repeats", type=int, default=10)
+    args = ap.parse_args()
+
+    if os.environ.get("BENCH_FORCE_CPU") == "1":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+    import jax
+
+    platform = jax.devices()[0].platform
+    prompts = [int(p) for p in args.prompts.split(",")]
+    # +1: the decode-compile warmup generates 1 + new_tokens tokens
+    max_tokens = ((max(prompts) + args.new_tokens + 1 + 63) // 64) * 64
+
+    rng = np.random.RandomState(0)
+    rows = []
+    for size in args.sizes.split(","):
+        for mode in args.modes.split(","):
+            engine, n_params = build_engine(args.family, size, mode, max_tokens)
+            for p in prompts:
+                ttft50, ttft95, dec = bench_one(
+                    engine, p, args.new_tokens, args.batch, args.repeats, rng)
+                row = {
+                    "model": f"{args.family}-{size}", "mode": mode,
+                    "prompt_len": p, "batch": args.batch,
+                    "new_tokens": args.new_tokens,
+                    "ttft_p50_ms": round(ttft50, 2),
+                    "ttft_p95_ms": round(ttft95, 2),
+                    "decode_tok_s": round(dec, 1),
+                    "n_params_m": round(n_params / 1e6, 1),
+                    "platform": platform,
+                }
+                rows.append(row)
+                print(json.dumps(row), flush=True)
+            # free the engine (one chip: keep HBM headroom between configs)
+            del engine
+
+    print(f"\n| model | mode | prompt | ttft p50 (ms) | ttft p95 (ms) | decode tok/s |")
+    print("|---|---|---|---|---|---|")
+    for r in rows:
+        print(f"| {r['model']} | {r['mode']} | {r['prompt_len']} "
+              f"| {r['ttft_p50_ms']} | {r['ttft_p95_ms']} | {r['decode_tok_s']} |")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
