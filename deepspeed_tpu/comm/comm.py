@@ -198,11 +198,37 @@ def init_distributed(dist_backend=None, timeout=None, init_method=None, rank=-1,
     global _initialized
     if _initialized:
         return
+
+    def _env_first(*names, default=""):
+        for n in names:
+            v = os.environ.get(n, "")
+            if v != "":
+                return v
+        return default
+
+    # World size / rank: our launcher env first, then the scheduler's own
+    # (srun exports SLURM_NTASKS/SLURM_PROCID; mpirun exports
+    # OMPI_COMM_WORLD_SIZE/RANK) — the slurm/openmpi transports deliberately
+    # export only the coordinator address and let the scheduler number ranks.
+    # The scheduler fallback engages ONLY when a coordinator address is set:
+    # a plain `python train.py` inside an `#SBATCH --ntasks=8` allocation also
+    # sees SLURM_NTASKS=8, and without a coordinator it must stay a normal
+    # single-process run, not hang waiting for seven peers that never arrive.
+    coordinator = _env_first("DS_TPU_COORDINATOR", "MASTER_ADDR")
     num_processes = int(os.environ.get("DS_TPU_NUM_PROCESSES", "0"))
-    coordinator = os.environ.get("DS_TPU_COORDINATOR", os.environ.get("MASTER_ADDR", ""))
+    if num_processes == 0 and coordinator:
+        num_processes = int(_env_first(
+            "SLURM_NTASKS", "OMPI_COMM_WORLD_SIZE", default="0"))
     if num_processes > 1:
+        if not coordinator:
+            raise RuntimeError(
+                "init_distributed: DS_TPU_NUM_PROCESSES > 1 but no coordinator "
+                "address — set DS_TPU_COORDINATOR (or MASTER_ADDR) to the host "
+                "that runs process 0")
         port = os.environ.get("MASTER_PORT", "8476")
-        process_id = int(os.environ.get("DS_TPU_PROCESS_ID", os.environ.get("RANK", "0")))
+        process_id = int(_env_first(
+            "DS_TPU_PROCESS_ID", "RANK", "SLURM_PROCID", "OMPI_COMM_WORLD_RANK",
+            default="0"))
         jax.distributed.initialize(
             coordinator_address=f"{coordinator}:{port}",
             num_processes=num_processes,

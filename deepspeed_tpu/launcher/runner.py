@@ -49,6 +49,15 @@ def parse_args(args=None):
                         help="with --hostfile: launch the command on every "
                              "host over ssh (reference PDSH runner role)")
     parser.add_argument("--ssh_port", type=int, default=22)
+    parser.add_argument("--launcher", type=str, default="",
+                        choices=["", "ssh", "slurm", "openmpi"],
+                        help="multi-node transport (reference --launcher): "
+                             "ssh | slurm (srun) | openmpi (mpirun); one "
+                             "process per HOST either way")
+    parser.add_argument("--launcher_args", type=str, default="",
+                        help="extra args passed through to srun/mpirun")
+    parser.add_argument("--slurm_comment", type=str, default="",
+                        help="slurm --comment (reference --comment flag)")
     parser.add_argument("--deepspeed_config", type=str, default=None)
     parser.add_argument("--module", action="store_true",
                         help="run the target as 'python -m <module>'")
@@ -233,7 +242,12 @@ def main(args=None):
         return launch_local_procs(cmd, args.num_local_procs, env,
                                   devices_per_proc=args.local_devices_per_proc,
                                   master_port=None)
-    if args.ssh and resource_pool:
+    if args.ssh and not args.launcher:
+        args.launcher = "ssh"
+    if args.launcher == "ssh" and not resource_pool:
+        raise ValueError("--launcher ssh needs a non-empty --hostfile "
+                         "(a missing path silently resolves to no hosts)")
+    if args.launcher == "ssh":
         hosts = sorted(resource_pool)
         runner = SshRunner(hosts, args.master_addr or hosts[0],
                            args.master_port, ssh_port=args.ssh_port)
@@ -241,6 +255,63 @@ def main(args=None):
             if args.deepspeed_config else None
         logger.info(f"ds_tpu: ssh launch on {len(hosts)} hosts")
         return runner.run(cmd, extra)
+    if args.launcher in ("slurm", "openmpi"):
+        import shlex
+
+        from .multinode import MULTINODE_RUNNERS
+
+        # one process per host: hostfile slots are chips, which all belong to
+        # the host process — the host count is what srun/mpirun see
+        if resource_pool:
+            num_hosts = len(resource_pool)
+        elif args.num_nodes > 0:
+            num_hosts = args.num_nodes
+        else:
+            raise ValueError(
+                f"--launcher {args.launcher} needs --hostfile or --num_nodes")
+        if not args.master_addr and not resource_pool:
+            raise ValueError(
+                f"--launcher {args.launcher} needs --master_addr when no "
+                f"hostfile is given (the coordinator must be one of the hosts)")
+        master = args.master_addr or sorted(resource_pool)[0]
+        exports = {"DS_TPU_COORDINATOR": master,
+                   "MASTER_PORT": str(args.master_port)}
+        if args.deepspeed_config:
+            exports["DS_TPU_CONFIG"] = args.deepspeed_config
+        kw = dict(exports=exports,
+                  launcher_args=shlex.split(args.launcher_args),
+                  module=args.module)
+        if args.launcher == "slurm":
+            if resource_pool:
+                # pin srun to the (already include/exclude-filtered) hostfile
+                # hosts — otherwise the allocation may place no task on the
+                # exported coordinator and every rank hangs at rendezvous
+                kw.update(include="@".join(sorted(resource_pool)))
+            else:
+                kw.update(include=args.include, exclude=args.exclude)
+            kw.update(comment=args.slurm_comment)
+        else:
+            if resource_pool:
+                # hand mpirun the EFFECTIVE host set (filters applied, one
+                # slot per host), not the raw user hostfile — the raw file
+                # still contains excluded hosts and chip-count slots
+                import tempfile
+
+                eff = tempfile.NamedTemporaryFile(
+                    "w", prefix="ds_tpu_hosts_", suffix=".txt", delete=False)
+                for h in sorted(resource_pool):
+                    eff.write(f"{h} slots=1\n")
+                eff.close()
+                kw.update(hostfile=eff.name)
+            else:
+                kw.update(hostfile="")
+        runner = MULTINODE_RUNNERS[args.launcher](num_hosts, **kw)
+        if not runner.backend_exists():
+            logger.warning(
+                f"ds_tpu: {args.launcher} tooling not found on PATH; the "
+                f"built command may fail to execute")
+        logger.info(f"ds_tpu: {args.launcher} launch on {num_hosts} hosts")
+        return runner.run(args.user_script, args.user_args)
     result = subprocess.call(cmd, env=env)
     return result
 

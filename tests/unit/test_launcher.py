@@ -144,3 +144,183 @@ def test_ds_ssh_builds_per_host_commands(tmp_path, monkeypatch):
     assert len(calls) == 2
     assert calls[0][-2:] == ["w0", "echo hi"]
     assert calls[1][-2:] == ["w2", "echo hi"]
+
+
+def test_slurm_runner_builds_srun_command(tmp_path):
+    """Slurm transport (reference multinode_runner.py:208 semantics on the TPU
+    host model): one task per node, env via --export=ALL,K=V, include/exclude
+    converted from '@' hostfile-filter syntax to slurm comma nodelists."""
+    from deepspeed_tpu.launcher.multinode import SlurmRunner
+
+    r = SlurmRunner(4, include="tpu-0@tpu-1", exclude="tpu-9", comment="ds",
+                    exports={"DS_TPU_COORDINATOR": "tpu-0", "MASTER_PORT": "8476"},
+                    launcher_args=["--partition", "tpu"])
+    cmd = r.build_cmd("train.py", ["--epochs", "2"])
+    assert cmd[:4] == ["srun", "-n", "4", "--ntasks-per-node=1"]
+    assert ["--partition", "tpu"] == cmd[4:6]
+    assert ["--comment", "ds"] == cmd[6:8]
+    assert ["--nodelist", "tpu-0,tpu-1"] == cmd[8:10]
+    assert ["--exclude", "tpu-9"] == cmd[10:12]
+    assert cmd[12] == "--export=ALL,DS_TPU_COORDINATOR=tpu-0,MASTER_PORT=8476"
+    import sys as _sys
+    assert cmd[13:] == [_sys.executable, "-u", "train.py", "--epochs", "2"]
+
+
+def test_openmpi_runner_builds_mpirun_command():
+    """OpenMPI transport (reference multinode_runner.py:107 semantics): one
+    process per node via --map-by ppr:1:node, env via -x K=V pairs."""
+    from deepspeed_tpu.launcher.multinode import OpenMPIRunner
+
+    r = OpenMPIRunner(2, hostfile="/tmp/hf",
+                      exports={"DS_TPU_COORDINATOR": "h0"}, module=True)
+    cmd = r.build_cmd("pkg.train", ["--lr", "1e-4"])
+    assert cmd[:5] == ["mpirun", "-n", "2", "--map-by", "ppr:1:node"]
+    assert ["-hostfile", "/tmp/hf"] == cmd[5:7]
+    assert ["-x", "DS_TPU_COORDINATOR=h0"] == cmd[7:9]
+    import sys as _sys
+    assert cmd[9:] == [_sys.executable, "-u", "-m", "pkg.train", "--lr", "1e-4"]
+
+
+def test_cli_builds_slurm_transport(tmp_path, monkeypatch):
+    """ds_tpu --launcher slurm: hostfile -> host count, coordinator = first
+    host, config forwarded; the built srun line is executed."""
+    from deepspeed_tpu.launcher import runner as R
+
+    hf = tmp_path / "hostfile"
+    hf.write_text("tpu-1 slots=4\ntpu-0 slots=4\n")
+    captured = {}
+
+    def fake_run(self, user_script, user_args=()):
+        captured["cmd"] = self.build_cmd(user_script, user_args)
+        return 0
+
+    monkeypatch.setattr("deepspeed_tpu.launcher.multinode._Transport.run",
+                        fake_run)
+    rc = R.main(["--hostfile", str(hf), "--launcher", "slurm",
+                 "--deepspeed_config", "/tmp/ds.json", "train.py"])
+    assert rc == 0
+    cmd = captured["cmd"]
+    assert cmd[:4] == ["srun", "-n", "2", "--ntasks-per-node=1"]
+    # srun is pinned to the hostfile hosts so the exported coordinator
+    # (tpu-0) is guaranteed a task
+    assert ["--nodelist", "tpu-0,tpu-1"] == cmd[4:6]
+    assert ("--export=ALL,DS_TPU_CONFIG=/tmp/ds.json,"
+            "DS_TPU_COORDINATOR=tpu-0,MASTER_PORT=8476") in cmd
+
+
+def test_cli_slurm_requires_hosts():
+    from deepspeed_tpu.launcher import runner as R
+
+    with pytest.raises(ValueError, match="hostfile or --num_nodes"):
+        R.main(["--launcher", "openmpi", "train.py"])
+
+
+def test_init_distributed_scheduler_env_fallback(tmp_path):
+    """Under srun/mpirun the transports export only the coordinator address;
+    rank/world must come from the scheduler's own env (SLURM_PROCID /
+    OMPI_COMM_WORLD_RANK). Two processes numbered ONLY by SLURM vars must
+    rendezvous."""
+    import subprocess
+    import sys
+
+    script = tmp_path / "worker.py"
+    script.write_text(
+        "import os\n"
+        "os.environ['XLA_FLAGS'] = (os.environ.get('XLA_FLAGS','') + "
+        "' --xla_force_host_platform_device_count=2').strip()\n"
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "import deepspeed_tpu.comm as dist\n"
+        "dist.init_distributed()\n"
+        "assert dist.get_world_size() == 2, dist.get_world_size()\n"
+        "assert dist.get_rank() == int(os.environ['SLURM_PROCID'])\n"
+        "dist.barrier()\n"
+        "print('SLURM_ENV_OK', dist.get_rank())\n")
+    import os as _os
+    repo = _os.path.dirname(_os.path.dirname(_os.path.dirname(
+        _os.path.abspath(__file__))))
+    import socket
+    s = socket.socket(); s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]; s.close()
+    procs = []
+    for rank in range(2):
+        env = dict(_os.environ, PYTHONPATH=repo,
+                   SLURM_NTASKS="2", SLURM_PROCID=str(rank),
+                   DS_TPU_COORDINATOR="127.0.0.1", MASTER_PORT=str(port))
+        env.pop("DS_TPU_NUM_PROCESSES", None)
+        env.pop("DS_TPU_PROCESS_ID", None)
+        procs.append(subprocess.Popen([sys.executable, str(script)],
+                                      env=env, cwd=repo))
+    rcs = [p.wait(timeout=240) for p in procs]
+    assert rcs == [0, 0], rcs
+
+
+def test_cli_openmpi_writes_effective_hostfile(tmp_path, monkeypatch):
+    """mpirun must see the filtered host set with one slot per host, not the
+    raw user hostfile (which lists excluded hosts and chip-count slots)."""
+    from deepspeed_tpu.launcher import runner as R
+
+    hf = tmp_path / "hostfile"
+    hf.write_text("tpu-0 slots=4\ntpu-1 slots=4\ntpu-2 slots=4\n")
+    captured = {}
+
+    def fake_run(self, user_script, user_args=()):
+        captured["hostfile"] = self.hostfile
+        captured["cmd"] = self.build_cmd(user_script, user_args)
+        return 0
+
+    monkeypatch.setattr("deepspeed_tpu.launcher.multinode._Transport.run",
+                        fake_run)
+    rc = R.main(["--hostfile", str(hf), "--exclude", "tpu-0",
+                 "--launcher", "openmpi", "train.py"])
+    assert rc == 0
+    assert captured["cmd"][:5] == ["mpirun", "-n", "2", "--map-by", "ppr:1:node"]
+    eff = open(captured["hostfile"]).read()
+    assert eff == "tpu-1 slots=1\ntpu-2 slots=1\n"
+
+
+def test_cli_ssh_missing_hostfile_raises():
+    from deepspeed_tpu.launcher import runner as R
+
+    with pytest.raises(ValueError, match="non-empty --hostfile"):
+        R.main(["--launcher", "ssh", "/does/not/exist.py"])
+
+
+def test_slurm_export_rejects_comma_values():
+    from deepspeed_tpu.launcher.multinode import SlurmRunner
+
+    r = SlurmRunner(2, exports={"DS_TPU_CONFIG": "/a,b/ds.json"})
+    with pytest.raises(ValueError, match="commas"):
+        r.build_cmd("train.py")
+
+
+def test_init_distributed_ignores_bare_slurm_allocation(monkeypatch):
+    """SLURM_NTASKS>1 WITHOUT a coordinator address (a plain `python train.py`
+    inside an sbatch allocation) must stay single-process, not rendezvous."""
+    import deepspeed_tpu.comm.comm as C
+
+    monkeypatch.setattr(C, "_initialized", False)
+    monkeypatch.setenv("SLURM_NTASKS", "8")
+    monkeypatch.setenv("SLURM_PROCID", "0")
+    for k in ("DS_TPU_NUM_PROCESSES", "DS_TPU_PROCESS_ID",
+              "DS_TPU_COORDINATOR", "MASTER_ADDR"):
+        monkeypatch.delenv(k, raising=False)
+    called = {}
+    monkeypatch.setattr(
+        C.jax.distributed, "initialize",
+        lambda **kw: called.setdefault("kw", kw))
+    C.init_distributed()
+    assert "kw" not in called  # single-process: no rendezvous attempted
+    monkeypatch.setattr(C, "_initialized", False)
+
+
+def test_init_distributed_explicit_world_requires_coordinator(monkeypatch):
+    import deepspeed_tpu.comm.comm as C
+
+    monkeypatch.setattr(C, "_initialized", False)
+    monkeypatch.setenv("DS_TPU_NUM_PROCESSES", "2")
+    for k in ("DS_TPU_COORDINATOR", "MASTER_ADDR"):
+        monkeypatch.delenv(k, raising=False)
+    with pytest.raises(RuntimeError, match="no coordinator"):
+        C.init_distributed()
+    monkeypatch.setattr(C, "_initialized", False)
