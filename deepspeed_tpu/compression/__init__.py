@@ -1,5 +1,6 @@
-from .compress import init_compression, redundancy_clean, CompressionScheduler
+from .compress import (init_compression, redundancy_clean,
+                       apply_to_model_config, CompressionScheduler)
 from .config import CompressionConfig
 
-__all__ = ["init_compression", "redundancy_clean", "CompressionScheduler",
-           "CompressionConfig"]
+__all__ = ["init_compression", "redundancy_clean", "apply_to_model_config",
+           "CompressionScheduler", "CompressionConfig"]

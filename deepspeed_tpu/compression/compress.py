@@ -55,27 +55,70 @@ class CompressionScheduler:
         return sp.ratio
 
 
-def init_compression(config) -> "CompressionScheduler":
+def init_compression(config, model_config=None) -> "CompressionScheduler":
     """Parse config -> scheduler + transform factory (reference ``compress.py:95``).
 
     Usage:
-        scheduler = init_compression({"weight_quantization": {...}})
+        scheduler = init_compression({"weight_quantization": {...}}, model_cfg)
         params_q = scheduler.compress_params(params, step)   # inside/before step
+
+    ``model_config`` (a ``TransformerConfig``) is required for head pruning
+    (head_dim) and for activation quantization via ``apply_to_model_config``.
     """
     if not isinstance(config, CompressionConfig):
         config = CompressionConfig.from_dict(dict(config or {}))
-    return _CompressionRuntime(config)
+    return _CompressionRuntime(config, model_config)
+
+
+def apply_to_model_config(model_config, config):
+    """Wire activation quantization into a model config (the reference swaps
+    layers for QuantAct-wrapped ones; here the model's block reads
+    ``activation_quant_bits`` and fake-quantizes its residual branches)."""
+    import dataclasses
+
+    if not isinstance(config, CompressionConfig):
+        config = CompressionConfig.from_dict(dict(config or {}))
+    aq = config.activation_quantization
+    if not aq.enabled:
+        return model_config
+    if aq.schedule_offset > 0:
+        log_dist(
+            "activation_quantization.schedule_offset is not supported: the "
+            "quantizer is part of the compiled model, so it engages from "
+            "step 0 (train the warmup phase with it disabled instead)",
+            ranks=[0])
+    return dataclasses.replace(model_config,
+                               activation_quant_bits=aq.bits,
+                               activation_quant_group=aq.group_size)
 
 
 class _CompressionRuntime(CompressionScheduler):
+    def __init__(self, config: CompressionConfig, model_config=None):
+        super().__init__(config)
+        self.model_config = model_config
+        if (config.head_pruning.enabled and model_config is None):
+            raise ValueError(
+                "head_pruning needs init_compression(config, model_config=...) "
+                "for the head layout (head_dim)")
+
     def compress_params(self, params, step):
         """Apply fake-quant + pruning masks for the current step (jittable)."""
         wq = self.config.weight_quantization
         sp = self.config.sparse_pruning
+        hp = self.config.head_pruning
+        rp = self.config.row_pruning
         bits = self.bits_at(step)
         ratio = self.prune_ratio_at(step)
-        if bits is None and ratio == 0.0:
+        head_on = hp.enabled and step >= hp.schedule_offset
+        row_on = rp.enabled and step >= rp.schedule_offset
+        if bits is None and ratio == 0.0 and not head_on and not row_on:
             return params
+
+        if head_on:
+            params, _ = _transform_heads(params, self.model_config.head_dim,
+                                         hp.ratio, hp.modules, shrink=False)
+        if row_on:
+            params, _ = _transform_rows(params, rp, shrink=False)
 
         keys, leaves, treedef = _leaf_keys(params)
         out = []
@@ -113,11 +156,211 @@ def _prune(x, method, ratio):
     return x * mask
 
 
-def redundancy_clean(params, config):
-    """Bake final quantized values for deployment (reference ``compress.py:123``):
-    returns (int8 leaves + scales) for quantized params, pruned values zeroed."""
+def _keep_count(n, ratio):
+    return max(1, int(round(n * (1.0 - ratio))))
+
+
+def _head_groups(keys, patterns):
+    """Attention groups: prefixes g with ``g/o/kernel`` present (zoo naming)."""
+    suffix = "/o/kernel"
+    return [k[:-len(suffix)] for k in keys
+            if k.endswith(suffix) and _matches(k[:-len(suffix)], patterns)]
+
+
+def _gather_or_mask(x, idx, axis, n_groups, shrink):
+    """Keep the ``idx`` groups along ``axis`` (gather when shrinking, zero-mask
+    otherwise). ``x`` is reshaped so ``axis`` splits into (n_groups, per_group).
+
+    ``idx`` is [lead..., K] where lead are x's leading dims (the stacked
+    ``layers`` dim, or nothing for an unstacked tree); between lead and
+    ``axis`` it broadcasts (e.g. over d_model for qkv kernel columns).
+    """
+    shape = list(x.shape)
+    axis = axis % x.ndim
+    per = shape[axis] // n_groups
+    grouped = x.reshape(shape[:axis] + [n_groups, per] + shape[axis + 1:])
+    lead = idx.ndim - 1
+    K = idx.shape[-1]
+    idx_shape = list(idx.shape[:lead]) + [1] * (grouped.ndim - lead)
+    idx_shape[axis] = K
+    expand = idx.reshape(idx_shape)
+    if shrink:
+        kept = jnp.take_along_axis(grouped, expand, axis=axis)
+        out_shape = shape[:axis] + [K * per] + shape[axis + 1:]
+        return kept.reshape(out_shape)
+    mask_shape = [1] * grouped.ndim
+    mask_shape[:lead] = list(idx.shape[:lead])
+    mask_shape[axis] = n_groups
+    mask = jnp.zeros(mask_shape, x.dtype)
+    mask = jnp.put_along_axis(mask, expand, 1.0, axis=axis, inplace=False)
+    return (grouped * mask).reshape(shape)
+
+
+def _transform_heads(params, head_dim, ratio, patterns, shrink):
+    """Head pruning (reference ``basic_layer.py:553``): score each attention
+    head by the L1 mass of its output-projection rows; keep the top
+    ``1 - ratio`` fraction. Returns (params, kept_heads_or_None)."""
+    keys, leaves, treedef = _leaf_keys(params)
+    index = {k: i for i, k in enumerate(keys)}
+    kept = None
+    for g in _head_groups(keys, patterns):
+        o = leaves[index[g + "/o/kernel"]]
+        H = o.shape[-2] // head_dim
+        if H <= 1:
+            continue
+        scores = jnp.sum(
+            jnp.abs(o).reshape(o.shape[:-2] + (H, head_dim, o.shape[-1])),
+            axis=(-1, -2))
+        K = _keep_count(H, ratio)
+        kept = K
+        idx = jnp.sort(jnp.argsort(scores, axis=-1)[..., -K:], axis=-1)
+        for proj in ("q", "k", "v"):
+            kk = f"{g}/{proj}/kernel"
+            if kk not in index:
+                continue
+            if leaves[index[kk]].shape[-1] != H * head_dim:
+                raise ValueError(
+                    f"head_pruning requires MHA ({kk} width "
+                    f"{leaves[index[kk]].shape[-1]} != {H}x{head_dim}); "
+                    f"GQA/MQA layouts are not head-prunable")
+            leaves[index[kk]] = _gather_or_mask(
+                leaves[index[kk]], idx, axis=-1, n_groups=H, shrink=shrink)
+            bk = f"{g}/{proj}/bias"
+            if bk in index:
+                leaves[index[bk]] = _gather_or_mask(
+                    leaves[index[bk]], idx, axis=-1, n_groups=H, shrink=shrink)
+        leaves[index[g + "/o/kernel"]] = _gather_or_mask(
+            o, idx, axis=-2, n_groups=H, shrink=shrink)
+    return jax.tree_util.tree_unflatten(treedef, leaves), kept
+
+
+def _row_groups(keys, rp):
+    """MLP groups as (prefix, producer_suffixes, consumer_suffix). The
+    configured producer/consumer pair is matched first; with the default
+    naming, SwiGLU triples (up+gate -> down) are recognized too, and a sibling
+    ``gate`` is ALWAYS co-pruned with its producer — shrinking ``up`` without
+    ``gate`` would crash silu(gate) * up at the first forward."""
+    keyset = set(keys)
+    pairs = [(rp.producer, rp.consumer)]
+    if rp.producer == "fc":
+        pairs.append(("up", "down"))
+    groups = []
+    for producer, consumer in pairs:
+        suffix = f"/{producer}/kernel"
+        for k in keys:
+            if not k.endswith(suffix):
+                continue
+            g = k[:-len(suffix)]
+            if f"{g}/{consumer}/kernel" not in keyset or not _matches(g, rp.modules):
+                continue
+            producers = [producer]
+            if producer != "gate" and f"{g}/gate/kernel" in keyset:
+                producers.append("gate")
+            groups.append((g, producers, consumer))
+    return groups
+
+
+def _transform_rows(params, rp, shrink):
+    """Row pruning (reference ``basic_layer.py:437``): score each intermediate
+    neuron by the L1 mass of its producing columns + consuming row; keep the
+    top ``1 - ratio`` fraction of producer output cols and the matching
+    consumer input rows. Returns (params, kept_rows_or_None)."""
+    keys, leaves, treedef = _leaf_keys(params)
+    index = {k: i for i, k in enumerate(keys)}
+    kept = None
+    for g, producers, consumer in _row_groups(keys, rp):
+        ck = f"{g}/{consumer}/kernel"
+        proj = leaves[index[ck]]               # [..., FF, d_out]
+        FF = proj.shape[-2]
+        scores = jnp.sum(jnp.abs(proj), axis=-1)
+        for p in producers:                     # [..., d_in, FF] each
+            scores = scores + jnp.sum(
+                jnp.abs(leaves[index[f"{g}/{p}/kernel"]]), axis=-2)
+        K = _keep_count(FF, rp.ratio)
+        kept = K
+        idx = jnp.sort(jnp.argsort(scores, axis=-1)[..., -K:], axis=-1)
+        for p in producers:
+            pk = f"{g}/{p}/kernel"
+            leaves[index[pk]] = _gather_or_mask(
+                leaves[index[pk]], idx, axis=-1, n_groups=FF, shrink=shrink)
+            bk = f"{g}/{p}/bias"
+            if bk in index:
+                leaves[index[bk]] = _gather_or_mask(
+                    leaves[index[bk]], idx, axis=-1, n_groups=FF, shrink=shrink)
+        leaves[index[ck]] = _gather_or_mask(proj, idx, axis=-2, n_groups=FF,
+                                            shrink=shrink)
+    return jax.tree_util.tree_unflatten(treedef, leaves), kept
+
+
+def _reduce_layers(params, lr):
+    """Depth reduction: slice the stacked ``layers`` dim of every leaf under
+    ``lr.module_prefix`` down to the kept block indices."""
+    keys, leaves, treedef = _leaf_keys(params)
+    stacked = [i for i, k in enumerate(keys) if k.startswith(lr.module_prefix)]
+    if not stacked:
+        raise ValueError(
+            f"layer_reduction: no parameters under prefix {lr.module_prefix!r} "
+            f"(is the model built with scan_layers stacking?)")
+    L = leaves[stacked[0]].shape[0]
+    if lr.teacher_layer:
+        idx = np.asarray(sorted(set(int(i) for i in lr.teacher_layer)))
+        if idx[0] < 0 or idx[-1] >= L:
+            raise ValueError(f"layer_reduction.teacher_layer out of range for "
+                             f"{L} layers: {list(idx)}")
+    else:
+        keep = lr.keep_number_layer
+        if not 0 < keep <= L:
+            raise ValueError(f"layer_reduction.keep_number_layer must be in "
+                             f"[1, {L}], got {keep}")
+        idx = np.unique(np.linspace(0, L - 1, keep).round().astype(int))
+    for i in stacked:
+        leaves[i] = leaves[i][idx]
+    return jax.tree_util.tree_unflatten(treedef, leaves), len(idx)
+
+
+def redundancy_clean(params, config, model_config=None):
+    """Bake final compressed values for deployment (reference ``compress.py:123``):
+    structured pruning/depth reduction physically SHRINK the tree, then
+    quantized params are packed to int.
+
+    Returns ``(params, packed)``, or ``(params, packed, new_model_config)``
+    when ``model_config`` is given (n_layers / n_heads / d_ff updated to the
+    shrunk shapes — required for head pruning, which needs head_dim)."""
+    import dataclasses
+
     if not isinstance(config, CompressionConfig):
         config = CompressionConfig.from_dict(dict(config or {}))
+    updates = {}
+    if config.layer_reduction.enabled:
+        params, n_layers = _reduce_layers(params, config.layer_reduction)
+        updates["n_layers"] = n_layers
+    if config.head_pruning.enabled:
+        if model_config is None:
+            raise ValueError("head_pruning shrink needs redundancy_clean("
+                             "..., model_config=...) for head_dim")
+        if getattr(model_config, "position_embedding", None) == "alibi":
+            # ALiBi slopes are a function of head index and TOTAL head count;
+            # re-deriving them for the shrunk count silently changes every
+            # kept head's slope vs what it was trained with
+            raise ValueError("head_pruning does not support ALiBi models: "
+                             "slopes would be silently re-assigned")
+        params, n_heads = _transform_heads(
+            params, model_config.head_dim, config.head_pruning.ratio,
+            config.head_pruning.modules, shrink=True)
+        if n_heads is not None:
+            updates["n_heads"] = n_heads
+            # heads keep their original width; d_model stays (residual width),
+            # so the derived d_model // n_heads would be wrong
+            updates["head_dim_override"] = model_config.head_dim
+            if getattr(model_config, "n_kv_heads", None) is not None:
+                # MHA spelled explicitly (the width check in _transform_heads
+                # already rejected GQA): kv heads shrink with the heads
+                updates["n_kv_heads"] = n_heads
+    if config.row_pruning.enabled:
+        params, d_ff = _transform_rows(params, config.row_pruning, shrink=True)
+        if d_ff is not None:
+            updates["d_ff"] = d_ff
+
     wq = config.weight_quantization
     keys, leaves, treedef = _leaf_keys(params)
     packed = {}
@@ -134,5 +377,11 @@ def redundancy_clean(params, config):
         else:
             out.append(leaf)
     log_dist(f"redundancy_clean: quantized {n_quant}/{len(leaves)} tensors to "
-             f"int{wq.target_bits}", ranks=[0])
-    return jax.tree_util.tree_unflatten(treedef, out), packed
+             f"int{wq.target_bits}"
+             + (f"; shrunk {updates}" if updates else ""), ranks=[0])
+    cleaned = jax.tree_util.tree_unflatten(treedef, out)
+    if model_config is None:
+        return cleaned, packed
+    new_cfg = dataclasses.replace(model_config, **updates) if updates \
+        else model_config
+    return cleaned, packed, new_cfg

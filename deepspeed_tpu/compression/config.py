@@ -14,9 +14,17 @@ class WeightQuantizeConfig(ConfigModel):
 
 
 class ActivationQuantizeConfig(ConfigModel):
+    """Reference ``basic_layer.py:17`` QuantAct. On TPU this is a model-config
+    knob (``TransformerConfig.activation_quant_bits``) wired by
+    ``apply_to_model_config``: activations are fake-quantized in-graph on the
+    attention/MLP residual branches (dynamic symmetric groupwise ranges; the
+    reference's "static" running-range calibration maps to dynamic here — the
+    range reduction happens per group inside the compiled step)."""
+
     enabled: bool = False
     bits: int = 8
-    range_calibration: str = "dynamic"  # dynamic | static
+    group_size: int = 64
+    range_calibration: str = "dynamic"  # dynamic | static (treated as dynamic)
     schedule_offset: int = 0
 
 
@@ -29,10 +37,42 @@ class SparsePruningConfig(ConfigModel):
 
 
 class RowPruningConfig(ConfigModel):
+    """Structured MLP-neuron pruning (reference ``basic_layer.py:437``): zero
+    (then shrink) output columns of the producing linear and the matching input
+    rows of the consuming linear. ``modules`` matches the producer group
+    (zoo naming: ``blocks/mlp`` with ``fc`` producing and ``proj`` consuming);
+    the reference's explicit ``related_modules`` pairing is the
+    producer/consumer suffix pair here."""
+
     enabled: bool = False
     ratio: float = 0.5
     schedule_offset: int = 0
     modules: list = ["*"]
+    producer: str = "fc"              # suffix of the producing linear
+    consumer: str = "proj"            # suffix of the consuming linear
+
+
+class HeadPruningConfig(ConfigModel):
+    """Attention-head pruning (reference ``basic_layer.py:553``): heads scored
+    by the L1 mass of their output-projection rows; lowest-``ratio`` fraction
+    masked during training and physically removed by ``redundancy_clean``."""
+
+    enabled: bool = False
+    ratio: float = 0.5
+    schedule_offset: int = 0
+    modules: list = ["*"]
+
+
+class LayerReductionConfig(ConfigModel):
+    """Depth reduction (reference ``compression/config.py`` layer_reduction):
+    keep a subset of transformer blocks. With scan-stacked layers this is a
+    slice of the leading ``layers`` dim. ``teacher_layer`` lists the block
+    indices to keep; otherwise ``keep_number_layer`` evenly-spaced blocks."""
+
+    enabled: bool = False
+    keep_number_layer: int = 0
+    teacher_layer: list = []
+    module_prefix: str = "blocks"     # stacked-subtree prefix in the param tree
 
 
 class CompressionConfig(ConfigModel):
@@ -40,3 +80,5 @@ class CompressionConfig(ConfigModel):
     activation_quantization: ActivationQuantizeConfig = ActivationQuantizeConfig
     sparse_pruning: SparsePruningConfig = SparsePruningConfig
     row_pruning: RowPruningConfig = RowPruningConfig
+    head_pruning: HeadPruningConfig = HeadPruningConfig
+    layer_reduction: LayerReductionConfig = LayerReductionConfig

@@ -76,7 +76,8 @@ def _attn_with_cache(cfg, p_attn, h, k_cache, v_cache, pos, kv_len, rope=None,
 
     out = L.dot_product_attention(q, k_full, v_full, mask=mask,
                                   scale=cfg.attn_scale, alibi_bias=alibi)
-    out = L.linear_apply(p_attn["o"], out.reshape(b, q_len, d))
+    # -1, not d: head-pruned models have attention width n_heads*head_dim < d
+    out = L.linear_apply(p_attn["o"], out.reshape(b, q_len, -1))
     return out, k_cache, v_cache
 
 

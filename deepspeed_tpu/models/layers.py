@@ -235,18 +235,22 @@ ACTIVATIONS = {
 # Attention
 # ---------------------------------------------------------------------------------
 def attention_init(rng, embed_dim, n_heads, n_kv_heads=None, bias=True, stddev=0.02,
-                   out_stddev=None):
+                   out_stddev=None, head_dim=None):
     """QKV + output projection. Fused qkv as one matrix (the reference's inference
-    kernels fuse qkv gemm the same way; csrc/transformer/inference)."""
+    kernels fuse qkv gemm the same way; csrc/transformer/inference).
+
+    ``head_dim`` defaults to embed_dim // n_heads; head-pruned models pass the
+    original width explicitly, making q/o width n_heads*head_dim < embed_dim."""
     n_kv_heads = n_kv_heads or n_heads
-    head_dim = embed_dim // n_heads
+    head_dim = head_dim or embed_dim // n_heads
+    q_dim = n_heads * head_dim
     kv_dim = n_kv_heads * head_dim
     k1, k2, k3, k4 = jax.random.split(rng, 4)
     return {
-        "q": linear_init(k1, embed_dim, embed_dim, ("embed", "heads"), bias, stddev),
+        "q": linear_init(k1, embed_dim, q_dim, ("embed", "heads"), bias, stddev),
         "k": linear_init(k2, embed_dim, kv_dim, ("embed", "kv"), bias, stddev),
         "v": linear_init(k3, embed_dim, kv_dim, ("embed", "kv"), bias, stddev),
-        "o": linear_init(k4, embed_dim, embed_dim, ("heads", "embed"), bias,
+        "o": linear_init(k4, q_dim, embed_dim, ("heads", "embed"), bias,
                          out_stddev or stddev),
     }
 

@@ -101,6 +101,15 @@ class TransformerConfig:
     # Sequence parallelism: shard the sequence dim over the ``seq`` mesh axis with
     # ring attention (set by the engine; see parallel/ring_attention.py)
     sequence_parallel: bool = False
+    # Activation quantization (reference compression/basic_layer.py:17 QuantAct
+    # via compression.apply_to_model_config): fake-quantize the attention/MLP
+    # residual-branch outputs in-graph. 0 = off.
+    activation_quant_bits: int = 0
+    activation_quant_group: int = 64
+    # Explicit per-head width. None = d_model // n_heads; head-pruned models
+    # (compression.redundancy_clean) keep the ORIGINAL head width while
+    # n_heads shrinks, so attention width n_heads*head_dim < d_model.
+    head_dim_override: typing.Optional[int] = None
     # Mixture-of-Experts (see moe/sharded_moe.py; reference deepspeed/moe/)
     n_experts: int = 0            # 0 = dense FFN
     moe_top_k: int = 1
@@ -112,7 +121,7 @@ class TransformerConfig:
 
     @property
     def head_dim(self):
-        return self.d_model // self.n_heads
+        return self.head_dim_override or self.d_model // self.n_heads
 
     @property
     def kv_heads(self):
@@ -122,9 +131,11 @@ class TransformerConfig:
         """Analytic parameter count (embedding + blocks + final norm)."""
         d, f, v = self.d_model, self.d_ff, self.vocab_size
         per_block = 4 * d * d * (self.kv_heads / self.n_heads if self.n_kv_heads else 1.0)
-        # more precisely: q:d*d, k,v:d*kv_dim, o:d*d
+        # more precisely: q:d*q_dim, k,v:d*kv_dim, o:q_dim*d (q_dim < d for
+        # head-pruned models with head_dim_override)
+        q_dim = self.n_heads * self.head_dim
         kv_dim = self.kv_heads * self.head_dim
-        per_block = d * d + 2 * d * kv_dim + d * d
+        per_block = d * q_dim + 2 * d * kv_dim + q_dim * d
         if self.activation == "swiglu":
             per_block += 3 * d * f
         else:
@@ -199,7 +210,7 @@ def block_init(rng, cfg):
         "ln_1": _norm_init(cfg),
         "attn": L.attention_init(
             k_attn, cfg.d_model, cfg.n_heads, cfg.kv_heads, cfg.use_bias,
-            cfg.initializer_range, out_stddev=out_std,
+            cfg.initializer_range, out_stddev=out_std, head_dim=cfg.head_dim,
         ),
         "ln_2": _norm_init(cfg),
         "mlp": mlp,
@@ -343,18 +354,29 @@ def block_apply(cfg, p, x, mask=None, rope=None, alibi=None, deterministic=True,
             return out
         return _mlp_apply(cfg, p["mlp"], h, tp_manual=tp_manual)
 
+    def qact(h):
+        # activation fake-quant on the residual branches (QuantAct role,
+        # compression/basic_layer.py:17) — dynamic symmetric groupwise range,
+        # straight-through gradient; fuses into the surrounding elementwise ops
+        if not cfg.activation_quant_bits:
+            return h
+        from ..ops.quantizer import fake_quantize
+
+        return fake_quantize(h, bits=cfg.activation_quant_bits,
+                             group_size=cfg.activation_quant_group)
+
     if cfg.parallel_attn_mlp:
         h = _norm_apply(cfg, p["ln_1"], x)
         h_mlp = _norm_apply(cfg, p["ln_2"], x) if cfg.parallel_norm_split else h
-        return x + maybe_drop(attn(h), 2) + maybe_drop(mlp(h_mlp), 3), aux
+        return x + maybe_drop(qact(attn(h)), 2) + maybe_drop(qact(mlp(h_mlp)), 3), aux
     elif cfg.prenorm:
-        x = x + maybe_drop(attn(_norm_apply(cfg, p["ln_1"], x)), 2)
-        x = x + maybe_drop(mlp(_norm_apply(cfg, p["ln_2"], x)), 3)
+        x = x + maybe_drop(qact(attn(_norm_apply(cfg, p["ln_1"], x))), 2)
+        x = x + maybe_drop(qact(mlp(_norm_apply(cfg, p["ln_2"], x))), 3)
         return x, aux
     else:
         # post-norm (BERT)
-        x = _norm_apply(cfg, p["ln_1"], x + maybe_drop(attn(x), 2))
-        x = _norm_apply(cfg, p["ln_2"], x + maybe_drop(mlp(x), 3))
+        x = _norm_apply(cfg, p["ln_1"], x + maybe_drop(qact(attn(x)), 2))
+        x = _norm_apply(cfg, p["ln_2"], x + maybe_drop(qact(mlp(x)), 3))
         return x, aux
 
 
