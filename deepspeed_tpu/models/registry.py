@@ -177,6 +177,30 @@ def gpt_neo_config(size="1.3b", **overrides):
     return TransformerConfig(**base)
 
 
+def gpt2_moe_config(size="tiny", **overrides):
+    """PR-MoE presets over the GPT-2 backbone (reference MoE tutorial
+    configuration: GPT-style dense backbone + MoE FFNs with residual experts,
+    ``moe/layer.py:16`` use_residual + noisy top-1 gating)."""
+    presets = {
+        "tiny": dict(n_layers=2, d_model=128, n_heads=2, d_ff=512,
+                     max_seq_len=256, n_experts=4),
+        "small": dict(n_layers=12, d_model=768, n_heads=12, d_ff=3072,
+                      n_experts=8),
+        "medium": dict(n_layers=24, d_model=1024, n_heads=16, d_ff=4096,
+                       n_experts=16),
+    }
+    base = dict(
+        vocab_size=50257, max_seq_len=1024, activation="gelu_new",
+        norm="layernorm", position_embedding="learned", tie_embeddings=True,
+        use_bias=True, prenorm=True,
+        moe_top_k=1, moe_use_residual=True, moe_use_rts=True,
+        moe_noisy_gate_policy="rsample",
+    )
+    base.update(presets[size])
+    base.update(overrides)
+    return TransformerConfig(**base)
+
+
 def bert_config(size="base", **overrides):
     """Encoder presets (BERT paper table 1 geometry): post-norm, bidirectional,
     learned positions + segment embeddings, gelu, embed LN."""
@@ -208,6 +232,7 @@ MODEL_CONFIGS = {
     "gpt_neo": gpt_neo_config,
     "falcon": falcon_config,
     "bert": bert_config,
+    "gpt2_moe": gpt2_moe_config,
 }
 
 

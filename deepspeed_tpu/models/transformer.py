@@ -118,6 +118,17 @@ class TransformerConfig:
     moe_min_capacity: int = 4
     moe_aux_loss_weight: float = 0.01
     moe_noise_std: float = 0.0
+    # Reference TopKGate noisy_gate_policy (sharded_moe.py:398): "jitter"
+    # multiplies the gate INPUT by uniform(1±eps); "rsample" adds gumbel noise
+    # to the selection logits (gates stay clean). "" = off. Training only.
+    moe_noisy_gate_policy: str = ""
+    # Random Token Selection (reference top1gating use_rts, sharded_moe.py:220):
+    # capacity-overflow drops are decided by random priority, not sequence order
+    moe_use_rts: bool = False
+    # PR-MoE residual experts (reference moe/layer.py use_residual, arXiv
+    # 2201.05596): a dense MLP runs alongside the experts; outputs are blended
+    # by a learned 2-way softmax coefficient
+    moe_use_residual: bool = False
 
     @property
     def head_dim(self):

@@ -20,10 +20,18 @@ Formulation (GShard / Switch):
   (reference ``sharded_moe.py:229``), returned to be added to the model loss.
 """
 
+import dataclasses
+
 import jax
 import jax.numpy as jnp
 
 from ..models.layers import Param, normal_init
+
+
+def _dense_cfg(cfg):
+    """Config for the PR-MoE residual branch: the same block geometry with the
+    experts turned off (so the dense ``_mlp_init``/``_mlp_apply`` run)."""
+    return dataclasses.replace(cfg, n_experts=0)
 
 
 def expert_capacity(seq_len, n_experts, top_k, capacity_factor, min_capacity=4):
@@ -32,7 +40,8 @@ def expert_capacity(seq_len, n_experts, top_k, capacity_factor, min_capacity=4):
     return max(cap, min_capacity)
 
 
-def top_k_gating(logits, top_k, capacity, *, rng=None, noise_std=0.0):
+def top_k_gating(logits, top_k, capacity, *, rng=None, noise_std=0.0,
+                 rsample=False, use_rts=False):
     """Top-k gating with per-group capacity.
 
     Args:
@@ -40,7 +49,12 @@ def top_k_gating(logits, top_k, capacity, *, rng=None, noise_std=0.0):
       top_k: 1 or 2 (reference supports k in {1, 2}; we allow any k < E).
       capacity: C slots per expert per group.
       rng: optional rng for gating noise (reference's ``noisy_gate_policy``).
-      noise_std: stddev of the jitter noise added to logits before top-k.
+      noise_std: stddev of gaussian noise added to logits before top-k.
+      rsample: reference 'RSample' policy (``sharded_moe.py:188``): gumbel
+        noise on the SELECTION logits only; gate weights stay clean.
+      use_rts: Random Token Selection (``sharded_moe.py:220``): the first
+        choice's capacity overflow is dropped by random priority instead of
+        sequence order, so late-sequence tokens aren't systematically dropped.
 
     Returns:
       dispatch: [b, s, E, C] bool — token -> (expert, slot) routing.
@@ -51,9 +65,14 @@ def top_k_gating(logits, top_k, capacity, *, rng=None, noise_std=0.0):
     logits = logits.astype(jnp.float32)
     gates = jax.nn.softmax(logits, axis=-1)  # [b, s, E]
 
+    gauss_rng = gumbel_rng = rts_rng = None
+    if rng is not None:
+        gauss_rng, gumbel_rng, rts_rng = jax.random.split(rng, 3)
     select_logits = logits
-    if noise_std > 0.0 and rng is not None:
-        select_logits = logits + jax.random.normal(rng, logits.shape) * noise_std
+    if noise_std > 0.0 and gauss_rng is not None:
+        select_logits = logits + jax.random.normal(gauss_rng, logits.shape) * noise_std
+    if rsample and gumbel_rng is not None:
+        select_logits = select_logits + jax.random.gumbel(gumbel_rng, logits.shape)
 
     # iteratively pick k experts per token, masking previous picks
     masked = select_logits
@@ -80,8 +99,20 @@ def top_k_gating(logits, top_k, capacity, *, rng=None, noise_std=0.0):
     denom = jnp.zeros((b, s), jnp.float32)
     kept_masks = []
     for choice, (mask, gate) in enumerate(zip(expert_masks, expert_gates)):
-        # cumulative position of this token in expert's queue within its group
-        pos_in_expert = jnp.cumsum(mask, axis=1) - mask        # [b, s, E]
+        if use_rts and rts_rng is not None and choice == 0:
+            # random priority (reference mask1 * uniform -> _top_idx): rank
+            # each token among its expert's tokens by a random draw. Done by
+            # sorting into priority order, cumsumming, and scattering back —
+            # O(s log s), no [s, s] pairwise matrix.
+            r = jax.random.uniform(rts_rng, (b, s))
+            perm = jnp.argsort(r, axis=1)                        # priority order
+            mask_sorted = jnp.take_along_axis(mask, perm[:, :, None], axis=1)
+            pos_sorted = jnp.cumsum(mask_sorted, axis=1) - mask_sorted
+            inv = jnp.argsort(perm, axis=1)
+            pos_in_expert = jnp.take_along_axis(pos_sorted, inv[:, :, None], axis=1)
+        else:
+            # cumulative position of this token in expert's queue in its group
+            pos_in_expert = jnp.cumsum(mask, axis=1) - mask    # [b, s, E]
         pos = pos_in_expert + prior_counts[:, None, :]
         keep = mask * (pos < capacity)                         # drop overflow tokens
         kept_masks.append((keep, gate))
@@ -105,7 +136,7 @@ def moe_mlp_init(rng, cfg):
     experts are gated — silu(x @ wi_gate) ⊙ (x @ wi) — matching the dense FFN's
     silu(gate) * up convention (models/transformer.py)."""
     E = cfg.n_experts
-    k_router, k1, k2, k3 = jax.random.split(rng, 4)
+    k_router, k1, k2, k3, k_res, k_coef = jax.random.split(rng, 6)
     std = cfg.initializer_range
     out_std = std / (2.0 * cfg.n_layers) ** 0.5
     params = {
@@ -121,6 +152,17 @@ def moe_mlp_init(rng, cfg):
     if cfg.activation == "swiglu":
         params["wi_gate"] = Param(normal_init(k3, (E, cfg.d_model, cfg.d_ff), std),
                                   ("expert", "embed", "mlp"))
+    if cfg.moe_use_residual:
+        # PR-MoE (reference moe/layer.py:16 use_residual): a dense MLP beside
+        # the experts + a learned 2-way blend coefficient
+        from ..models.transformer import _mlp_init
+
+        params["res_mlp"] = _mlp_init(k_res, _dense_cfg(cfg))
+        params["coef"] = {
+            "kernel": Param(normal_init(k_coef, (cfg.d_model, 2), std),
+                            ("embed", "coef")),
+            "bias": Param(jnp.zeros((2,), jnp.float32), ("coef",)),
+        }
     return params
 
 
@@ -148,12 +190,23 @@ def moe_mlp_apply(cfg, p, x, *, deterministic=True, rng=None):
         capacity = expert_capacity(s, E, cfg.moe_top_k, cfg.moe_capacity_factor,
                                    cfg.moe_min_capacity)
 
+    policy = (cfg.moe_noisy_gate_policy or "").lower()
+    gate_in = x.astype(jnp.float32)
+    gate_rng = rng
+    if policy == "jitter" and not deterministic and rng is not None:
+        # reference multiplicative_jitter (sharded_moe.py:49): scale the gate
+        # INPUT by uniform(1±eps) — the router sees jittered activations
+        jitter_rng, gate_rng = jax.random.split(rng)
+        gate_in = gate_in * jax.random.uniform(
+            jitter_rng, gate_in.shape, minval=1.0 - 1e-2, maxval=1.0 + 1e-2)
     router_logits = jnp.einsum(
-        "bsm,me->bse", x.astype(jnp.float32), p["router"]["kernel"].astype(jnp.float32)
+        "bsm,me->bse", gate_in, p["router"]["kernel"].astype(jnp.float32)
     )
     noise = cfg.moe_noise_std if not deterministic else 0.0
     dispatch, combine, aux = top_k_gating(
-        router_logits, cfg.moe_top_k, capacity, rng=rng, noise_std=noise
+        router_logits, cfg.moe_top_k, capacity, rng=gate_rng, noise_std=noise,
+        rsample=(policy == "rsample" and not deterministic),
+        use_rts=(cfg.moe_use_rts and not deterministic),
     )
     dispatch_f = dispatch.astype(x.dtype)
     combine = combine.astype(x.dtype)
@@ -181,6 +234,18 @@ def moe_mlp_apply(cfg, p, x, *, deterministic=True, rng=None):
     expert_out = _expert_a2a(expert_out, getattr(cfg, "mesh", None), to_expert=False)
     # expert-sharded -> data-sharded: the return all_to_all
     y = jnp.einsum("bsec,ebcm->bsm", combine, expert_out)
+    if cfg.moe_use_residual:
+        # PR-MoE blend (reference moe/layer.py:118): out*c0 + dense(x)*c1
+        from ..models.transformer import _mlp_apply
+
+        res_p = jax.tree_util.tree_map(
+            lambda a: a.astype(x.dtype)
+            if jnp.issubdtype(a.dtype, jnp.floating) else a, p["res_mlp"])
+        dense = _mlp_apply(_dense_cfg(cfg), res_p, x).astype(x.dtype)
+        coef = jax.nn.softmax(
+            x.astype(jnp.float32) @ p["coef"]["kernel"].astype(jnp.float32)
+            + p["coef"]["bias"].astype(jnp.float32), axis=-1).astype(x.dtype)
+        y = y * coef[..., 0:1] + dense * coef[..., 1:]
     return y, aux * cfg.moe_aux_loss_weight
 
 
