@@ -1,3 +1,5 @@
-from .flops_profiler import FlopsProfiler, get_model_profile, transformer_train_flops
+from .flops_profiler import (FlopsProfiler, get_model_profile,
+                             get_module_profile, transformer_train_flops)
 
-__all__ = ["FlopsProfiler", "get_model_profile", "transformer_train_flops"]
+__all__ = ["FlopsProfiler", "get_model_profile", "get_module_profile",
+           "transformer_train_flops"]

@@ -98,26 +98,28 @@ def _fmt(n):
     return f"{n:.2f} E"
 
 
-def get_model_profile(model, batch, *, loss=False, n_iters=5, print_profile=True):
-    """Profile a model's forward (or loss) on a batch (reference
-    ``flops_profiler.get_model_profile``). Returns (flops, macs, params)."""
+def _profile_forward(model, batch, *, loss=False, n_iters=5):
+    """Shared scaffold: init params, compile the forward (or loss), measure.
+    Returns (params, stats)."""
     import jax.numpy as jnp
 
     from ..models import split_params_axes
 
     params, _ = split_params_axes(model.init(jax.random.PRNGKey(0)))
-    n_params = sum(int(np.prod(p.shape)) for p in jax.tree_util.tree_leaves(params))
-
     if loss:
         fn = lambda p: model.loss(p, batch)
-        prof = FlopsProfiler(fn).compile(params)
-        stats = prof.measure(params, n_iters=n_iters)
     else:
         ids = batch["input_ids"] if isinstance(batch, dict) else batch
         fn = lambda p: model.apply(p, jnp.asarray(ids))
-        prof = FlopsProfiler(fn).compile(params)
-        stats = prof.measure(params, n_iters=n_iters)
+    prof = FlopsProfiler(fn).compile(params)
+    return params, prof.measure(params, n_iters=n_iters)
 
+
+def get_model_profile(model, batch, *, loss=False, n_iters=5, print_profile=True):
+    """Profile a model's forward (or loss) on a batch (reference
+    ``flops_profiler.get_model_profile``). Returns (flops, macs, params)."""
+    params, stats = _profile_forward(model, batch, loss=loss, n_iters=n_iters)
+    n_params = sum(int(np.prod(p.shape)) for p in jax.tree_util.tree_leaves(params))
     flops = stats["flops"]
     macs = flops / 2
     if print_profile:
@@ -127,3 +129,134 @@ def get_model_profile(model, batch, *, loss=False, n_iters=5, print_profile=True
             f"achieved: {_fmt(stats['flops_per_s'])}FLOP/s"
         )
     return flops, macs, n_params
+
+
+# ---------------------------------------------------------------------------------
+# Per-module breakdown (reference profiler.py:66 print_model_profile: a tree of
+# params / MACs / latency per submodule with top-modules aggregation).
+#
+# The reference collects these with forward hooks on every nn.Module. Under XLA
+# the whole model is ONE fused program, so per-module walltime is not separately
+# observable; instead: params are grouped EXACTLY from the param tree, per-module
+# flops come from the analytic decomposition of the transformer forward, and
+# measured end-to-end latency is attributed proportionally to flops share (stated
+# in the report). The module rows sum to the whole-program totals by construction
+# — pinned by tests/unit/test_aux.py.
+# ---------------------------------------------------------------------------------
+def _module_param_counts(params):
+    """Group exact param counts by module path: top-level entries, with the
+    stacked ``blocks`` subtree split by submodule (attn/mlp/ln_*)."""
+    flat, _ = jax.tree_util.tree_flatten_with_path(params)
+    counts = {}
+    for path, leaf in flat:
+        keys = [str(getattr(p, "key", getattr(p, "idx", p))) for p in path]
+        if keys[0] == "blocks" and len(keys) > 1:
+            name = f"blocks/{keys[1]}"
+        else:
+            name = keys[0]
+        counts[name] = counts.get(name, 0) + int(np.prod(leaf.shape))
+    return counts
+
+
+def _module_flops(cfg, batch_size, seq_len):
+    """Analytic forward flops per module (2*in*out per matmul output element).
+
+    Embedding lookups are gathers (0 MACs, as the reference counts them); the
+    LM-head matmul is attributed to ``lm_head`` even when tied to ``wte``.
+    """
+    T = batch_size * seq_len
+    d = cfg.d_model
+    q_dim = cfg.n_heads * cfg.head_dim
+    kv_dim = cfg.kv_heads * cfg.head_dim
+    L = cfg.n_layers
+    attn_proj = 2 * T * d * (q_dim + 2 * kv_dim) + 2 * T * q_dim * d
+    attn_core = 4 * T * seq_len * cfg.n_heads * cfg.head_dim
+    if cfg.n_experts > 0:
+        # counts what the PROFILED forward executes: model.apply runs
+        # deterministic gating, whose default eval capacity is drop-free
+        # (C = s), so every expert processes E*b*C slots regardless of top_k
+        # (moe/sharded_moe.py moe_mlp_apply)
+        E = cfg.n_experts
+        if cfg.moe_eval_capacity_factor and cfg.moe_eval_capacity_factor > 0:
+            from ..moe.sharded_moe import expert_capacity
+
+            C = expert_capacity(seq_len, E, cfg.moe_top_k,
+                                cfg.moe_eval_capacity_factor,
+                                cfg.moe_min_capacity)
+        else:
+            C = seq_len
+        slots = batch_size * E * C
+        n_expert_matmuls = 3 if cfg.activation == "swiglu" else 2
+        mlp = 2 * T * d * E                                  # router
+        mlp += n_expert_matmuls * 2 * slots * d * cfg.d_ff   # expert compute
+        mlp += 2 * 2 * T * E * C * d                         # dispatch+combine einsums
+        if cfg.moe_use_residual:
+            n_res_matmuls = 3 if cfg.activation == "swiglu" else 2
+            mlp += n_res_matmuls * 2 * T * d * cfg.d_ff + 2 * T * d * 2
+    else:
+        mlp = 2 * 2 * T * d * cfg.d_ff
+        if cfg.activation == "swiglu":
+            mlp += 2 * T * d * cfg.d_ff
+    norm = 5 * T * d
+    flops = {
+        "wte": 0.0,
+        "blocks/attn": float(L * (attn_proj + attn_core)),
+        "blocks/mlp": float(L * mlp),
+        "blocks/ln_1": float(L * norm),
+        "blocks/ln_2": float(L * norm),
+        "lm_head": float(2 * T * d * cfg.vocab_size),
+    }
+    if getattr(cfg, "position_embedding", "") == "learned":
+        flops["wpe"] = 0.0
+    if getattr(cfg, "final_layernorm", True):
+        flops["ln_f"] = float(norm)
+    return flops
+
+
+def get_module_profile(model, batch, *, n_iters=5, print_profile=True):
+    """Per-module params/flops/latency breakdown + whole-program totals
+    (reference ``print_model_profile`` role).
+
+    Returns ``{"modules": {name: {params, flops, macs, latency_ms, flops_pct}},
+    "total": {params, flops, macs, latency_ms, xla_flops}}`` where the module
+    flops/params sum EXACTLY to the totals row.
+    """
+    ids = batch["input_ids"] if isinstance(batch, dict) else batch
+    b, s = np.asarray(ids).shape
+    params, stats = _profile_forward(model, batch, n_iters=n_iters)
+    latency_ms = stats["latency_s"] * 1e3
+
+    param_counts = _module_param_counts(params)
+    flops = _module_flops(model.config, b, s)
+    names = sorted(set(param_counts) | set(flops))
+    total_flops = sum(flops.values())
+    modules = {}
+    for name in names:
+        f = flops.get(name, 0.0)
+        share = f / total_flops if total_flops else 0.0
+        modules[name] = {
+            "params": param_counts.get(name, 0),
+            "flops": f,
+            "macs": f / 2,
+            "latency_ms": latency_ms * share,  # flops-proportional attribution
+            "flops_pct": 100.0 * share,
+        }
+    total = {
+        "params": sum(param_counts.values()),
+        "flops": total_flops,
+        "macs": total_flops / 2,
+        "latency_ms": latency_ms,
+        "xla_flops": stats["flops"],  # the compiler's own count, for reference
+    }
+    if print_profile:
+        top = sorted(modules.items(), key=lambda kv: -kv[1]["flops"])
+        lines = [f"{'module':<14} {'params':>10} {'flops':>10} {'lat ms':>8} {'%':>6}"]
+        for name, m in top:
+            lines.append(f"{name:<14} {_fmt(m['params']):>10} {_fmt(m['flops']):>10} "
+                         f"{m['latency_ms']:>8.2f} {m['flops_pct']:>5.1f}%")
+        lines.append(f"{'TOTAL':<14} {_fmt(total['params']):>10} "
+                     f"{_fmt(total['flops']):>10} {latency_ms:>8.2f} {'100.0%':>6} "
+                     f"(latency attributed by flops share; xla counted "
+                     f"{_fmt(total['xla_flops'])}flops)")
+        logger.info("\n".join(lines))
+    return {"modules": modules, "total": total}

@@ -277,12 +277,16 @@ def test_pr_moe_trains(devices8):
               "steps_per_print": 10**6}
     engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=config)
     batch = _batch(b=8)
+    p0 = engine.params["blocks"]["mlp"]
+    coef0 = np.asarray(p0["coef"]["kernel"]).copy()
+    res0 = np.asarray(p0["res_mlp"]["fc"]["kernel"]).copy()
     losses = [float(engine.train_batch(batch=batch)) for _ in range(5)]
     assert all(np.isfinite(l) for l in losses)
     assert losses[-1] < losses[0], losses
-    # the residual branch params exist and received gradients (changed)
-    p = engine.params
-    assert "res_mlp" in p["blocks"]["mlp"] and "coef" in p["blocks"]["mlp"]
+    # the residual branch is LIVE: its params received gradients
+    p = engine.params["blocks"]["mlp"]
+    assert not np.array_equal(coef0, np.asarray(p["coef"]["kernel"]))
+    assert not np.array_equal(res0, np.asarray(p["res_mlp"]["fc"]["kernel"]))
 
 
 def test_moe_preset_serves_with_training_parity():
