@@ -37,10 +37,13 @@ class TuneResult:
     status: str = "pending"  # pruned-oom | compile-failed | estimated | measured
 
     def row(self):
+        zero = self.config.get("zero_optimization", {})
         return {
             "mesh": self.config.get("mesh"),
             "micro": self.config.get("train_micro_batch_size_per_gpu"),
-            "zero": self.config.get("zero_optimization", {}).get("stage"),
+            "gas": self.config.get("gradient_accumulation_steps"),
+            "zero": zero.get("stage"),
+            "offload": zero.get("offload_optimizer", {}).get("device"),
             "remat": self.config.get("_remat"),
             "peak_gb": round(self.peak_bytes / 1e9, 3) if self.peak_bytes >= 0 else None,
             "est_ms": round(self.est_time * 1e3, 2) if self.est_time >= 0 else None,
@@ -48,6 +51,31 @@ class TuneResult:
             if self.measured_tokens_per_s >= 0 else None,
             "status": self.status,
         }
+
+    env: dict = dataclasses.field(default_factory=dict)
+
+    def key(self):
+        """Stable identity of the candidate (ledger key). Includes the
+        measurement environment — batch shape, device count/memory, roofline
+        constants — so a ledger from a different workload or machine is never
+        silently replayed."""
+        import hashlib
+
+        blob = json.dumps({"config": self.config, "env": self.env},
+                          sort_keys=True, default=str)
+        return hashlib.sha1(blob.encode()).hexdigest()[:16]
+
+    def to_ledger(self):
+        return {"key": self.key(), "row": self.row(),
+                "peak_bytes": self.peak_bytes, "est_time": self.est_time,
+                "measured_tokens_per_s": self.measured_tokens_per_s,
+                "status": self.status}
+
+    def restore(self, entry):
+        self.peak_bytes = entry["peak_bytes"]
+        self.est_time = entry["est_time"]
+        self.measured_tokens_per_s = entry["measured_tokens_per_s"]
+        self.status = entry["status"]
 
 
 def _factor_meshes(n_devices, axes=("data", "model")):
@@ -67,13 +95,44 @@ class Autotuner:
     """
 
     def __init__(self, model_factory, base_config, *, device_memory_bytes=None,
-                 peak_flops=None, hbm_bw=None):
+                 peak_flops=None, hbm_bw=None, results_dir=None):
         self.model_factory = model_factory
         self.base_config = dict(base_config)
         self.device_memory = device_memory_bytes or self._detect_memory()
         # roofline constants for the estimate (defaults: v5e-ish)
         self.peak_flops = peak_flops or 100e12
         self.hbm_bw = hbm_bw or 6e11
+        # experiment ledger (reference autotuning_results/ contract,
+        # autotuner.py:404): every candidate's outcome is appended to
+        # <results_dir>/ledger.jsonl as it lands, and a re-run resumes from it
+        # (already-explored candidates skip straight to their recorded result)
+        self.results_dir = results_dir
+
+    # ------------------------------------------------------------------
+    def _ledger_path(self):
+        import os
+
+        os.makedirs(self.results_dir, exist_ok=True)
+        return os.path.join(self.results_dir, "ledger.jsonl")
+
+    def _load_ledger(self):
+        import os
+
+        entries = {}
+        if self.results_dir and os.path.isfile(self._ledger_path()):
+            with open(self._ledger_path()) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    e = json.loads(line)
+                    entries[e["key"]] = e  # last write wins
+        return entries
+
+    def _append_ledger(self, res):
+        if self.results_dir:
+            with open(self._ledger_path(), "a") as f:
+                f.write(json.dumps(res.to_ledger()) + "\n")
 
     @staticmethod
     def _detect_memory():
@@ -86,21 +145,30 @@ class Autotuner:
     def search_space(self, n_devices, global_batch):
         zero_stages = [0, 1, 2, 3]
         remats = ["minimal", None]
+        offloads = [None, "cpu"]
         micros = [m for m in (1, 2, 4, 8, 16)
                   if global_batch % (m * 1) == 0]
         meshes = _factor_meshes(n_devices)
         cands = []
-        for mesh, zero, remat, micro in itertools.product(
-                meshes, zero_stages, remats, micros):
+        for mesh, zero, remat, micro, offload in itertools.product(
+                meshes, zero_stages, remats, micros, offloads):
             dp = mesh["data"]
             if global_batch % (micro * dp):
+                continue
+            if offload and zero < 1:
+                # optimizer offload needs sharded optimizer state (ZeRO >= 1),
+                # matching the reference's offload/stage coupling
                 continue
             cfg = dict(self.base_config)
             cfg["mesh"] = mesh
             cfg["zero_optimization"] = {"stage": zero}
+            if offload:
+                cfg["zero_optimization"]["offload_optimizer"] = {"device": offload}
             cfg["train_batch_size"] = global_batch
             cfg["train_micro_batch_size_per_gpu"] = micro
-            cfg.pop("gradient_accumulation_steps", None)
+            # explicit: micro x dp fixes gas via the batch triangle; recording
+            # it makes the swept grad-accum dimension visible in the ledger
+            cfg["gradient_accumulation_steps"] = global_batch // (micro * dp)
             cfg["_remat"] = remat
             cands.append(cfg)
         return cands
@@ -132,6 +200,11 @@ class Autotuner:
             engine.params, sharded, jnp.asarray(1.0, jnp.float32), rng)
         return lowered.compile(), sharded, rng
 
+    # host link bandwidth proxy for the offload transfer penalty (optimizer
+    # step stages grads down + params back over the host link once per
+    # GLOBAL batch; ~10 GB/s is a conservative PCIe-class figure)
+    HOST_LINK_BW = 1e10
+
     def _estimate(self, compiled):
         mem = compiled.memory_analysis()
         peak = (mem.temp_size_in_bytes + mem.argument_size_in_bytes +
@@ -141,6 +214,25 @@ class Autotuner:
         bytes_ = cost.get("bytes accessed", 0.0)
         est = max(flops / self.peak_flops, bytes_ / self.hbm_bw)
         return peak, est
+
+    def _opt_state_bytes(self, n_params, cfg):
+        """Device-resident optimizer bytes the fwd_bwd lowering can't see:
+        adam m+v plus the fp32 master, sharded over data for ZeRO >= 1,
+        zero when offloaded to the host."""
+        zero_cfg = cfg.get("zero_optimization", {})
+        if zero_cfg.get("offload_optimizer"):
+            return 0
+        shard = cfg["mesh"]["data"] if zero_cfg.get("stage", 0) >= 1 else 1
+        return 3 * n_params * 4 // shard
+
+    def _offload_penalty(self, n_params, cfg):
+        """est_time surcharge per MICRO step for host-offloaded optimizers:
+        grads down + params back (2x n_params fp32) once per global batch,
+        amortized over the accumulation steps."""
+        if not cfg.get("zero_optimization", {}).get("offload_optimizer"):
+            return 0.0
+        gas = max(cfg.get("gradient_accumulation_steps", 1), 1)
+        return (4.0 * n_params * 4 / self.HOST_LINK_BW) / gas
 
     # ------------------------------------------------------------------
     def tune(self, batch, *, measured_topk=3, measure_steps=3, max_candidates=None):
@@ -154,27 +246,70 @@ class Autotuner:
         cands = self.search_space(n_devices, global_batch)
         if max_candidates:
             cands = cands[:max_candidates]
+        env = {
+            "batch_shape": {k: list(np.asarray(v).shape) for k, v in batch.items()},
+            "n_devices": n_devices,
+            "device_memory": self.device_memory,
+            "peak_flops": self.peak_flops,
+            "hbm_bw": self.hbm_bw,
+        }
+        ledger = self._load_ledger()
         results = []
+        n_resumed = 0
+        est_cache = {}   # offload twins share one lowering: the fwd_bwd
+        # program is identical (offload only changes the host-side step)
         for cfg in cands:
-            res = TuneResult(config=cfg)
+            res = TuneResult(config=cfg, env=env)
             results.append(res)
+            prev = ledger.get(res.key())
+            if prev and prev["status"] != "pending":
+                res.restore(prev)   # resume: skip re-exploring this candidate
+                n_resumed += 1
+                continue
+            zero_cfg = dict(cfg.get("zero_optimization", {}))
+            zero_cfg.pop("offload_optimizer", None)
+            est_key = json.dumps(
+                {**{k: v for k, v in cfg.items() if k != "zero_optimization"},
+                 "zero_optimization": zero_cfg},
+                sort_keys=True, default=str)
             try:
-                engine = self._build_engine(cfg)
-                compiled, _, _ = self._lower_step(engine, batch)
-                res.peak_bytes, res.est_time = self._estimate(compiled)
+                if est_key in est_cache:
+                    fwd_peak, fwd_est, n_params = est_cache[est_key]
+                else:
+                    engine = self._build_engine(cfg)
+                    compiled, _, _ = self._lower_step(engine, batch)
+                    fwd_peak, fwd_est = self._estimate(compiled)
+                    n_params = engine.num_parameters
+                    est_cache[est_key] = (fwd_peak, fwd_est, n_params)
             except Exception as e:  # compile/shape failures prune the candidate
                 res.status = "compile-failed"
                 logger.debug(f"autotune candidate failed: {cfg}: {e}")
+                self._append_ledger(res)
                 continue
+            # the lowering covers fwd+bwd only; optimizer residency and the
+            # offload transfer tax are added analytically so offload twins
+            # differ where it matters (peak memory, per-step time)
+            res.peak_bytes = fwd_peak + self._opt_state_bytes(n_params, cfg)
+            res.est_time = fwd_est + self._offload_penalty(n_params, cfg)
             if res.peak_bytes > self.device_memory:
                 res.status = "pruned-oom"
+                self._append_ledger(res)
                 continue
             res.status = "estimated"
+            self._append_ledger(res)
+        if n_resumed:
+            log_dist(f"autotune: resumed {n_resumed}/{len(cands)} candidates "
+                     f"from {self._ledger_path()}", ranks=[0])
 
         engine = None  # drop the last estimation-phase engine before measuring
-        live = [r for r in results if r.status == "estimated"]
-        live.sort(key=lambda r: r.est_time)
+        # rank by time per GLOBAL batch: the lowering is one micro step, so a
+        # small-micro/high-gas candidate must pay its accumulation factor
+        live = [r for r in results if r.status in ("estimated", "measured")]
+        live.sort(key=lambda r: r.est_time
+                  * max(r.config.get("gradient_accumulation_steps", 1), 1))
         for res in live[:measured_topk]:
+            if res.status == "measured":
+                continue   # resumed from the ledger; don't re-measure
             # drop the previous candidates' executables/buffers first — dozens
             # of live compiled engines on an emulated many-device CPU platform
             # starve the scheduler (observed as spurious collective aborts)
@@ -197,6 +332,7 @@ class Autotuner:
             dt = (time.perf_counter() - t0) / measure_steps
             res.measured_tokens_per_s = tokens / dt
             res.status = "measured"
+            self._append_ledger(res)   # updated row; last write wins on resume
             del engine
 
         measured = [r for r in results if r.status == "measured"]
@@ -209,6 +345,12 @@ class Autotuner:
         # engine's gradient_checkpointing flag (engine.py sets module remat)
         out = {k: v for k, v in best.config.items() if not k.startswith("_")}
         out["gradient_checkpointing"] = best.config.get("_remat") is not None
+        if self.results_dir:
+            import os
+
+            with open(os.path.join(self.results_dir, "best_config.json"),
+                      "w") as f:
+                json.dump(out, f, indent=1)
         return out, results
 
     @staticmethod

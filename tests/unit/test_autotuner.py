@@ -58,3 +58,62 @@ def test_oom_candidates_are_pruned_without_running():
     tuner = Autotuner(_factory(), BASE, device_memory_bytes=1024)
     with pytest.raises(RuntimeError, match="no viable"):
         tuner.tune(batch, measured_topk=1, max_candidates=6)
+
+
+def test_search_space_sweeps_offload_and_gas():
+    tuner = Autotuner(_factory(), BASE, device_memory_bytes=2 ** 40)
+    cands = tuner.search_space(n_devices=8, global_batch=16)
+    offloads = {c["zero_optimization"].get("offload_optimizer", {}).get("device")
+                for c in cands}
+    assert offloads == {None, "cpu"}
+    # offload only rides sharded optimizer state (ZeRO >= 1)
+    for c in cands:
+        if c["zero_optimization"].get("offload_optimizer"):
+            assert c["zero_optimization"]["stage"] >= 1
+    # grad accumulation is explicit and satisfies the batch triangle
+    for c in cands:
+        gas = c["gradient_accumulation_steps"]
+        assert gas >= 1
+        assert (c["train_micro_batch_size_per_gpu"] * gas
+                * c["mesh"]["data"]) == 16
+    assert any(c["gradient_accumulation_steps"] > 1 for c in cands)
+
+
+def test_ledger_persists_and_resumes(tmp_path):
+    """The reference's autotuning_results/ contract: every candidate's outcome
+    lands in a ledger; a re-run resumes from it without re-exploring."""
+    import json as _json
+
+    rng = np.random.RandomState(0)
+    batch = {"input_ids": rng.randint(0, 128, (8, 32)).astype(np.int32)}
+    rdir = str(tmp_path / "autotuning_results")
+    tuner = Autotuner(_factory(), BASE, device_memory_bytes=2 ** 40,
+                      results_dir=rdir)
+    best, results = tuner.tune(batch, measured_topk=1, measure_steps=1,
+                               max_candidates=4)
+    ledger = [_json.loads(l) for l in open(f"{rdir}/ledger.jsonl")]
+    assert len(ledger) >= len([r for r in results if r.status != "pending"])
+    assert all({"key", "row", "status"} <= set(e) for e in ledger)
+    assert (tmp_path / "autotuning_results" / "best_config.json").exists()
+    best_on_disk = _json.load(open(f"{rdir}/best_config.json"))
+    assert best_on_disk["mesh"] == best["mesh"]
+
+    # second run: every candidate resumes from the ledger — no engine builds
+    # during the estimation phase (only the measured top-k re-runs are live)
+    builds = []
+    orig = Autotuner._build_engine
+
+    def counting_build(self, cfg):
+        builds.append(cfg)
+        return orig(self, cfg)
+
+    tuner2 = Autotuner(_factory(), BASE, device_memory_bytes=2 ** 40,
+                       results_dir=rdir)
+    import unittest.mock as mock
+
+    with mock.patch.object(Autotuner, "_build_engine", counting_build):
+        best2, results2 = tuner2.tune(batch, measured_topk=1, measure_steps=1,
+                                      max_candidates=4)
+    # fully served by the ledger: no estimation builds AND no re-measurement
+    assert builds == []
+    assert [r.status for r in results2] == [r.status for r in results]
