@@ -35,6 +35,9 @@ class TuneResult:
     est_time: float = -1.0
     measured_tokens_per_s: float = -1.0
     status: str = "pending"  # pruned-oom | compile-failed | estimated | measured
+    # measurement environment (batch shape, device count/memory, roofline
+    # constants) — part of the ledger key, see key()
+    env: dict = dataclasses.field(default_factory=dict)
 
     def row(self):
         zero = self.config.get("zero_optimization", {})
@@ -51,8 +54,6 @@ class TuneResult:
             if self.measured_tokens_per_s >= 0 else None,
             "status": self.status,
         }
-
-    env: dict = dataclasses.field(default_factory=dict)
 
     def key(self):
         """Stable identity of the candidate (ledger key). Includes the
@@ -232,7 +233,7 @@ class Autotuner:
         if not cfg.get("zero_optimization", {}).get("offload_optimizer"):
             return 0.0
         gas = max(cfg.get("gradient_accumulation_steps", 1), 1)
-        return (4.0 * n_params * 4 / self.HOST_LINK_BW) / gas
+        return (2.0 * n_params * 4 / self.HOST_LINK_BW) / gas
 
     # ------------------------------------------------------------------
     def tune(self, batch, *, measured_topk=3, measure_steps=3, max_candidates=None):
@@ -247,7 +248,7 @@ class Autotuner:
         if max_candidates:
             cands = cands[:max_candidates]
         env = {
-            "batch_shape": {k: list(np.asarray(v).shape) for k, v in batch.items()},
+            "batch_shape": {k: list(np.shape(v)) for k, v in batch.items()},
             "n_devices": n_devices,
             "device_memory": self.device_memory,
             "peak_flops": self.peak_flops,
@@ -262,8 +263,11 @@ class Autotuner:
             res = TuneResult(config=cfg, env=env)
             results.append(res)
             prev = ledger.get(res.key())
-            if prev and prev["status"] != "pending":
-                res.restore(prev)   # resume: skip re-exploring this candidate
+            if prev and prev["status"] not in ("pending", "compile-failed"):
+                # resume: skip re-exploring. compile-failed entries are NOT
+                # replayed — the failure may have been a since-fixed bug, and
+                # retrying a failed lowering is cheap
+                res.restore(prev)
                 n_resumed += 1
                 continue
             zero_cfg = dict(cfg.get("zero_optimization", {}))

@@ -463,8 +463,9 @@ class DeepSpeedEngine:
             if warn:
                 logger.warning(
                     "pipeline schedule '1f1b' does not compose with sequence "
-                    "parallelism (mesh seq=%d); falling back to gpipe",
-                    self.seq_parallel_size)
+                    "parallelism (mesh seq=%d); falling back to gpipe — a "
+                    "measured wontfix: root cause and activation-cost numbers "
+                    "in PARITY.md 'Known gaps'", self.seq_parallel_size)
             use_1f1b = False
         if use_1f1b and self.mp_world_size > 1 and \
                 getattr(self.module.config, "n_experts", 0) > 0:
