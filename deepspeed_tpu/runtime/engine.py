@@ -1056,16 +1056,21 @@ class DeepSpeedEngine:
         return mean_loss
 
     def eval_batch(self, batch):
-        """Loss without grads. Runs the non-pipelined forward even on pipe meshes
-        (eval has no accumulation window, so there is no microbatch contract; the
-        plain scan path reads the pipe-sharded layer stack via XLA's partitioner)."""
+        """Loss without grads. On pipe meshes this runs the PIPELINED forward
+        with a single microbatch: weights stay stage-local and activations move
+        by ppermute, where the previous non-pipelined eval read the pipe-sharded
+        layer stack through the auto partitioner — an all-gather of every block
+        weight per eval step (brutal at multi-B params). M=1 keeps eval free of
+        any microbatch divisibility contract; the (S-1)/S bubble is irrelevant
+        at eval rates."""
         if self._eval_fn is None:
             module = self.module
             if self.pipe_stages > 1:
                 import dataclasses
 
                 module = type(self.module)(
-                    dataclasses.replace(self.module.config, pipeline_stages=1)
+                    dataclasses.replace(self.module.config,
+                                        pipeline_microbatches=1)
                 )
             with self.mesh:
                 self._eval_fn = jax.jit(lambda p, b: module.loss(p, b))

