@@ -1,0 +1,17 @@
+"""Shared helpers for the benchmark/profiling tools."""
+
+import os
+
+
+def maybe_force_cpu():
+    """BENCH_FORCE_CPU=1: pin jax to the host CPU backend (smoke/debug runs).
+
+    The axon boot hook programmatically sets jax_platforms="axon,cpu", which
+    overrides the JAX_PLATFORMS env var — forcing CPU must happen at the
+    config level after import (same mechanism as bench.py's _maybe_force_cpu,
+    kept separate there so the driver-contract file stays standalone).
+    """
+    if os.environ.get("BENCH_FORCE_CPU") == "1":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")

@@ -18,6 +18,9 @@ import numpy as np
 
 
 def main():
+    from _common import maybe_force_cpu
+
+    maybe_force_cpu()
     import jax
     import jax.numpy as jnp
 

@@ -92,11 +92,9 @@ def main():
     ap.add_argument("--repeats", type=int, default=10)
     args = ap.parse_args()
 
-    if os.environ.get("BENCH_FORCE_CPU") == "1":
-        import jax
+    from _common import maybe_force_cpu
 
-        jax.config.update("jax_platforms", "cpu")
-
+    maybe_force_cpu()
     import jax
 
     platform = jax.devices()[0].platform

@@ -6,9 +6,12 @@ losses can be attributed to a phase instead of guessed at.
 """
 
 import os
+import sys
 import time
 
 import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def timeit(fn, *args, n=5, warmup=2):
@@ -29,14 +32,19 @@ def timeit(fn, *args, n=5, warmup=2):
 
 
 def main():
+    from _common import maybe_force_cpu
+
+    maybe_force_cpu()
     import jax
     import jax.numpy as jnp
 
     import deepspeed_tpu
     from deepspeed_tpu.models import CausalLM, TransformerConfig
 
+    layers = int(os.environ.get("BENCH_LAYERS", "24"))
+    seq = int(os.environ.get("BENCH_SEQ", "1024"))
     cfg = TransformerConfig(
-        vocab_size=50304, max_seq_len=1024, n_layers=24, n_heads=16,
+        vocab_size=50304, max_seq_len=seq, n_layers=layers, n_heads=16,
         d_model=1024, d_ff=4096, compute_dtype=jnp.bfloat16,
         attention_impl=os.environ.get("BENCH_ATTN", "xla"),
         remat=os.environ.get("BENCH_NOREMAT", "") != "1",
@@ -44,7 +52,7 @@ def main():
     )
     model = CausalLM(cfg)
     b = int(os.environ.get("BENCH_BATCH", "12"))
-    s = 1024
+    s = seq
     config = {
         "train_batch_size": b,
         "optimizer": {"type": "adamw", "params": {"lr": 1e-4}},

@@ -31,6 +31,9 @@ def measure(engine, batch, steps=8):
 
 
 def main():
+    from _common import maybe_force_cpu
+
+    maybe_force_cpu()
     import jax
     import jax.numpy as jnp
 
@@ -38,11 +41,12 @@ def main():
     import deepspeed_tpu
     from deepspeed_tpu.models import CausalLM, TransformerConfig
 
-    n_params = 354.9e6
     peak = 197e12  # v5e bf16
 
+    layers = int(os.environ.get("BENCH_LAYERS", "24"))
+    seq = int(os.environ.get("BENCH_SEQ", "1024"))
     base_model = dict(
-        vocab_size=50304, max_seq_len=1024, n_layers=24, n_heads=16,
+        vocab_size=50304, max_seq_len=seq, n_layers=layers, n_heads=16,
         d_model=1024, d_ff=4096, compute_dtype=jnp.bfloat16,
         remat=True, remat_policy="minimal", scan_layers=True, fused_ce=True,
         attention_impl="xla")
@@ -82,9 +86,9 @@ def main():
             model = CausalLM(TransformerConfig(**{**base_model, **m_over}))
             engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=cfg)
             batch = {"input_ids": rng.randint(
-                0, 50304, (b, 1024)).astype(np.int32)}
+                0, 50304, (b, seq)).astype(np.int32)}
             tps = measure(engine, batch)
-            mfu = tps * 6 * n_params / peak
+            mfu = tps * 6 * engine.num_parameters / peak
             print(f"{name:<16} {tps:>10.0f} {mfu:>7.4f}", flush=True)
             if tps > best[1]:
                 best = (name, tps)
