@@ -1,0 +1,70 @@
+"""ctypes binding for the native host-side Adam/Adagrad (csrc/adam/cpu_adam.cpp).
+
+The reference's CPUAdamBuilder loads an AVX Adam extension for ZeRO-Offload
+(``deepspeed/ops/adam/cpu_adam.py``); this is the same role over numpy fp32
+buffers, used by ``runtime/offload.py`` when the offloaded optimizer is
+adam/adamw/adagrad. Falls back cleanly (``available()`` False) when g++ is
+missing or the build fails.
+"""
+
+import ctypes
+
+import numpy as np
+
+from ..utils.logging import logger
+from .op_builder.builder import CPUAdamBuilder
+
+_lib = None
+_load_failed = False
+
+
+def _load():
+    global _lib, _load_failed
+    if _lib is None and not _load_failed:
+        try:
+            lib = CPUAdamBuilder().load()
+            lib.ds_cpu_adam_step.argtypes = [
+                ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
+                ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
+                ctypes.c_int64, ctypes.c_int64, ctypes.c_float, ctypes.c_float,
+                ctypes.c_float, ctypes.c_float, ctypes.c_float, ctypes.c_int,
+                ctypes.c_int, ctypes.c_int, ctypes.c_float,
+            ]
+            lib.ds_cpu_adagrad_step.argtypes = [
+                ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
+                ctypes.POINTER(ctypes.c_float), ctypes.c_int64, ctypes.c_float,
+                ctypes.c_float, ctypes.c_float, ctypes.c_int, ctypes.c_float,
+            ]
+            _lib = lib
+        except Exception as e:
+            logger.warning(f"native cpu_adam unavailable ({e}); "
+                           f"offload falls back to the jitted host step")
+            _load_failed = True
+    return _lib
+
+
+def available():
+    return _load() is not None
+
+
+def _fptr(a):
+    assert a.dtype == np.float32 and a.flags["C_CONTIGUOUS"]
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+
+
+def adam_step_inplace(p, g, m, v, *, step, lr, betas, eps, weight_decay,
+                      adamw_mode, bias_correction, decay, grad_scale=1.0):
+    """In-place fused Adam(W) on fp32 numpy leaves (p/m/v mutated)."""
+    _lib.ds_cpu_adam_step(
+        _fptr(p), _fptr(g), _fptr(m), _fptr(v), p.size, int(step), float(lr),
+        float(betas[0]), float(betas[1]), float(eps), float(weight_decay),
+        int(bool(adamw_mode)), int(bool(bias_correction)), int(bool(decay)),
+        float(grad_scale))
+
+
+def adagrad_step_inplace(p, g, s, *, lr, eps, weight_decay, decay,
+                         grad_scale=1.0):
+    """In-place Adagrad on fp32 numpy leaves (p/s mutated)."""
+    _lib.ds_cpu_adagrad_step(
+        _fptr(p), _fptr(g), _fptr(s), p.size, float(lr), float(eps),
+        float(weight_decay), int(bool(decay)), float(grad_scale))

@@ -39,11 +39,25 @@ class OpBuilder:
         return which("g++") is not None
 
     def _signature(self):
+        import platform
+
         h = hashlib.sha256()
         for src in self.sources():
             with open(src, "rb") as f:
                 h.update(f.read())
         h.update(" ".join(self.EXTRA_FLAGS).encode())
+        # -march=native binaries are host-ISA-specific, and dlopen does NOT
+        # validate ISA extensions (a foreign cache would SIGILL at call time,
+        # not rebuild) — key the cache on the host's arch + feature flags
+        h.update(platform.machine().encode())
+        try:
+            with open("/proc/cpuinfo") as f:
+                for line in f:
+                    if line.startswith("flags"):
+                        h.update(line.encode())
+                        break
+        except OSError:
+            pass
         return h.hexdigest()[:16]
 
     def lib_path(self):
@@ -94,3 +108,20 @@ class AsyncIOBuilder(OpBuilder):
 
     NAME = "ds_aio"
     SOURCES = ("csrc/aio/ds_aio.cpp",)
+
+
+class CPUAdamBuilder(OpBuilder):
+    """Reference ``op_builder/cpu_adam.py:10`` CPUAdamBuilder -> csrc/adam.
+
+    -march=native + OpenMP: the simd pragma loops compile to the host's widest
+    vector ISA (the reference's hand-written simd.h intrinsics), and the
+    parallel-for spreads a leaf across cores. The cache key includes the host
+    arch + cpu flags (see _signature) so a binary built elsewhere is never
+    loaded. No -ffast-math: linking it pulls crtfastmath.o into the .so, and
+    dlopen would then set FTZ/DAZ process-wide, silently changing float
+    semantics for every host computation in the process.
+    """
+
+    NAME = "ds_cpu_adam"
+    SOURCES = ("csrc/adam/cpu_adam.cpp",)
+    EXTRA_FLAGS = ("-fopenmp", "-march=native")

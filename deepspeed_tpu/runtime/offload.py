@@ -92,6 +92,51 @@ class OffloadedOptimizer:
         self.device = device
         self.cpu = _cpu_device()
 
+        # Native fused host step (reference CPUAdamBuilder role,
+        # csrc/adam/cpu_adam.cpp): in-place SIMD/OpenMP update over numpy
+        # leaves for adam/adamw/adagrad on the full-host path. Decided BEFORE
+        # the jax masters/state are built — the native path keeps everything
+        # in numpy, and building the XLA-CPU copies first would transiently
+        # double host RAM on exactly the large-model configs offload targets.
+        # Opt out with DS_TPU_NATIVE_CPU_OPT=0; any ineligibility falls back
+        # to the jitted XLA-CPU step transparently.
+        self._native = None
+        if device == "cpu" and \
+                os.environ.get("DS_TPU_NATIVE_CPU_OPT", "1") != "0":
+            from ..ops import cpu_adam_native
+            from ..ops.optimizers import Adam, Adagrad
+
+            if type(optimizer) in (Adam, Adagrad) and cpu_adam_native.available():
+                self._native = "adam" if isinstance(optimizer, Adam) else "adagrad"
+
+        if self._native:
+            from ..ops.optimizers import _mask_like
+
+            keys, leaves, treedef = _leaf_paths(master_params)
+            # explicit copy: device_get returns READ-ONLY buffers
+            np_masters = [np.array(jax.device_get(x), np.float32, copy=True)
+                          for x in leaves]
+            # the masters tree aliases the SAME mutable numpy buffers the
+            # kernels update in place; _device_params reads them fresh
+            self.masters = jax.tree_util.tree_unflatten(treedef, np_masters)
+            self._nat_masters = np_masters
+            self._nat_treedef = treedef
+            self._nat_decay = [bool(np.asarray(d)) for d in
+                               _leaf_paths(_mask_like(wd_mask, self.masters))[1]]
+            if self._native == "adam":
+                self._nat_m = [np.zeros_like(x) for x in np_masters]
+                self._nat_v = [np.zeros_like(x) for x in np_masters]
+            else:
+                self._nat_s = [np.zeros_like(x) for x in np_masters]
+            self._nat_step = 0
+            self.store = None
+            self.state = None
+            self._full_update = None
+            self._leaf_update = {}
+            log_dist(f"native cpu_{self._native}: fused host step over "
+                     f"{len(np_masters)} leaves", ranks=[0])
+            return
+
         # fp32 masters in host RAM (committed to the CPU backend)
         self.masters = jax.tree_util.tree_map(
             lambda p: jax.device_put(np.asarray(jax.device_get(p), np.float32),
@@ -166,7 +211,9 @@ class OffloadedOptimizer:
         if not np.isfinite(float(norm)):
             return self._device_params(), norm, True
         factor = self._clip_factor(norm)
-        if self.store is None:
+        if self._native:
+            self._native_step(grads_host, float(lr), float(factor))
+        elif self.store is None:
             if self._full_update is None:
                 def update(masters, state, grads, lr, factor):
                     grads = jax.tree_util.tree_map(lambda g: g * factor, grads)
@@ -181,6 +228,29 @@ class OffloadedOptimizer:
         else:
             self._nvme_step(grads_host, lr, factor)
         return self._device_params(), norm, False
+
+    def _native_step(self, grads_host, lr, factor):
+        """Fused in-place host update (csrc/adam/cpu_adam.cpp) — one kernel
+        call per leaf, masters/moments mutated in their numpy buffers."""
+        from ..ops import cpu_adam_native
+
+        opt = self.optimizer
+        grads = [np.ascontiguousarray(np.asarray(jax.device_get(g), np.float32))
+                 for g in _leaf_paths(grads_host)[1]]
+        self._nat_step += 1
+        for i, (p, g) in enumerate(zip(self._nat_masters, grads)):
+            if self._native == "adam":
+                cpu_adam_native.adam_step_inplace(
+                    p, g, self._nat_m[i], self._nat_v[i],
+                    step=self._nat_step, lr=lr, betas=opt.betas, eps=opt.eps,
+                    weight_decay=opt.weight_decay, adamw_mode=opt.adam_w_mode,
+                    bias_correction=opt.bias_correction,
+                    decay=self._nat_decay[i], grad_scale=factor)
+            else:
+                cpu_adam_native.adagrad_step_inplace(
+                    p, g, self._nat_s[i], lr=lr, eps=opt.eps,
+                    weight_decay=opt.weight_decay, decay=self._nat_decay[i],
+                    grad_scale=factor)
 
     # ------------------------------------------------------------------------------
     def _nvme_leaf_update(self, shape_dtype_key, master, grad, heads, lr, factor,
@@ -250,6 +320,16 @@ class OffloadedOptimizer:
     # checkpoint surface (engine save/load)
     # ------------------------------------------------------------------------------
     def state_for_checkpoint(self):
+        if self._native:
+            unflat = lambda leaves: jax.tree_util.tree_unflatten(
+                self._nat_treedef, [np.asarray(l) for l in leaves])
+            state = {"step": np.asarray(self._nat_step, np.int32)}
+            if self._native == "adam":
+                state["exp_avg"] = unflat(self._nat_m)
+                state["exp_avg_sq"] = unflat(self._nat_v)
+            else:
+                state["sum_sq"] = unflat(self._nat_s)
+            return state
         if self.store is None:
             return self.state
         state = {"step": jnp.asarray(self.step_count)}
@@ -263,6 +343,14 @@ class OffloadedOptimizer:
         return state
 
     def load_state(self, state):
+        if self._native:
+            self._nat_step = int(np.asarray(state["step"]))
+            heads = (("exp_avg", self._nat_m), ("exp_avg_sq", self._nat_v)) \
+                if self._native == "adam" else (("sum_sq", self._nat_s),)
+            for name, bufs in heads:
+                for buf, leaf in zip(bufs, _leaf_paths(state[name])[1]):
+                    buf[...] = np.asarray(jax.device_get(leaf), np.float32)
+            return
         if self.store is None:
             self.state = jax.tree_util.tree_map(
                 lambda l: jax.device_put(np.asarray(l), self.cpu), state)
@@ -275,6 +363,12 @@ class OffloadedOptimizer:
         self.store.drain()
 
     def load_masters(self, params):
+        if self._native:
+            # refill the live numpy buffers in place (the masters tree keeps
+            # aliasing them)
+            for buf, leaf in zip(self._nat_masters, _leaf_paths(params)[1]):
+                buf[...] = np.asarray(jax.device_get(leaf), np.float32)
+            return
         self.masters = jax.tree_util.tree_map(
             lambda p: jax.device_put(np.asarray(jax.device_get(p), np.float32),
                                      self.cpu), params)
