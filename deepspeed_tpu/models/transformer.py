@@ -532,9 +532,16 @@ def stack_apply(cfg, stacked_params, x, mask=None, rope=None, alibi=None,
                 jnp.where(keep, aux_i, jnp.zeros_like(aux_i)))
 
     aux = jnp.zeros((), jnp.float32)
-    if not cfg.scan_layers or local_pattern is not None:
-        # unrolled: per-layer mask selection stays a python choice (global
-        # layers keep mask=None -> flash-eligible)
+    # Banded local attention scans too: the per-layer global/local choice is a
+    # traced boolean scanned alongside the stacked weights, selecting between
+    # the two precomputed [s, s] masks in-graph — compile time stays constant
+    # in depth. Only pallas attention keeps the unrolled loop (an explicit
+    # mask forces the kernels' dense fallback, so the python-level mask=None
+    # on global layers is what keeps them kernel-eligible there).
+    unrolled = not cfg.scan_layers or (
+        local_pattern is not None
+        and cfg.attention_impl in ("flash", "block_sparse"))
+    if unrolled:
         for i in range(cfg.n_layers):
             p_i = gather_constraint(
                 jax.tree_util.tree_map(lambda a: a[i], stacked_params))
@@ -546,16 +553,29 @@ def stack_apply(cfg, stacked_params, x, mask=None, rope=None, alibi=None,
             aux = aux + aux_i
         return x, aux
 
-    def scan_fn(carry, xs):
-        h, i, aux = carry
-        p = gather_constraint(xs)
+    def scan_step(h, i, aux, p, m_i):
+        p = gather_constraint(p)
         rng_i = jax.random.fold_in(dropout_rng, i) if dropout_rng is not None else None
-        h_new, aux_i = body(p, h, rng_i, mask)
+        h_new, aux_i = body(p, h, rng_i, m_i)
         h, aux_i = pld_select(i, h_new, h, aux_i, rng_i)
-        return (h, i + 1, aux + aux_i), None
+        return h, i + 1, aux + aux_i
+
+    if local_pattern is not None:
+        # gmask was built alongside local_mask above; the per-layer choice is
+        # a traced flag scanned with the weights
+        def scan_fn(carry, xs):
+            p, is_local = xs
+            return scan_step(*carry, p, jnp.where(is_local, local_mask, gmask)), None
+
+        xs_in = (stacked_params, jnp.asarray(local_pattern))
+    else:
+        def scan_fn(carry, xs):
+            return scan_step(*carry, xs, mask), None
+
+        xs_in = stacked_params
 
     (x, _, aux), _ = jax.lax.scan(
-        scan_fn, (x, jnp.zeros((), jnp.int32), aux), stacked_params
+        scan_fn, (x, jnp.zeros((), jnp.int32), aux), xs_in
     )
     return x, aux
 

@@ -52,10 +52,19 @@ def _fptr(a):
     return a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
 
 
+def _require():
+    lib = _load()
+    if lib is None:
+        raise RuntimeError(
+            "native cpu_adam library unavailable (build failed or g++ "
+            "missing); check available() and fall back to the jitted step")
+    return lib
+
+
 def adam_step_inplace(p, g, m, v, *, step, lr, betas, eps, weight_decay,
                       adamw_mode, bias_correction, decay, grad_scale=1.0):
     """In-place fused Adam(W) on fp32 numpy leaves (p/m/v mutated)."""
-    _lib.ds_cpu_adam_step(
+    _require().ds_cpu_adam_step(
         _fptr(p), _fptr(g), _fptr(m), _fptr(v), p.size, int(step), float(lr),
         float(betas[0]), float(betas[1]), float(eps), float(weight_decay),
         int(bool(adamw_mode)), int(bool(bias_correction)), int(bool(decay)),
@@ -65,6 +74,6 @@ def adam_step_inplace(p, g, m, v, *, step, lr, betas, eps, weight_decay,
 def adagrad_step_inplace(p, g, s, *, lr, eps, weight_decay, decay,
                          grad_scale=1.0):
     """In-place Adagrad on fp32 numpy leaves (p/s mutated)."""
-    _lib.ds_cpu_adagrad_step(
+    _require().ds_cpu_adagrad_step(
         _fptr(p), _fptr(g), _fptr(s), p.size, float(lr), float(eps),
         float(weight_decay), int(bool(decay)), float(grad_scale))

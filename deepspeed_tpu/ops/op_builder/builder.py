@@ -53,7 +53,8 @@ class OpBuilder:
         try:
             with open("/proc/cpuinfo") as f:
                 for line in f:
-                    if line.startswith("flags"):
+                    # x86 spells it "flags", aarch64 "Features"
+                    if line.startswith(("flags", "Features")):
                         h.update(line.encode())
                         break
         except OSError:
