@@ -117,27 +117,61 @@ class OnebitLamb(OnebitAdam):
 
 class ZeroOneAdam(OnebitAdam):
     """0/1 Adam (reference ``onebit/zoadam.py``): compression starts almost
-    immediately, and instead of freezing the variance forever, an EXACT
-    synchronization round runs every ``var_update_interval`` steps — the
-    variance (and momentum) refresh from true mean gradients, then compressed
-    momentum resumes against the refreshed ``v``.
+    immediately, and instead of freezing the variance forever, periodic EXACT
+    synchronization rounds refresh the variance (and momentum) from true mean
+    gradients; compressed momentum then resumes against the refreshed ``v``.
 
-    The reference schedules these refreshes with growing intervals
-    (``var_freeze_step`` + interval scaling); here the interval is a fixed
-    knob — the engine picks the exact-sync program whenever
-    ``step % var_update_interval == 0`` (host-side, so no collective sits in
-    a conditional). ``freeze_step`` keeps its warmup meaning and defaults
-    low."""
+    Refreshes follow the reference's GROWING schedule (``zoadam.py:267``):
+    the interval starts at 1 and doubles after every ``var_update_scaler``
+    refreshes, so early training refreshes often and late training almost
+    never — "the interval of updating variance will increase exponentially,
+    so that it has negligible effect on the estimation" (``zoadam.py:265``).
+    Past ``var_freeze_step`` the variance freezes entirely. The schedule is
+    decided host-side per step (the engine picks between the exact and
+    compressed compiled programs), so no collective sits in a conditional.
+    Setting ``var_update_interval`` > 0 opts into the legacy fixed interval.
+    ``freeze_step`` keeps its warmup meaning and defaults low."""
 
     def __init__(self, lr=1e-3, betas=(0.9, 0.999), eps=1e-8,
-                 weight_decay=0.0, freeze_step=2, var_update_interval=16):
+                 weight_decay=0.0, freeze_step=2, var_update_interval=0,
+                 var_freeze_step=100000, var_update_scaler=16):
         super().__init__(lr=lr, betas=betas, eps=eps,
                          weight_decay=weight_decay, freeze_step=freeze_step)
-        self.var_update_interval = max(1, int(var_update_interval))
+        self.var_update_interval = int(var_update_interval)
+        self.var_freeze_step = int(var_freeze_step)
+        self.var_update_scaler = max(1, int(var_update_scaler))
+        # growing-schedule cursor (reference state['var_interval'] /
+        # ['var_counter'], advanced monotonically; replayable from 0 so a
+        # checkpoint resume at step N reconstructs the same schedule)
+        self._sched = {"step": 0, "interval": 1, "counter": 0}
+
+    def _refresh_at(self, step):
+        """Replay the reference rule up to ``step``: refresh iff
+        step % interval == 0, with interval doubling every
+        ``var_update_scaler`` refreshes. The cursor advances monotonically
+        (the engine queries increasing steps); a non-monotone query replays
+        from 0 — O(step), rare, and fully deterministic."""
+        if step < self._sched["step"]:
+            self._sched = {"step": 0, "interval": 1, "counter": 0}
+        s = self._sched
+        refresh = False
+        while s["step"] <= step:
+            refresh = (s["step"] % s["interval"]) == 0
+            if refresh:
+                s["counter"] += 1
+                if s["counter"] >= self.var_update_scaler:
+                    s["counter"] = 0
+                    s["interval"] *= 2
+            s["step"] += 1
+        return refresh
 
     def wants_exact_step(self, step):
         """True when ``step`` (0-based global step) should run the exact
-        (uncompressed) program: warmup AND periodic variance refreshes."""
+        (uncompressed) program: warmup AND variance refreshes."""
         if step < self.freeze_step:
             return True
-        return (step % self.var_update_interval) == 0
+        if self.var_update_interval > 0:      # legacy fixed interval
+            return (step % self.var_update_interval) == 0
+        if step >= self.var_freeze_step:      # variance frozen for good
+            return False
+        return self._refresh_at(step)

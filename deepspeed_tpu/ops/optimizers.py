@@ -275,7 +275,8 @@ def get_optimizer(name, params=None):
                "zerooneadam": ZeroOneAdam}[key]
         allowed = ("lr", "betas", "eps", "weight_decay", "freeze_step")
         if key == "zerooneadam":
-            allowed += ("var_update_interval",)
+            allowed += ("var_update_interval", "var_freeze_step",
+                        "var_update_scaler")
         ob_kwargs = {k: v for k, v in kwargs.items() if k in allowed}
         return cls(**ob_kwargs)
     if key not in OPTIMIZERS:

@@ -260,3 +260,26 @@ def test_zero_one_adam_variance_refresh(devices8):
     assert v_steps[4] == 5          # refresh at global step 4 -> v_step 5
     assert v_steps[8] == 9          # refresh at global step 8
     assert v_steps[7] == v_steps[5] == v_steps[4]  # frozen between refreshes
+
+
+def test_zero_one_adam_growing_refresh_schedule():
+    """The variance-refresh interval follows the reference's exponential rule
+    (zoadam.py:267): starts at 1, doubles after every var_update_scaler
+    refreshes, freezes past var_freeze_step. Deterministic and replayable."""
+    from deepspeed_tpu.ops.onebit import ZeroOneAdam
+
+    opt = ZeroOneAdam(freeze_step=0, var_update_scaler=2, var_freeze_step=40)
+    refreshes = [s for s in range(40) if opt.wants_exact_step(s)]
+    # interval 1 for 2 refreshes (0,1), then 2 for two (2,4), then 4 (8,12),
+    # then 8 (16,24), then 16 (32)
+    assert refreshes == [0, 1, 2, 4, 8, 12, 16, 24, 32], refreshes
+    # frozen past var_freeze_step
+    assert not any(opt.wants_exact_step(s) for s in range(40, 120))
+    # a FRESH object (checkpoint resume) replays to the same answers
+    opt2 = ZeroOneAdam(freeze_step=0, var_update_scaler=2, var_freeze_step=40)
+    assert opt2.wants_exact_step(24) and not opt2.wants_exact_step(20)
+    # non-monotone queries replay consistently
+    assert opt2.wants_exact_step(4) and not opt2.wants_exact_step(3)
+    # legacy fixed interval still honored
+    opt3 = ZeroOneAdam(freeze_step=0, var_update_interval=8)
+    assert [s for s in range(17) if opt3.wants_exact_step(s)] == [0, 8, 16]
