@@ -17,6 +17,15 @@ class TensorParallelConfig(ConfigModel):
     tp_size: int = 1
 
 
+class MoEInferenceConfig(ConfigModel):
+    """Reference ``inference/config.py`` DeepSpeedMoEConfig (``ep_size``):
+    expert-parallel serving — experts shard over the ``expert`` mesh axis and
+    token dispatch rides the same all_to_all constraints as training."""
+
+    enabled: bool = True
+    ep_size: int = 1
+
+
 class QuantizationConfig(ConfigModel):
     """Weight quantization (reference ``replace_module.py:140`` GroupQuantizer)."""
 
@@ -40,6 +49,7 @@ class DeepSpeedInferenceConfig(ConfigModel):
     # vary — row padding costs compute but saves the recompile.
     batch_bucket_size: int = 1
     quant: QuantizationConfig = None
+    moe: MoEInferenceConfig = None
     replace_with_kernel_inject: bool = False  # accepted for config compat; no-op
     seed: int = 0
 
@@ -48,6 +58,8 @@ class DeepSpeedInferenceConfig(ConfigModel):
             self.tensor_parallel = TensorParallelConfig()
         if self.quant is None:
             self.quant = QuantizationConfig()
+        if self.moe is None:
+            self.moe = MoEInferenceConfig()
         if self.dtype not in ("float16", "bfloat16", "float32"):
             from ..config.base import ConfigError
 

@@ -41,8 +41,17 @@ class InferenceEngine:
             model.config.compute_dtype = self.dtype
 
         tp = config.tensor_parallel.tp_size if config.tensor_parallel.enabled else 1
-        self.mesh = mesh if mesh is not None else build_mesh(MeshConfig(model=tp))
+        ep = config.moe.ep_size if config.moe.enabled else 1
+        if ep > 1 and getattr(getattr(model, "config", None), "n_experts", 0) < 1:
+            raise ConfigError(
+                f"moe.ep_size={ep} needs an MoE model (n_experts > 0)")
+        self.mesh = mesh if mesh is not None else build_mesh(
+            MeshConfig(model=tp, expert=ep))
         self.mp_world_size = self.mesh.shape.get(MODEL_AXIS, 1)
+        # the MoE dispatch constraints (moe/sharded_moe.py _expert_a2a) and
+        # ring attention read the mesh off the model config
+        if hasattr(model, "config") and hasattr(model.config, "mesh"):
+            model.config.mesh = self.mesh
 
         self._rng = jax.random.PRNGKey(config.seed)
         self._init_parameters(model_parameters)

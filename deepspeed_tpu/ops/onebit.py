@@ -121,15 +121,23 @@ class ZeroOneAdam(OnebitAdam):
     synchronization rounds refresh the variance (and momentum) from true mean
     gradients; compressed momentum then resumes against the refreshed ``v``.
 
-    Refreshes follow the reference's GROWING schedule (``zoadam.py:267``):
-    the interval starts at 1 and doubles after every ``var_update_scaler``
-    refreshes, so early training refreshes often and late training almost
-    never — "the interval of updating variance will increase exponentially,
-    so that it has negligible effect on the estimation" (``zoadam.py:265``).
-    Past ``var_freeze_step`` the variance freezes entirely. The schedule is
-    decided host-side per step (the engine picks between the exact and
-    compressed compiled programs), so no collective sits in a conditional.
-    Setting ``var_update_interval`` > 0 opts into the legacy fixed interval.
+    Refreshes follow the reference's GROWING rule (``zoadam.py:267``):
+    refresh when ``step % interval == 0``, interval starting at 1 and
+    doubling after every ``var_update_scaler`` refreshes, so early training
+    refreshes often and late training almost never — "the interval of
+    updating variance will increase exponentially, so that it has negligible
+    effect on the estimation" (``zoadam.py:265``). Past ``var_freeze_step``
+    the variance freezes entirely.
+
+    Deliberate deviation: the reference ALSO marks ``(step+1) % interval
+    == 0`` steps for an exact round (``zoadam.py:273``) — a lookahead needed
+    because its eager engine must arrange the NEXT step's uncompressed
+    allreduce in advance. Here the engine picks the exact or compressed
+    compiled program AT the step host-side, so the refresh step's gradient
+    is exact by construction and no lookahead round exists; the exact-step
+    SEQUENCE therefore differs from the reference's by that arrangement
+    offset while the refresh cadence is the same. Setting
+    ``var_update_interval`` > 0 opts into the legacy fixed interval.
     ``freeze_step`` keeps its warmup meaning and defaults low."""
 
     def __init__(self, lr=1e-3, betas=(0.9, 0.999), eps=1e-8,

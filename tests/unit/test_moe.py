@@ -308,3 +308,40 @@ def test_moe_preset_serves_with_training_parity():
                                atol=2e-4)
     out = engine.generate(ids, max_new_tokens=4, greedy=True)
     assert out.shape == (2, 16)
+
+
+def test_moe_expert_parallel_serving_parity(devices8):
+    """Reference moe_inference ep_size role: serving with experts sharded over
+    the expert mesh axis must produce the same logits as replicated serving."""
+    from deepspeed_tpu.models.registry import get_model
+
+    def build(ep):
+        model = get_model("gpt2_moe", "tiny", vocab_size=128, max_seq_len=64,
+                          n_experts=4, compute_dtype=jnp.float32)
+        return deepspeed_tpu.init_inference(
+            model=model, config={"dtype": "float32", "max_tokens": 64,
+                                 "prompt_bucket_size": 1,
+                                 "moe": {"ep_size": ep}})
+
+    rep = build(1)
+    ep2 = build(2)
+    assert ep2.mesh.shape["expert"] == 2
+    # experts actually sharded over the expert axis
+    wi = ep2.params["blocks"]["mlp"]["wi"]
+    assert "expert" in str(wi.sharding.spec), wi.sharding.spec
+    ids = _batch(b=2, s=12, vocab=128)["input_ids"]
+    np.testing.assert_allclose(np.asarray(ep2.forward(ids)),
+                               np.asarray(rep.forward(ids)),
+                               rtol=2e-4, atol=2e-4)
+    out = ep2.generate(ids, max_new_tokens=4, greedy=True)
+    assert out.shape == (2, 16)
+
+
+def test_moe_ep_serving_requires_moe_model():
+    from deepspeed_tpu.config import ConfigError
+
+    with pytest.raises(ConfigError, match="MoE model"):
+        deepspeed_tpu.init_inference(
+            model=CausalLM(moe_cfg(n_experts=0)),
+            config={"dtype": "float32", "max_tokens": 64,
+                    "moe": {"ep_size": 2}})
