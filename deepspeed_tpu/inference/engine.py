@@ -312,7 +312,9 @@ class InferenceEngine:
         first, cache = prefill_fn(self.params, ids_in, r1, temp, true_len)
         out = [input_ids, first[:, None]]
         if max_new_tokens > 1:
-            toks = decode_fn(self.params, cache, first, r2, temp, true_len)  # [steps, b]
+            # the final cache is dropped, but returning it from the jitted fn
+            # lets the donated input cache alias the output (no entry copy)
+            toks, _ = decode_fn(self.params, cache, first, r2, temp, true_len)
             out.append(jnp.transpose(toks))
         result = jnp.concatenate(out, axis=1)
         if b_real < b:

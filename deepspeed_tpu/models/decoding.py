@@ -238,7 +238,12 @@ def prefill_and_first_token(model, params, ids, rng, temperature, *, max_len,
 
 def decode_tokens(model, params, cache, tok, rng, temperature, *, prompt_len,
                   max_len, steps, greedy, top_k):
-    """Scan ``steps`` single-token decode iterations; returns [steps, b]."""
+    """Scan ``steps`` single-token decode iterations.
+
+    Returns ``(toks [steps, b], cache)``. The final cache is returned (even
+    though callers usually drop it) so a caller that donates the input cache
+    gives XLA an output to alias — otherwise the donation is unusable and the
+    compiled program copies the cache at loop entry."""
 
     def step(carry, i):
         cache, tok, rng = carry
@@ -251,7 +256,7 @@ def decode_tokens(model, params, cache, tok, rng, temperature, *, prompt_len,
 
     (cache, _, _), toks = jax.lax.scan(step, (cache, tok, rng),
                                        jnp.arange(steps))
-    return toks
+    return toks, cache
 
 
 def decode_tokens_until(model, params, cache, tok, rng, temperature, *,
@@ -261,7 +266,9 @@ def decode_tokens_until(model, params, cache, tok, rng, temperature, *,
     has emitted ``eos_token_id`` (the reference's generate-stops-at-eos
     behavior, but inside the compiled program — short answers don't pay for
     ``max_new_tokens`` iterations). Rows that finished keep emitting eos.
-    Returns [steps, b] (positions past a row's eos filled with eos)."""
+    Returns ``(out [steps, b], cache)`` (positions past a row's eos filled
+    with eos; the cache is returned for donation aliasing, see
+    ``decode_tokens``)."""
     b = tok.shape[0]
     out0 = jnp.full((steps, b), eos_token_id, jnp.int32)
     done0 = tok == eos_token_id
@@ -284,4 +291,4 @@ def decode_tokens_until(model, params, cache, tok, rng, temperature, *,
 
     (_, _, cache, _, _, out) = jax.lax.while_loop(
         cond, body, (jnp.zeros((), jnp.int32), done0, cache, tok, rng, out0))
-    return out
+    return out, cache

@@ -113,7 +113,7 @@ class DeepSpeedHybridEngine(DeepSpeedEngine):
                     greedy=greedy, top_k=top_k, dtype=dtype, true_len=true_len)
                 toks = None
                 if max_new_tokens > 1:
-                    toks = decode_tokens(
+                    toks, _ = decode_tokens(
                         model, cast, cache, tok, rng, temperature,
                         prompt_len=true_len, max_len=max_len,
                         steps=max_new_tokens - 1, greedy=greedy, top_k=top_k)

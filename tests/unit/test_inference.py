@@ -403,7 +403,7 @@ def test_eos_early_stop_decode_matches_scan():
         greedy=True, top_k=0, dtype=jnp.float32)
     ref = np.asarray(decode_tokens(
         model, values, cache, tok, jax.random.PRNGKey(2), 1.0,
-        prompt_len=6, max_len=32, steps=steps, greedy=True, top_k=0))
+        prompt_len=6, max_len=32, steps=steps, greedy=True, top_k=0)[0])
 
     # pick an eos that actually appears mid-stream for at least one row
     flat = ref.T  # [b, steps]
@@ -414,7 +414,7 @@ def test_eos_early_stop_decode_matches_scan():
     got = np.asarray(decode_tokens_until(
         model, values, cache2, tok2, jax.random.PRNGKey(2), 1.0,
         prompt_len=6, max_len=32, steps=steps, greedy=True, top_k=0,
-        eos_token_id=eos)).T
+        eos_token_id=eos)[0]).T
 
     for row_ref, row_got, t0 in zip(flat, got, np.asarray(tok)):
         if t0 == eos:
