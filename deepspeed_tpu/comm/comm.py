@@ -218,7 +218,7 @@ def init_distributed(dist_backend=None, timeout=None, init_method=None, rank=-1,
     num_processes = int(os.environ.get("DS_TPU_NUM_PROCESSES", "0"))
     if num_processes == 0 and coordinator:
         num_processes = int(_env_first(
-            "SLURM_NTASKS", "OMPI_COMM_WORLD_SIZE", default="0"))
+            "SLURM_NTASKS", "OMPI_COMM_WORLD_SIZE", "PMI_SIZE", default="0"))
     if num_processes > 1:
         if not coordinator:
             raise RuntimeError(
@@ -228,7 +228,7 @@ def init_distributed(dist_backend=None, timeout=None, init_method=None, rank=-1,
         port = os.environ.get("MASTER_PORT", "8476")
         process_id = int(_env_first(
             "DS_TPU_PROCESS_ID", "RANK", "SLURM_PROCID", "OMPI_COMM_WORLD_RANK",
-            default="0"))
+            "PMI_RANK", default="0"))
         jax.distributed.initialize(
             coordinator_address=f"{coordinator}:{port}",
             num_processes=num_processes,

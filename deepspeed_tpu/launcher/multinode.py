@@ -1,4 +1,4 @@
-"""Slurm / OpenMPI launch transports for TPU pods.
+"""Slurm / OpenMPI / MPICH launch transports for TPU pods.
 
 Behavior-port of the reference's multinode runners
 (``launcher/multinode_runner.py:107`` OpenMPIRunner, ``:208`` SlurmRunner)
@@ -10,8 +10,9 @@ launches one process per GPU.
 Rank numbering is the scheduler's job: these transports export only the
 rendezvous *address* (``DS_TPU_COORDINATOR`` + ``MASTER_PORT``, and any
 user ``--export``s); ``comm.init_distributed`` then reads the per-task rank
-and world size from ``SLURM_PROCID``/``SLURM_NTASKS`` or
-``OMPI_COMM_WORLD_RANK``/``OMPI_COMM_WORLD_SIZE`` at startup. This replaces
+and world size from ``SLURM_PROCID``/``SLURM_NTASKS``,
+``OMPI_COMM_WORLD_RANK``/``OMPI_COMM_WORLD_SIZE``, or MPICH's
+``PMI_RANK``/``PMI_SIZE`` at startup. This replaces
 the reference's base64 world-info blob threaded through ``launch.py``.
 """
 
@@ -19,7 +20,8 @@ import shutil
 import subprocess
 import sys
 
-__all__ = ["SlurmRunner", "OpenMPIRunner", "MULTINODE_RUNNERS"]
+__all__ = ["SlurmRunner", "OpenMPIRunner", "MPICHRunner",
+           "MULTINODE_RUNNERS"]
 
 
 class _Transport:
@@ -122,4 +124,41 @@ class OpenMPIRunner(_Transport):
         return cmd + self._python_exec(user_script, user_args)
 
 
-MULTINODE_RUNNERS = {r.name: r for r in (SlurmRunner, OpenMPIRunner)}
+class MPICHRunner(_Transport):
+    """``mpirun`` (MPICH/Hydra) transport (reference ``multinode_runner.py:160``).
+
+    One process per node via ``-ppn 1``; env forwarded with ``-genv K V``
+    pairs (MPICH's spelling of OpenMPI's ``-x``). Rank numbering comes from
+    the PMI env (``PMI_RANK``/``PMI_SIZE``) at startup."""
+
+    name = "mpich"
+
+    def __init__(self, num_hosts, *, hostfile="", **kw):
+        super().__init__(num_hosts, **kw)
+        self.hostfile = hostfile
+
+    def backend_exists(self):
+        # OpenMPI also installs an `mpirun`; make sure this one is Hydra/MPICH
+        # (OpenMPI would reject -ppn/-genv/-f with no hint otherwise)
+        if not shutil.which("mpirun"):
+            return False
+        try:
+            out = subprocess.run(["mpirun", "--version"], capture_output=True,
+                                 text=True, timeout=10)
+            banner = (out.stdout + out.stderr).lower()
+            return "hydra" in banner or "mpich" in banner
+        except (OSError, subprocess.TimeoutExpired):
+            return False
+
+    def build_cmd(self, user_script, user_args=()):
+        cmd = ["mpirun", "-n", str(self.num_hosts), "-ppn", "1"]
+        if self.hostfile:
+            cmd += ["-f", self.hostfile]
+        cmd += self.launcher_args
+        for k, v in sorted(self.exports.items()):
+            cmd += ["-genv", k, str(v)]
+        return cmd + self._python_exec(user_script, user_args)
+
+
+MULTINODE_RUNNERS = {r.name: r
+                     for r in (SlurmRunner, OpenMPIRunner, MPICHRunner)}

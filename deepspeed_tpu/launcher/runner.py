@@ -50,10 +50,10 @@ def parse_args(args=None):
                              "host over ssh (reference PDSH runner role)")
     parser.add_argument("--ssh_port", type=int, default=22)
     parser.add_argument("--launcher", type=str, default="",
-                        choices=["", "ssh", "slurm", "openmpi"],
+                        choices=["", "ssh", "slurm", "openmpi", "mpich"],
                         help="multi-node transport (reference --launcher): "
-                             "ssh | slurm (srun) | openmpi (mpirun); one "
-                             "process per HOST either way")
+                             "ssh | slurm (srun) | openmpi | mpich (mpirun); "
+                             "one process per HOST either way")
     parser.add_argument("--launcher_args", type=str, default="",
                         help="extra args passed through to srun/mpirun")
     parser.add_argument("--slurm_comment", type=str, default="",
@@ -255,7 +255,7 @@ def main(args=None):
             if args.deepspeed_config else None
         logger.info(f"ds_tpu: ssh launch on {len(hosts)} hosts")
         return runner.run(cmd, extra)
-    if args.launcher in ("slurm", "openmpi"):
+    if args.launcher in ("slurm", "openmpi", "mpich"):
         import shlex
 
         from .multinode import MULTINODE_RUNNERS
@@ -293,14 +293,18 @@ def main(args=None):
         else:
             if resource_pool:
                 # hand mpirun the EFFECTIVE host set (filters applied, one
-                # slot per host), not the raw user hostfile — the raw file
-                # still contains excluded hosts and chip-count slots
+                # process per host), not the raw user hostfile — the raw file
+                # still contains excluded hosts and chip-count slots. Each
+                # flavor gets its own machinefile dialect: OpenMPI reads
+                # "host slots=n", Hydra (MPICH) reads "host[:n]".
                 import tempfile
 
+                line = ("{h} slots=1\n" if args.launcher == "openmpi"
+                        else "{h}\n")
                 eff = tempfile.NamedTemporaryFile(
                     "w", prefix="ds_tpu_hosts_", suffix=".txt", delete=False)
                 for h in sorted(resource_pool):
-                    eff.write(f"{h} slots=1\n")
+                    eff.write(line.format(h=h))
                 eff.close()
                 kw.update(hostfile=eff.name)
             else:

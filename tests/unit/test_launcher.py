@@ -324,3 +324,59 @@ def test_init_distributed_explicit_world_requires_coordinator(monkeypatch):
     with pytest.raises(RuntimeError, match="no coordinator"):
         C.init_distributed()
     monkeypatch.setattr(C, "_initialized", False)
+
+
+def test_mpich_runner_builds_mpirun_command():
+    """MPICH transport (reference multinode_runner.py:160 semantics): one
+    process per node via -ppn 1, env via -genv K V pairs."""
+    from deepspeed_tpu.launcher.multinode import MPICHRunner
+
+    r = MPICHRunner(3, hostfile="/tmp/hf",
+                    exports={"DS_TPU_COORDINATOR": "h0", "MASTER_PORT": "9"})
+    cmd = r.build_cmd("train.py")
+    assert cmd[:5] == ["mpirun", "-n", "3", "-ppn", "1"]
+    assert ["-f", "/tmp/hf"] == cmd[5:7]
+    assert ["-genv", "DS_TPU_COORDINATOR", "h0",
+            "-genv", "MASTER_PORT", "9"] == cmd[7:13]
+    import sys as _sys
+    assert cmd[13:] == [_sys.executable, "-u", "train.py"]
+
+
+def test_init_distributed_pmi_env_fallback(monkeypatch):
+    """MPICH/Hydra export PMI_RANK/PMI_SIZE; with a coordinator set, rank and
+    world size must come from them."""
+    import deepspeed_tpu.comm.comm as C
+
+    monkeypatch.setattr(C, "_initialized", False)
+    monkeypatch.setenv("PMI_SIZE", "4")
+    monkeypatch.setenv("PMI_RANK", "3")
+    monkeypatch.setenv("DS_TPU_COORDINATOR", "h0")
+    for k in ("DS_TPU_NUM_PROCESSES", "DS_TPU_PROCESS_ID", "RANK",
+              "SLURM_NTASKS", "SLURM_PROCID", "OMPI_COMM_WORLD_SIZE",
+              "OMPI_COMM_WORLD_RANK"):
+        monkeypatch.delenv(k, raising=False)
+    called = {}
+    monkeypatch.setattr(C.jax.distributed, "initialize",
+                        lambda **kw: called.update(kw))
+    C.init_distributed()
+    assert called["num_processes"] == 4 and called["process_id"] == 3
+    monkeypatch.setattr(C, "_initialized", False)
+
+
+def test_cli_mpich_writes_hydra_machinefile(tmp_path, monkeypatch):
+    """Hydra machinefiles are 'host[:n]' lines, NOT OpenMPI's 'host slots=n'."""
+    from deepspeed_tpu.launcher import runner as R
+
+    hf = tmp_path / "hostfile"
+    hf.write_text("tpu-0 slots=4\ntpu-1 slots=4\n")
+    captured = {}
+
+    def fake_run(self, user_script, user_args=()):
+        captured["hostfile"] = self.hostfile
+        return 0
+
+    monkeypatch.setattr("deepspeed_tpu.launcher.multinode._Transport.run",
+                        fake_run)
+    rc = R.main(["--hostfile", str(hf), "--launcher", "mpich", "train.py"])
+    assert rc == 0
+    assert open(captured["hostfile"]).read() == "tpu-0\ntpu-1\n"
