@@ -297,6 +297,10 @@ class DeepSpeedConfig(ConfigModel):
     curriculum_learning: CurriculumConfig = CurriculumConfig
     progressive_layer_drop: ProgressiveLayerDropConfig = ProgressiveLayerDropConfig
     gradient_compression: GradientCompressionConfig = GradientCompressionConfig
+    # compression-in-training (reference compression_training section,
+    # deepspeed/compression/config.py): parsed by compression.init_compression
+    # — kept as a raw dict here to avoid a config<->compression import cycle
+    compression_training: dict = {}
     communication_data_type: typing.Optional[str] = None
     wall_clock_breakdown: bool = False
     memory_breakdown: bool = False

@@ -131,6 +131,35 @@ class DeepSpeedEngine:
                 "dispatch cannot be constrained to all_to_all and will compile "
                 "to a degraded replicated layout")
 
+        # -- compression-in-training (reference compression_training section) --------
+        self._compression = None
+        self._compression_phase = None
+        self._compression_step = 0
+        if self._config.compression_training:
+            # reject incompatible configs BEFORE touching the module config —
+            # a caught ConfigError must leave the model reusable
+            if self.pipe_stages > 1:
+                raise ConfigError(
+                    "compression_training does not compose with pipeline "
+                    "parallelism (apply compression manually via "
+                    "deepspeed_tpu.compression on pipe meshes)")
+            if self._config.gradient_compression.enabled or \
+                    self._config.optimizer.type.lower().replace("-", "").replace("_", "") \
+                    in ("onebitadam", "zerooneadam", "onebitlamb"):
+                raise ConfigError(
+                    "compression_training does not compose with 1-bit/"
+                    "compressed-gradient optimizers (their train path would "
+                    "silently skip the quantization/pruning masks)")
+            from ..compression import apply_to_model_config, init_compression
+
+            if hasattr(self.module, "config"):
+                # activation quantization is a model-config knob (QuantAct role)
+                self.module.config = apply_to_model_config(
+                    self.module.config, self._config.compression_training)
+            self._compression = init_compression(
+                self._config.compression_training,
+                model_config=getattr(self.module, "config", None))
+
         # -- parameters (sharded at init = zero.Init) --------------------------------
         self._rng = jax.random.PRNGKey(self._config.seed)
         self._init_parameters(model_parameters)
@@ -477,6 +506,31 @@ class DeepSpeedEngine:
             use_1f1b = False
         return use_1f1b
 
+    def _compress(self, params):
+        """Apply the current compression phase's masks/fake-quant inside a
+        compiled step (no-op without compression_training). The phase's step
+        is a BUILD-time constant: schedule transitions invalidate the compiled
+        programs (bounded recompiles — one per bit level / phase start)."""
+        if self._compression is None:
+            return params
+        return self._compression.compress_params(params, self._compression_step)
+
+    def _maybe_refresh_compression(self):
+        if self._compression is None:
+            return
+        rt = self._compression
+        cfg = rt.config
+        step = self.global_steps
+        key = (rt.bits_at(step), rt.prune_ratio_at(step),
+               cfg.head_pruning.enabled and step >= cfg.head_pruning.schedule_offset,
+               cfg.row_pruning.enabled and step >= cfg.row_pruning.schedule_offset)
+        if key != self._compression_phase:
+            self._compression_phase = key
+            self._compression_step = step
+            self._train_step_fn = None
+            self._fwd_bwd_fn = None
+            self._eval_fn = None   # eval must see the same compressed net
+
     def _build_fwd_bwd(self):
         gas = self.gradient_accumulation_steps_
 
@@ -500,7 +554,7 @@ class DeepSpeedEngine:
 
         def fwd_bwd(params, batch, scale, rng):
             def scaled_loss(p):
-                loss = self.module.loss(p, batch,
+                loss = self.module.loss(self._compress(p), batch,
                                         deterministic=not self._train_mode,
                                         dropout_rng=rng)
                 # reference scales by 1/gas at backward (engine.py:1793) and by the
@@ -598,7 +652,7 @@ class DeepSpeedEngine:
 
             def scaled_loss(p, batch, r):
                 loss = self.module.loss(
-                    p, batch, deterministic=not self._train_mode,
+                    self._compress(p), batch, deterministic=not self._train_mode,
                     dropout_rng=r,
                     **({"pld_theta": pld_theta} if pld_enabled else {}))
                 return loss * scale.astype(loss.dtype) / gas, loss
@@ -905,6 +959,7 @@ class DeepSpeedEngine:
         """
         if self._wall_clock_breakdown:
             self.timers(FORWARD_GLOBAL_TIMER).start()
+        self._maybe_refresh_compression()
         if self._fwd_bwd_fn is None:
             self._build_fwd_bwd()
         batch = self._shard_batch(self._apply_curriculum(batch))
@@ -1030,6 +1085,7 @@ class DeepSpeedEngine:
         the pipelining guarantee holds for bf16/fp32.
         """
         self.tput_timer.start()
+        self._maybe_refresh_compression()
         micros = []
         for _ in range(self.gradient_accumulation_steps_):
             micro = batch if batch is not None else next(data_iter)
@@ -1063,6 +1119,7 @@ class DeepSpeedEngine:
         weight per eval step (brutal at multi-B params). M=1 keeps eval free of
         any microbatch divisibility contract; the (S-1)/S bubble is irrelevant
         at eval rates."""
+        self._maybe_refresh_compression()
         if self._eval_fn is None:
             module = self.module
             if self.pipe_stages > 1:
@@ -1072,8 +1129,11 @@ class DeepSpeedEngine:
                     dataclasses.replace(self.module.config,
                                         pipeline_microbatches=1)
                 )
+            # eval the COMPRESSED net (what redundancy_clean will deploy),
+            # not the dense masters
             with self.mesh:
-                self._eval_fn = jax.jit(lambda p, b: module.loss(p, b))
+                self._eval_fn = jax.jit(
+                    lambda p, b: module.loss(self._compress(p), b))
         return self._eval_fn(self.params, self._shard_batch(batch))
 
     def _current_lr(self):

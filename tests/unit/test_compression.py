@@ -221,3 +221,79 @@ def test_head_pruning_rejects_alibi():
         redundancy_clean(_params(cfg),
                          {"head_pruning": {"enabled": True, "ratio": 0.5}},
                          model_config=cfg)
+
+
+def test_engine_compression_training_config(devices8):
+    """The documented compression_training config section drives compression
+    INSIDE the compiled step: fake-quant/masks apply per the MoQ schedule, the
+    program rebuilds at phase transitions, and the trajectory differs from an
+    uncompressed engine with identical seeds."""
+    def build(comp):
+        model = CausalLM(tiny_cfg())
+        cfg = {"train_batch_size": 8,
+               "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+               "steps_per_print": 10**6}
+        if comp:
+            cfg["compression_training"] = comp
+        return deepspeed_tpu.initialize(model=model, config=cfg)[0]
+
+    comp = {"weight_quantization": {"enabled": True, "start_bits": 8,
+                                    "target_bits": 4, "quantize_period": 2,
+                                    "schedule_offset": 1},
+            "sparse_pruning": {"enabled": True, "ratio": 0.3,
+                               "schedule_offset": 2}}
+    e_c = build(comp)
+    e_p = build(None)
+    assert e_c._compression is not None
+    batch = _batch(b=8)
+    lc = [float(e_c.train_batch(batch=batch)) for _ in range(5)]
+    lp = [float(e_p.train_batch(batch=batch)) for _ in range(5)]
+    assert all(np.isfinite(l) for l in lc)
+    # step 0 is pre-offset on both quant and prune: identical programs
+    np.testing.assert_allclose(lc[0], lp[0], rtol=1e-6)
+    # once the schedule engages, the compressed trajectory diverges
+    assert abs(lc[-1] - lp[-1]) > 1e-4, (lc, lp)
+    # the phase key tracked the schedule (4-bit floor reached, pruning on)
+    assert e_c._compression_phase[0] == 4
+    assert e_c._compression_phase[1] == 0.3
+
+
+def test_engine_compression_activation_quant_wired(devices8):
+    """activation_quantization in compression_training lands on the model
+    config (QuantAct role) through initialize()."""
+    model = CausalLM(tiny_cfg())
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config={
+        "train_batch_size": 8,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+        "compression_training": {"activation_quantization":
+                                 {"enabled": True, "bits": 8}},
+        "steps_per_print": 10**6})
+    assert engine.module.config.activation_quant_bits == 8
+    assert np.isfinite(float(engine.train_batch(batch=_batch(b=8))))
+
+
+def test_engine_compression_rejects_onebit_and_eval_is_compressed(devices8):
+    from deepspeed_tpu.config import ConfigError
+
+    comp = {"sparse_pruning": {"enabled": True, "ratio": 0.5,
+                               "schedule_offset": 0}}
+    with pytest.raises(ConfigError, match="1-bit"):
+        deepspeed_tpu.initialize(model=CausalLM(tiny_cfg()), config={
+            "train_batch_size": 8,
+            "optimizer": {"type": "onebitadam", "params": {"lr": 1e-3}},
+            "compression_training": comp})
+
+    engine, _, _, _ = deepspeed_tpu.initialize(model=CausalLM(tiny_cfg()),
+                                               config={
+        "train_batch_size": 8,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+        "compression_training": comp, "steps_per_print": 10**6})
+    batch = _batch(b=8)
+    engine.train_batch(batch=batch)
+    loss_eval = float(engine.eval_batch(batch))
+    # eval must see the masked net, not the dense masters
+    masked = engine._compress(engine.params)
+    loss_masked = float(engine.module.loss(masked, batch))
+    np.testing.assert_allclose(loss_eval, loss_masked, rtol=1e-5)
+    loss_dense = float(engine.module.loss(engine.params, batch))
+    assert abs(loss_eval - loss_dense) > 1e-4
