@@ -143,6 +143,13 @@ class DeepSpeedEngine:
                     "compression_training does not compose with pipeline "
                     "parallelism (apply compression manually via "
                     "deepspeed_tpu.compression on pipe meshes)")
+            if dict(self._config.compression_training).get(
+                    "layer_reduction", {}).get("enabled"):
+                raise ConfigError(
+                    "compression_training.layer_reduction is a deploy-time "
+                    "transform (redundancy_clean slices the layer stack); it "
+                    "cannot run inside training — train the full depth, then "
+                    "clean, or build the student model directly")
             if self._config.gradient_compression.enabled or \
                     self._config.optimizer.type.lower().replace("-", "").replace("_", "") \
                     in ("onebitadam", "zerooneadam", "onebitlamb"):
@@ -166,6 +173,15 @@ class DeepSpeedEngine:
 
         # -- optimizer ---------------------------------------------------------------
         self._configure_optimizer()
+
+        if self._compression is not None and self._onebit_active:
+            # authoritative guard: a client-PASSED 1-bit optimizer instance
+            # bypasses the config-string check above, and the 1-bit train
+            # path would silently skip the compression masks
+            raise ConfigError(
+                "compression_training does not compose with 1-bit/"
+                "compressed-gradient optimizers (their train path would "
+                "silently skip the quantization/pruning masks)")
 
         # -- lr scheduler ------------------------------------------------------------
         self.lr_scheduler = lr_scheduler
@@ -652,7 +668,7 @@ class DeepSpeedEngine:
 
             def scaled_loss(p, batch, r):
                 loss = self.module.loss(
-                    self._compress(p), batch, deterministic=not self._train_mode,
+                    p, batch, deterministic=not self._train_mode,
                     dropout_rng=r,
                     **({"pld_theta": pld_theta} if pld_enabled else {}))
                 return loss * scale.astype(loss.dtype) / gas, loss
@@ -660,9 +676,17 @@ class DeepSpeedEngine:
             grad_fn = jax.value_and_grad(scaled_loss, has_aux=True)
             constrain = lambda g: jax.lax.with_sharding_constraint(
                 g, self._grad_shardings)  # ZeRO-2: grads sharded over data
+            # compression runs ONCE per step, outside the accumulation scan:
+            # cp is the compressed tree the micro-batches differentiate
+            # against, and the vjp pulls the accumulated grads back through
+            # the masks/STE exactly (identity for fake-quant, mask multiply
+            # for pruning) — not gas redundant fake-quant/sort passes
+            if self._compression is not None:
+                cp, compress_vjp = jax.vjp(self._compress, params)
+            else:
+                cp, compress_vjp = params, None
             if gas == 1:
-                (_, loss), grads = grad_fn(params, batches, step_rng)
-                grads = constrain(grads)
+                (_, loss), grads = grad_fn(cp, batches, step_rng)
                 mean_loss = loss
             else:
                 micro_rngs = jax.random.split(step_rng, gas)
@@ -671,12 +695,15 @@ class DeepSpeedEngine:
 
                 def body(acc, xs):
                     micro, r = xs
-                    (_, loss), g = grad_fn(params, micro, r)
+                    (_, loss), g = grad_fn(cp, micro, r)
                     acc = constrain(jax.tree_util.tree_map(jnp.add, acc, g))
                     return acc, loss
 
                 grads, losses = jax.lax.scan(body, zeros, (batches, micro_rngs))
                 mean_loss = jnp.mean(losses)
+            if compress_vjp is not None:
+                (grads,) = compress_vjp(grads)
+            grads = constrain(grads)
 
             (new_params, new_state, scale, good_steps,
              overflow, norm) = self._apply_body(params, opt_state, grads, scale,

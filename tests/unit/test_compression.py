@@ -297,3 +297,39 @@ def test_engine_compression_rejects_onebit_and_eval_is_compressed(devices8):
     np.testing.assert_allclose(loss_eval, loss_masked, rtol=1e-5)
     loss_dense = float(engine.module.loss(engine.params, batch))
     assert abs(loss_eval - loss_dense) > 1e-4
+
+
+def test_engine_compression_grad_accum_pullback(devices8):
+    """With gradient accumulation, compression runs once outside the scan and
+    grads pull back through the vjp — one train_batch must move the params
+    exactly as an optimizer step on d/dp mean_micro loss(compress(p), micro)."""
+    comp = {"sparse_pruning": {"enabled": True, "ratio": 0.5,
+                               "schedule_offset": 0},
+            "weight_quantization": {"enabled": True, "start_bits": 8,
+                                    "target_bits": 8, "schedule_offset": 0}}
+    model = CausalLM(tiny_cfg())
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config={
+        "train_batch_size": 16, "train_micro_batch_size_per_gpu": 1,
+        "gradient_accumulation_steps": 2,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-2,
+                                                  "weight_decay": 0.0}},
+        "compression_training": comp, "steps_per_print": 10**6})
+    assert engine.gradient_accumulation_steps_ == 2
+    b1, b2 = _batch(b=8, seed=1), _batch(b=8, seed=2)
+    p0 = jax.tree_util.tree_map(lambda a: np.asarray(a).copy(), engine.params)
+    state0 = jax.tree_util.tree_map(lambda a: np.asarray(a).copy(),
+                                    engine.optimizer_state)
+    rt = engine._compression
+
+    engine.train_batch(data_iter=iter([b1, b2]))
+
+    def ref_loss(p):
+        cp = rt.compress_params(p, 0)
+        return (model.loss(cp, b1) + model.loss(cp, b2)) / 2.0
+
+    g_ref = jax.grad(ref_loss)(p0)
+    expected, _ = engine.optimizer.update(g_ref, state0, p0, lr=1e-2)
+    for a, b in zip(jax.tree_util.tree_leaves(engine.params),
+                    jax.tree_util.tree_leaves(expected)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)

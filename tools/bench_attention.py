@@ -67,7 +67,22 @@ def main():
         flops = 2 * (s * s / 2) * d * 2 * b * h
         if not fwd_only:
             flops *= 4.5
-        for name, fn in [("xla", xla_attn), ("flash", flash)]:
+        impls = [("xla", xla_attn), ("flash", flash)]
+        # BENCH_BLOCKS="128x256,256x512,512x512": sweep flash kernel block
+        # sizes (block_q x block_kv) — the tuning knob VERDICT r2 flagged.
+        # TPU-only: the CPU fallback path ignores block sizes.
+        blocks = os.environ.get("BENCH_BLOCKS", "")
+        if blocks:
+            from deepspeed_tpu.ops.pallas.flash_attention import (
+                pallas_flash_attention)
+
+            for spec in blocks.split(","):
+                bq, bkv = (int(x) for x in spec.split("x"))
+                impls.append((
+                    f"fl{bq}x{bkv}",
+                    lambda q, k, v, bq=bq, bkv=bkv: pallas_flash_attention(
+                        q, k, v, causal=True, block_q=bq, block_kv=bkv)))
+        for name, fn in impls:
             try:
                 dt = bench(fn, q, k, v)
                 print(f"seq={s:6d} {name:6s} {dt * 1e3:9.2f} ms "
