@@ -211,6 +211,21 @@ class InferenceEngine:
     def __call__(self, input_ids):
         return self.forward(input_ids)
 
+    def destroy(self):
+        """Release device memory and compiled programs (reference
+        engine.py:381 role). Jitted prefill/decode closures capture ``self``;
+        without this, dropping the engine leaves a gc cycle pinning the
+        weights in HBM until a full collection happens to run."""
+        self.params = None
+        self._prefill_fn = None
+        self._decode_fn = None
+        self._prefill_cache = {}
+        import gc
+
+        # no jax.clear_caches(): process-global, would wipe other live
+        # engines' compiled programs; dropping our wrappers is enough
+        gc.collect()
+
     def generate(self, input_ids, max_new_tokens=32, temperature=1.0, top_k=0,
                  greedy=True, eos_token_id=None, rng=None):
         """Autoregressive generation with a jitted prefill + decode loop.

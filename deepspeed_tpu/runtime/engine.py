@@ -1202,6 +1202,34 @@ class DeepSpeedEngine:
     def eval(self):
         return self.train(False)
 
+    def destroy(self):
+        """Release device memory and compiled programs (reference
+        engine.py:381 ``destroy``). The engine's jitted closures capture
+        ``self``, so dropping the last user reference leaves a cycle that
+        holds params/optimizer state in HBM until an eventual full gc pass;
+        after ``destroy()`` the buffers are freed immediately. The engine is
+        unusable afterwards."""
+        self.params = None
+        self.optimizer_state = None
+        self._acc_grads = None
+        self._cached = None   # forward()'s stashed (loss, grads)
+        self._fwd_bwd_fn = None
+        self._accumulate_fn = None
+        self._apply_fn = None
+        self._train_step_fn = None
+        self._eval_fn = None
+        if getattr(self, "_onebit_active", False):
+            self._onebit_fns = {}
+            self._onebit_we = None   # error-feedback buffers (~params-sized)
+            self._onebit_se = None
+        self._offloaded = None
+        import gc
+
+        # no jax.clear_caches(): that is process-global and would force every
+        # OTHER live engine in the process to recompile; dropping this
+        # engine's jitted wrappers frees its executables
+        gc.collect()
+
     def _report_progress(self):
         """Reference ``engine.py:2167`` _report_progress."""
         log_dist(

@@ -122,7 +122,10 @@ def main():
                 }
                 rows.append(row)
                 print(json.dumps(row), flush=True)
-            # free the engine (one chip: keep HBM headroom between configs)
+            # free the engine (one chip: keep HBM headroom between configs).
+            # del alone leaves engine<->jit-closure cycles holding every
+            # device buffer; destroy() is what actually frees HBM.
+            engine.destroy()
             del engine
 
     print(f"\n| model | mode | prompt | ttft p50 (ms) | ttft p95 (ms) | decode tok/s |")

@@ -14,20 +14,65 @@ import time
 import numpy as np
 
 
-def measure(engine, batch, steps=8):
-    import jax
+# v5e HBM is 16 GB; leave headroom for the runtime + fragmentation. An OOM
+# crash mid-sweep can wedge the axon tunnel for hours (observed 2026-07-31),
+# so over-memory variants must be skipped by ANALYSIS, not by crashing.
+HBM_BUDGET = 14.5e9
 
-    engine.train_batch(batch=batch)  # compile + warm
-    engine.train_batch(batch=batch)
-    leaf = jax.tree_util.tree_leaves(engine.params)[0]
-    np.asarray(jax.device_get(leaf.ravel()[0]))
+
+def compile_step(engine, batch):
+    """AOT-compile the exact fused train-step program (one compile total) and
+    return (compiled, projected peak HBM bytes) WITHOUT executing anything —
+    over-budget variants must be skipped by analysis, not by an OOM crash."""
+    import jax
+    import jax.numpy as jnp
+
+    assert engine.gradient_accumulation_steps_ == 1 \
+        and engine._can_fuse_train_step(), \
+        "sweep drives the gas==1 fused step; this variant would run a " \
+        "different program through engine.train_batch"
+    if engine._train_step_fn is None:
+        engine._build_train_step()
+    sharded = engine._shard_batch(batch)
+    compiled = engine._train_step_fn.lower(
+        engine.params, engine.optimizer_state, sharded, engine._scale,
+        engine._good_steps, engine._rng, jnp.asarray(1e-4, jnp.float32),
+        jnp.asarray(1.0, jnp.float32)).compile()
+    mem = compiled.memory_analysis()
+    # donated params/opt-state alias input->output; without subtracting the
+    # alias bytes the projection double-counts ~5 GB and mis-skips exactly
+    # the large-micro-batch variants this sweep exists to measure
+    peak = (mem.temp_size_in_bytes + mem.argument_size_in_bytes +
+            mem.output_size_in_bytes - mem.alias_size_in_bytes)
+    return compiled, sharded, peak
+
+
+def measure(engine, compiled, sharded, steps=8):
+    """Drive the AOT-compiled fused step directly (params/opt-state donated
+    through, like engine.train_batch's hot loop)."""
+    import jax
+    import jax.numpy as jnp
+
+    lr = jnp.asarray(1e-4, jnp.float32)
+    theta = jnp.asarray(1.0, jnp.float32)
+
+    def step():
+        (engine.params, engine.optimizer_state, engine._scale,
+         engine._good_steps, _, _, loss, engine._rng) = compiled(
+            engine.params, engine.optimizer_state, sharded, engine._scale,
+            engine._good_steps, engine._rng, lr, theta)
+        return loss
+
+    step()  # warm (first run may still page in the executable)
+    loss = step()
+    np.asarray(jax.device_get(loss))
     t0 = time.perf_counter()
     for _ in range(steps):
-        engine.train_batch(batch=batch)
-    leaf = jax.tree_util.tree_leaves(engine.params)[0]
-    np.asarray(jax.device_get(leaf.ravel()[0]))
+        loss = step()
+    np.asarray(jax.device_get(loss))
     dt = (time.perf_counter() - t0) / steps
-    return batch["input_ids"].size / dt  # tokens/s
+    tokens = int(np.prod([d for d in sharded["input_ids"].shape]))
+    return tokens / dt  # tokens/s
 
 
 def main():
@@ -84,6 +129,7 @@ def main():
     rng = np.random.RandomState(0)
     print(f"{'variant':<16} {'tok/s':>10} {'MFU':>7}")
     best = (None, 0.0)
+    engine = model = None
     for name, m_over, b in variants:
         try:
             cfg = dict(base_cfg, train_batch_size=b)
@@ -93,15 +139,25 @@ def main():
             engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=cfg)
             batch = {"input_ids": rng.randint(
                 0, 50304, (b, seq)).astype(np.int32)}
-            tps = measure(engine, batch)
-            mfu = tps * 6 * engine.num_parameters / peak
-            print(f"{name:<16} {tps:>10.0f} {mfu:>7.4f}", flush=True)
-            if tps > best[1]:
-                best = (name, tps)
-            del engine
+            compiled, sharded, need = compile_step(engine, batch)
+            if need > HBM_BUDGET:
+                print(f"{name:<16} SKIPPED: projected {need/1e9:.1f} GB "
+                      f"> {HBM_BUDGET/1e9:.1f} GB budget", flush=True)
+            else:
+                tps = measure(engine, compiled, sharded, steps=8)
+                mfu = tps * 6 * engine.num_parameters / peak
+                print(f"{name:<16} {tps:>10.0f} {mfu:>7.4f}", flush=True)
+                if tps > best[1]:
+                    best = (name, tps)
         except Exception as e:
             print(f"{name:<16} FAILED: {type(e).__name__}: {str(e)[:80]}",
                   flush=True)
+        finally:
+            # free HBM before the next variant: del alone leaves
+            # engine<->jit-closure gc cycles pinning every device buffer
+            if engine is not None:
+                engine.destroy()
+            engine = model = None
     print(f"\nbest: {best[0]} at {best[1]:.0f} tok/s")
 
 
