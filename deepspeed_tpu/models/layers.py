@@ -273,7 +273,8 @@ def dot_product_attention(q, k, v, mask=None, scale=None, dropout_rate=0.0,
     """
     head_dim = q.shape[-1]
     scale = scale if scale is not None else 1.0 / math.sqrt(head_dim)
-    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
     logits = checkpoint_name(logits, "attn_logits")
     if alibi_bias is not None:
         logits = logits + alibi_bias

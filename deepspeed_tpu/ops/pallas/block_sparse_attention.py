@@ -75,11 +75,15 @@ def _fwd_kernel(idx_ref, cnt_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
     run = a < n_act
 
     def step(masked):
-        q = q_ref[0].astype(jnp.float32) * scale
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
+        # bf16 dot inputs + fp32 accumulation (MXU native); upcasting tiles to
+        # fp32 before the dot runs fp32xfp32 matmuls at a fraction of bf16
+        # throughput (same fix as flash_attention.py)
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
         s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
         if masked:
             s = _mask_tile(s, this_kv, j, block_q, block_kv, q_offset)
         m_prev = m_scr[:, :1]
@@ -89,7 +93,7 @@ def _fwd_kernel(idx_ref, cnt_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
         corr = jnp.exp(m_prev - m_new)
         l_new = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
         acc_scr[...] = acc_scr[...] * corr + jnp.dot(
-            p, v, preferred_element_type=jnp.float32)
+            p.astype(v.dtype), v, preferred_element_type=jnp.float32)
         m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
         l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
 
@@ -136,10 +140,11 @@ def _dq_kernel(idx_ref, cnt_ref, q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
     run = a < n_act
 
     def step(masked):
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        # bf16 dot inputs + fp32 accumulation (see fwd kernel)
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
         s = scale * jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
         if masked:
@@ -148,7 +153,8 @@ def _dq_kernel(idx_ref, cnt_ref, q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
         ds = p * (dp - delta_scr[:, :1]) * scale
-        dq_scr[...] += jnp.dot(ds, k, preferred_element_type=jnp.float32)
+        dq_scr[...] += jnp.dot(ds.astype(k.dtype), k,
+                               preferred_element_type=jnp.float32)
 
     @pl.when(run & jnp.logical_not(on_diag))
     def _full():
@@ -187,12 +193,13 @@ def _dkv_kernel(idx_ref, cnt_ref, q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
     run = a < n_act
 
     def step(masked):
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
+        # bf16 dot inputs + fp32 accumulation (see fwd kernel)
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
         o = o_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
-        delta = jnp.sum(o * do, axis=-1, keepdims=True)
+        do = do_ref[0]
+        delta = jnp.sum(o * do.astype(jnp.float32), axis=-1, keepdims=True)
         s = scale * jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
         if masked:
@@ -203,12 +210,14 @@ def _dkv_kernel(idx_ref, cnt_ref, q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
                 s, NEG_INF)
         p = jnp.exp(s - lse_ref[0][:, :1])
         dv_scr[...] += jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
         ds = p * (dp - delta) * scale
         dk_scr[...] += jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
 
     @pl.when(run & jnp.logical_not(on_diag))
     def _full():

@@ -76,12 +76,16 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *rest, scale, causal, block_q,
         run_diag = jnp.asarray(False)
 
     def step(masked):
-        q = q_ref[0].astype(jnp.float32) * scale
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
+        # dots take the INPUT dtype (bf16 in training) with fp32 accumulation —
+        # the MXU's native mode. Upcasting tiles to fp32 before the dot forces
+        # fp32xfp32 matmuls at a fraction of bf16 throughput (measured: the
+        # whole kernel lost to plain XLA attention until this was fixed).
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )  # [bq, bkv]
+        ) * scale  # [bq, bkv] fp32
         if masked:
             row = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_kv), 0)
             col = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_kv), 1)
@@ -94,7 +98,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *rest, scale, causal, block_q,
         corr = jnp.exp(m_prev - m_new)
         l_new = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
         acc_scr[...] = acc_scr[...] * corr + jnp.dot(
-            p, v, preferred_element_type=jnp.float32
+            p.astype(v.dtype), v, preferred_element_type=jnp.float32
         )
         m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
         l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
@@ -203,13 +207,14 @@ def _dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref,
         run_diag = jnp.asarray(False)
 
     def step(masked):
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        # bf16 dot inputs, fp32 accumulation (see _fwd_kernel.step)
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
         s = scale * jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )  # [bq, bkv]
+        )  # [bq, bkv] fp32
         if masked:
             row = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_kv), 0)
             col = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_kv), 1)
@@ -218,9 +223,10 @@ def _dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref,
         p = jnp.exp(s - lse_ref[0][:, :1])
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )  # [bq, bkv]
+        )  # [bq, bkv] fp32
         ds = p * (dp - delta_scr[:, :1]) * scale
-        dq_scr[...] += jnp.dot(ds, k, preferred_element_type=jnp.float32)
+        dq_scr[...] += jnp.dot(ds.astype(k.dtype), k,
+                               preferred_element_type=jnp.float32)
 
     @pl.when(run_full)
     def _full():
@@ -262,32 +268,36 @@ def _dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dk_ref, dv_ref,
         run_diag = jnp.asarray(False)
 
     def step(masked):
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
+        # bf16 dot inputs, fp32 accumulation (see _fwd_kernel.step)
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
         o = o_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
-        delta = jnp.sum(o * do, axis=-1, keepdims=True)  # [bq, 1]
+        do = do_ref[0]
+        delta = jnp.sum(o * do.astype(jnp.float32), axis=-1,
+                        keepdims=True)  # [bq, 1]
         s = scale * jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )  # [bq, bkv]
+        )  # [bq, bkv] fp32
         if masked:
             row = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_kv), 0)
             col = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_kv), 1)
             s = jnp.where(jkv * block_kv + col <= qb * block_q + row + q_offset,
                           s, NEG_INF)
-        p = jnp.exp(s - lse_ref[0][:, :1])  # [bq, bkv]
+        p = jnp.exp(s - lse_ref[0][:, :1])  # [bq, bkv] fp32
         # dV += P^T dO
         dv_scr[...] += jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32
         )
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
-        ds = p * (dp - delta) * scale  # [bq, bkv]
+        ds = p * (dp - delta) * scale  # [bq, bkv] fp32
         # dK += dS^T Q
         dk_scr[...] += jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32
         )
 
     @pl.when(run_full)
