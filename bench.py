@@ -155,6 +155,16 @@ def run_benchmark():
     n_chips = len(jax.devices())
 
     # GPT-2 medium-class decoder (~350M params), bf16 compute.
+    # BENCH_FLASH_BLOCKS="bqxbkv[:bq_bwd x bkv_bwd]" tunes the pallas tiles
+    flash_blocks = {}
+    spec = os.environ.get("BENCH_FLASH_BLOCKS", "")
+    if spec:
+        from deepspeed_tpu.ops.flash_attention import parse_block_spec
+
+        bq, bkv, bqb, bkvb = parse_block_spec(spec)
+        flash_blocks = {"flash_block_q": bq, "flash_block_kv": bkv,
+                        "flash_block_q_bwd": bqb, "flash_block_kv_bwd": bkvb}
+
     cfg = TransformerConfig(
         vocab_size=50304,  # padded to a multiple of 128 for MXU-friendly head matmul
         max_seq_len=1024,
@@ -168,6 +178,7 @@ def run_benchmark():
         remat_policy=os.environ.get("BENCH_REMAT", "minimal"),
         scan_layers=os.environ.get("BENCH_SCAN", "1") == "1",
         fused_ce=os.environ.get("BENCH_FUSED_CE", "1") == "1",
+        **flash_blocks,
     )
     model = CausalLM(cfg)
 

@@ -76,6 +76,7 @@ class TransformerConfig:
     # and softmax-CE as one streaming op — the [tokens, vocab] logit matrix is
     # never materialized (fwd or bwd). Big memory + bandwidth win at LLM vocabs.
     fused_ce: bool = True
+    fused_ce_chunks: int = 8  # vocab chunks in the streaming CE (tuning knob)
     remat: bool = False
     remat_policy: str = "nothing_saveable"  # nothing_saveable | dots_with_no_batch_dims
     compute_dtype: typing.Any = jnp.bfloat16
@@ -86,6 +87,12 @@ class TransformerConfig:
     sparse_block: int = 128
     sparse_pattern_config: typing.Any = None  # dict of pattern kwargs
     attention_interpret: bool = False  # pallas interpret mode (CPU tests)
+    # Flash-kernel tile sizes (None = kernel defaults: 256x512 fwd, 256x256
+    # bwd). Tuning knobs for tools/bench_attention.py BENCH_BLOCKS sweeps.
+    flash_block_q: typing.Any = None
+    flash_block_kv: typing.Any = None
+    flash_block_q_bwd: typing.Any = None
+    flash_block_kv_bwd: typing.Any = None
     # Pipeline parallelism (set by the engine from mesh/config; see parallel/pipeline.py)
     pipeline_stages: int = 1
     pipeline_microbatches: int = 1
@@ -320,7 +327,11 @@ def block_apply(cfg, p, x, mask=None, rope=None, alibi=None, deterministic=True,
             from ..ops.flash_attention import flash_attention
 
             out = flash_attention(q, k, v, causal=cfg.causal,
-                                  scale=cfg.attn_scale)
+                                  scale=cfg.attn_scale,
+                                  block_q=cfg.flash_block_q,
+                                  block_kv=cfg.flash_block_kv,
+                                  block_q_bwd=cfg.flash_block_q_bwd,
+                                  block_kv_bwd=cfg.flash_block_kv_bwd)
         else:
             dense_mask = mask if mask is not None else (
                 L.causal_mask(s, s) if cfg.causal else None)
@@ -745,7 +756,8 @@ class CausalLM:
                 emb = params["lm_head"]["kernel"].T
                 bias = params["lm_head"].get("bias")  # GPT-J biased head
             return fused_cross_entropy(
-                x.reshape(-1, cfg.d_model), emb, labels.reshape(-1), bias)
+                x.reshape(-1, cfg.d_model), emb, labels.reshape(-1), bias,
+                n_chunks=cfg.fused_ce_chunks)
         return cross_entropy_loss(self.head(params, x), labels)
 
     def apply(self, params, input_ids, positions=None, attention_mask=None,
@@ -825,7 +837,8 @@ class MaskedLM(CausalLM):
 
             return fused_cross_entropy(
                 h.reshape(-1, cfg.d_model), params["wte"]["weight"],
-                labels.reshape(-1), params["mlm_bias"]["bias"])
+                labels.reshape(-1), params["mlm_bias"]["bias"],
+                n_chunks=cfg.fused_ce_chunks)
         logits = L.embedding_attend(params["wte"], h) \
             + params["mlm_bias"]["bias"].astype(cfg.compute_dtype)
         return cross_entropy_loss(logits, labels)

@@ -22,19 +22,38 @@ import jax.numpy as jnp
 NEG_INF = -1e30
 
 
-def flash_attention(q, k, v, causal=True, scale=None, block_size=512):
+def parse_block_spec(spec):
+    """Parse a "bq x bkv[: bq_bwd x bkv_bwd]" tile-size string (the
+    BENCH_FLASH_BLOCKS / BENCH_BLOCKS knob shared by bench.py and
+    tools/bench_attention.py). Returns (bq, bkv, bq_bwd, bkv_bwd) with the
+    backward pair None when omitted."""
+    fwd, _, bwd = spec.partition(":")
+    bq, bkv = (int(x) for x in fwd.split("x"))
+    if bwd:
+        bqb, bkvb = (int(x) for x in bwd.split("x"))
+    else:
+        bqb = bkvb = None
+    return bq, bkv, bqb, bkvb
+
+
+def flash_attention(q, k, v, causal=True, scale=None, block_size=512,
+                    block_q=None, block_kv=None, block_q_bwd=None,
+                    block_kv_bwd=None):
     """Online-softmax attention, scanned over KV blocks.
 
     For each query block the running (max, sum, acc) triple is updated per KV chunk —
     the same recurrence the FlashAttention kernel uses, expressed as ``lax.scan`` so
-    XLA keeps the working set in registers/VMEM.
+    XLA keeps the working set in registers/VMEM. ``block_*`` override the
+    Pallas kernel's tile sizes (tuning knobs; ignored by the XLA fallback).
     """
     if jax.default_backend() == "tpu" and q.shape[1] % 128 == 0 and k.shape[1] % 128 == 0:
         from .pallas.flash_attention import pallas_flash_attention
 
         return pallas_flash_attention(q, k, v, causal=causal, scale=scale,
-                                      block_q=min(256, q.shape[1]),
-                                      block_kv=min(512, k.shape[1]))
+                                      block_q=min(block_q or 256, q.shape[1]),
+                                      block_kv=min(block_kv or 512, k.shape[1]),
+                                      block_q_bwd=block_q_bwd,
+                                      block_kv_bwd=block_kv_bwd)
     return _chunked_attention(q, k, v, causal=causal, scale=scale,
                               block_size=block_size)
 

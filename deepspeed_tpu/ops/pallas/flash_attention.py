@@ -128,10 +128,10 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_kv, interpret,
     s_kv = k.shape[1]
     scale = scale if scale is not None else 1.0 / math.sqrt(d)
 
-    bq = min(block_q, s_q)
-    bkv = min(block_kv, s_kv)
-    if s_q % bq or s_kv % bkv:
-        raise ValueError(f"seq lengths ({s_q},{s_kv}) must divide blocks ({bq},{bkv})")
+    # clamp to the largest divisor <= requested — a non-dividing request (e.g.
+    # default 512 at seq 640) must degrade, not crash at trace time
+    bq = _fit_block(block_q, s_q)
+    bkv = _fit_block(block_kv, s_kv)
     n_kvb = s_kv // bkv
 
     # [b, s, h, d] -> [b*h, s, d]
@@ -390,23 +390,30 @@ def _flash_bwd(q, k, v, out, lse, g, causal, scale, block_q, block_kv, interpret
 # ---------------------------------------------------------------------------
 # public API
 # ---------------------------------------------------------------------------
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
 def pallas_flash_attention(q, k, v, causal=True, scale=None, block_q=256,
-                           block_kv=512, interpret=False):
+                           block_kv=512, interpret=False, block_q_bwd=None,
+                           block_kv_bwd=None):
+    """block_q/block_kv tile the forward; block_q_bwd/block_kv_bwd the two
+    backward kernels (default: forward blocks clamped to 256 — the bwd holds
+    more live tiles per step, so its sweet spot is smaller)."""
     return _flash_fwd(q, k, v, causal, scale, block_q, block_kv, interpret)
 
 
-def _vjp_fwd(q, k, v, causal, scale, block_q, block_kv, interpret):
+def _vjp_fwd(q, k, v, causal, scale, block_q, block_kv, interpret,
+             block_q_bwd, block_kv_bwd):
     out, lse = _flash_fwd(q, k, v, causal, scale, block_q, block_kv, interpret,
                           need_lse=True)
     return out, (q, k, v, out, lse)
 
 
-def _vjp_bwd(causal, scale, block_q, block_kv, interpret, residuals, g):
+def _vjp_bwd(causal, scale, block_q, block_kv, interpret, block_q_bwd,
+             block_kv_bwd, residuals, g):
     q, k, v, out, lse = residuals
     lse = jnp.broadcast_to(lse, lse.shape[:-1] + (LANES,))
     return _flash_bwd(q, k, v, out, lse, g, causal, scale,
-                      min(block_q, 256), min(block_kv, 256), interpret)
+                      block_q_bwd or min(block_q, 256),
+                      block_kv_bwd or min(block_kv, 256), interpret)
 
 
 pallas_flash_attention.defvjp(_vjp_fwd, _vjp_bwd)

@@ -68,20 +68,24 @@ def main():
         if not fwd_only:
             flops *= 4.5
         impls = [("xla", xla_attn), ("flash", flash)]
-        # BENCH_BLOCKS="128x256,256x512,512x512": sweep flash kernel block
-        # sizes (block_q x block_kv) — the tuning knob VERDICT r2 flagged.
-        # TPU-only: the CPU fallback path ignores block sizes.
+        # BENCH_BLOCKS="128x256,256x512,512x512:256x512": sweep flash kernel
+        # block sizes (block_q x block_kv, optional ":bq_bwd x bkv_bwd") —
+        # the tuning knob VERDICT r2 flagged. TPU-only: the CPU fallback path
+        # ignores block sizes.
         blocks = os.environ.get("BENCH_BLOCKS", "")
         if blocks:
+            from deepspeed_tpu.ops.flash_attention import parse_block_spec
             from deepspeed_tpu.ops.pallas.flash_attention import (
                 pallas_flash_attention)
 
             for spec in blocks.split(","):
-                bq, bkv = (int(x) for x in spec.split("x"))
+                bq, bkv, bqb, bkvb = parse_block_spec(spec)
                 impls.append((
-                    f"fl{bq}x{bkv}",
-                    lambda q, k, v, bq=bq, bkv=bkv: pallas_flash_attention(
-                        q, k, v, causal=True, block_q=bq, block_kv=bkv)))
+                    f"fl{spec}",
+                    lambda q, k, v, bq=bq, bkv=bkv, bqb=bqb, bkvb=bkvb:
+                    pallas_flash_attention(
+                        q, k, v, causal=True, block_q=bq, block_kv=bkv,
+                        block_q_bwd=bqb, block_kv_bwd=bkvb)))
         for name, fn in impls:
             try:
                 dt = bench(fn, q, k, v)

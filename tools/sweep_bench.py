@@ -119,6 +119,15 @@ def main():
         ("remat-dots-b12", {"remat_policy": "dots_with_no_batch_dims"}, 12),
         ("noclip-b12", {}, 12),  # gradient_clipping removed below
         ("flash-b16", {"attention_impl": "flash"}, 16),
+        # flash tile-size variants (kernel defaults are 256x512 fwd, 256x256
+        # bwd); larger tiles amortize the online-softmax bookkeeping
+        ("flash-big-b12", {"attention_impl": "flash", "flash_block_q": 512,
+                           "flash_block_kv": 1024, "flash_block_q_bwd": 256,
+                           "flash_block_kv_bwd": 512}, 12),
+        ("flash-b24", {"attention_impl": "flash"}, 24),
+        # CE vocab-chunk count: fewer chunks = bigger head GEMMs per pass
+        ("ce4-b12", {"fused_ce_chunks": 4}, 12),
+        ("ce16-b12", {"fused_ce_chunks": 16}, 12),
     ]
     sel = os.environ.get("BENCH_SWEEP")
     if sel:
