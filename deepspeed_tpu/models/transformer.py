@@ -411,7 +411,10 @@ def _remat_policy(cfg):
         "dots_with_no_batch_dims": jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims,
         "everything_saveable": jax.checkpoint_policies.everything_saveable,
         "minimal": jax.checkpoint_policies.save_only_these_names(
-            "q_proj", "k_proj", "v_proj", "attn_out", "mlp_hidden"
+            # attn_lse: the flash kernel's softmax statistics ([tokens, 1] —
+            # trivial HBM) — without it the backward re-runs the whole forward
+            # flash kernel per layer just to regenerate the lse residual
+            "q_proj", "k_proj", "v_proj", "attn_out", "attn_lse", "mlp_hidden"
         ),
     }[cfg.remat_policy]
 

@@ -28,6 +28,7 @@ import math
 
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -404,6 +405,12 @@ def _vjp_fwd(q, k, v, causal, scale, block_q, block_kv, interpret,
              block_q_bwd, block_kv_bwd):
     out, lse = _flash_fwd(q, k, v, causal, scale, block_q, block_kv, interpret,
                           need_lse=True)
+    # Names for remat policies: saving "attn_out"+"attn_lse" (models' "minimal"
+    # policy) makes the backward's residuals fully available — without the lse
+    # name the checkpoint recompute must RE-RUN the whole forward kernel just
+    # to regenerate the [tokens, 1] lse.
+    out = checkpoint_name(out, "attn_out")
+    lse = checkpoint_name(lse, "attn_lse")
     return out, (q, k, v, out, lse)
 
 

@@ -159,3 +159,27 @@ def test_pallas_bwd_nondivisible_clamp_is_safe():
         assert np.all(np.isfinite(np.asarray(b_)))
         np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
                                    rtol=5e-4, atol=5e-4)
+
+
+def test_pallas_lse_named_for_remat_policy():
+    """The "minimal" remat policy saves attn_out + attn_lse: the backward must
+    NOT re-run the forward flash kernel to regenerate the lse residual (3
+    pallas_calls total: fwd + dq + dkv — not 4)."""
+    from jax.ad_checkpoint import checkpoint_name
+
+    from deepspeed_tpu.ops.pallas.flash_attention import pallas_flash_attention
+
+    q = jnp.ones((1, 128, 2, 32), jnp.float32)
+
+    def attn(q):
+        out = pallas_flash_attention(q, q, q, True, None, 64, 64, True)
+        return (checkpoint_name(out, "attn_out") * q).sum()
+
+    def count(names):
+        pol = jax.checkpoint_policies.save_only_these_names(*names)
+        f = jax.checkpoint(attn, policy=pol)
+        return str(jax.make_jaxpr(jax.grad(f))(q)).count("pallas_call")
+
+    assert count(("attn_out", "attn_lse")) == 3
+    # sanity: without the lse name the recompute re-runs the fwd kernel
+    assert count(("attn_out",)) == 4

@@ -1,0 +1,68 @@
+"""Wait for the axon TPU tunnel to answer, probing safely in a loop.
+
+Each probe is bench.py's killable-subprocess probe (45 s timeout, SIGTERM
+with grace before SIGKILL) — the parent never imports jax, so this script can
+wait for hours without itself wedging anything.
+
+    python tools/wait_for_chip.py [--max-minutes N] [--interval S]
+
+Exits 0 the moment a probe sees a real TPU device; exits 1 on giving up.
+"""
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--max-minutes", type=float, default=600.0)
+    ap.add_argument("--interval", type=float, default=180.0)
+    args = ap.parse_args()
+
+    deadline = time.time() + args.max_minutes * 60
+    attempt = 0
+    while time.time() < deadline:
+        attempt += 1
+        t0 = time.time()
+        # bench.py's _run_subprocess semantics: probe in a fresh session with
+        # a hard timeout, SIGTERM grace before SIGKILL
+        proc = subprocess.Popen(
+            [sys.executable, "-u", os.path.join(REPO, "bench.py"), "--probe"],
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            start_new_session=True,
+        )
+        try:
+            rc = proc.wait(timeout=45)
+        except subprocess.TimeoutExpired:
+            rc = None
+            for sig, grace in ((signal.SIGTERM, 15), (signal.SIGKILL, 10)):
+                try:
+                    os.killpg(proc.pid, sig)
+                except (ProcessLookupError, PermissionError):
+                    pass
+                try:
+                    proc.wait(timeout=grace)
+                    break
+                except subprocess.TimeoutExpired:
+                    pass
+        dt = time.time() - t0
+        stamp = time.strftime("%H:%M:%S")
+        if rc == 0:
+            print(f"[{stamp}] probe #{attempt}: TPU ANSWERED ({dt:.0f}s)",
+                  flush=True)
+            return 0
+        print(f"[{stamp}] probe #{attempt}: no TPU (rc={rc}, {dt:.0f}s); "
+              f"retrying in {args.interval:.0f}s", flush=True)
+        time.sleep(args.interval)
+    print("gave up waiting for the chip", flush=True)
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
