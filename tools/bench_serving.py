@@ -107,26 +107,29 @@ def main():
     for size in args.sizes.split(","):
         for mode in args.modes.split(","):
             engine, n_params = build_engine(args.family, size, mode, max_tokens)
-            for p in prompts:
-                ttft50, ttft95, dec = bench_one(
-                    engine, p, args.new_tokens, args.batch, args.repeats, rng)
-                row = {
-                    "model": f"{args.family}-{size}", "mode": mode,
-                    "prompt_len": p, "batch": args.batch,
-                    "new_tokens": args.new_tokens,
-                    "ttft_p50_ms": round(ttft50, 2),
-                    "ttft_p95_ms": round(ttft95, 2),
-                    "decode_tok_s": round(dec, 1),
-                    "n_params_m": round(n_params / 1e6, 1),
-                    "platform": platform,
-                }
-                rows.append(row)
-                print(json.dumps(row), flush=True)
-            # free the engine (one chip: keep HBM headroom between configs).
-            # del alone leaves engine<->jit-closure cycles holding every
-            # device buffer; destroy() is what actually frees HBM.
-            engine.destroy()
-            del engine
+            try:
+                for p in prompts:
+                    ttft50, ttft95, dec = bench_one(
+                        engine, p, args.new_tokens, args.batch, args.repeats, rng)
+                    row = {
+                        "model": f"{args.family}-{size}", "mode": mode,
+                        "prompt_len": p, "batch": args.batch,
+                        "new_tokens": args.new_tokens,
+                        "ttft_p50_ms": round(ttft50, 2),
+                        "ttft_p95_ms": round(ttft95, 2),
+                        "decode_tok_s": round(dec, 1),
+                        "n_params_m": round(n_params / 1e6, 1),
+                        "platform": platform,
+                    }
+                    rows.append(row)
+                    print(json.dumps(row), flush=True)
+            finally:
+                # free the engine even on a mid-bench crash (one chip: a later
+                # phase in the same process budgets HBM assuming an empty
+                # device). del alone leaves engine<->jit-closure cycles holding
+                # every device buffer; destroy() is what actually frees HBM.
+                engine.destroy()
+                del engine
 
     print(f"\n| model | mode | prompt | ttft p50 (ms) | ttft p95 (ms) | decode tok/s |")
     print("|---|---|---|---|---|---|")

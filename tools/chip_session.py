@@ -1,0 +1,73 @@
+"""One-claim benchmark session: every perf tool in ONE process.
+
+The axon tunnel serves one claim, and the claim handoff between processes is
+where wedges happen (observed 2026-07-31: a 10 s gap between two TPU
+processes wedged the tunnel for >30 min; a ~60 s gap worked). This runner
+holds a single claim for the whole measurement plan:
+
+    python tools/chip_session.py                 # sweep + attention + serving
+    BENCH_PHASES="sweep,attn" python tools/chip_session.py
+
+Each phase is fenced with try/except so one failure doesn't cost the rest.
+"""
+
+import os
+import sys
+import time
+import traceback
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_phase(name, fn):
+    print(f"\n===== phase: {name} =====", flush=True)
+    t0 = time.time()
+    try:
+        fn()
+        print(f"===== {name} done in {time.time() - t0:.0f}s =====", flush=True)
+    except (KeyboardInterrupt, SystemExit):
+        # Ctrl-C means "release the chip NOW", not "try the next phase"
+        raise
+    except Exception as e:
+        traceback.print_exc()
+        print(f"===== {name} FAILED: {type(e).__name__}: {str(e)[:200]} =====",
+              flush=True)
+
+
+def _sweep():
+    import sweep_bench
+
+    sweep_bench.main()
+
+
+def _attn():
+    import bench_attention
+
+    bench_attention.main()
+
+
+def _serving():
+    import bench_serving
+
+    # gpt2 small+medium (default), then bloom-560m — the closest one-chip
+    # proxy to the BLOOM TTFT north star (BASELINE.json)
+    for argv in ([], ["--family", "bloom", "--sizes", "560m"]):
+        sys.argv = ["bench_serving.py"] + argv
+        bench_serving.main()
+
+
+def main():
+    phases = os.environ.get("BENCH_PHASES", "sweep,attn,serving").split(",")
+    # imports stay inside the phase fences: a broken unselected module must
+    # not cost the whole claim
+    table = {"sweep": _sweep, "attn": _attn, "serving": _serving}
+    for p in phases:
+        p = p.strip()
+        if p in table:
+            run_phase(p, table[p])
+        else:
+            print(f"unknown phase: {p}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -125,6 +125,15 @@ def main():
                            "flash_block_kv": 1024, "flash_block_q_bwd": 256,
                            "flash_block_kv_bwd": 512}, 12),
         ("flash-b24", {"attention_impl": "flash"}, 24),
+        # flash kills the O(s^2) probs activation AND (with the saved lse)
+        # the bwd fwd-kernel re-run — bigger micro-batches may now fit
+        ("flash-b32", {"attention_impl": "flash"}, 32),
+        ("flash-b24-noremat", {"attention_impl": "flash", "remat": False}, 24),
+        # single kv block at seq 1024: one online-softmax step — no multi-step
+        # (m, l, acc) bookkeeping at all; big bwd tiles to match
+        ("flash-huge-b24", {"attention_impl": "flash", "flash_block_q": 512,
+                            "flash_block_kv": 1024, "flash_block_q_bwd": 512,
+                            "flash_block_kv_bwd": 1024}, 24),
         # CE vocab-chunk count: fewer chunks = bigger head GEMMs per pass
         ("ce4-b12", {"fused_ce_chunks": 4}, 12),
         ("ce16-b12", {"fused_ce_chunks": 16}, 12),
