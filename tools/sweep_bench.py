@@ -134,6 +134,10 @@ def main():
         ("flash-huge-b24", {"attention_impl": "flash", "flash_block_q": 512,
                             "flash_block_kv": 1024, "flash_block_q_bwd": 512,
                             "flash_block_kv_bwd": 1024}, 24),
+        # lean remat (no mlp_hidden save): trades one fc-GEMM recompute for
+        # ~60% of the per-layer activation HBM — room for larger batches
+        ("flash-b32-nomlp", {"attention_impl": "flash",
+                             "remat_policy": "minimal_nomlp"}, 32),
         # CE vocab-chunk count: fewer chunks = bigger head GEMMs per pass
         ("ce4-b12", {"fused_ce_chunks": 4}, 12),
         ("ce16-b12", {"fused_ce_chunks": 16}, 12),
