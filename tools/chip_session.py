@@ -56,8 +56,39 @@ def _serving():
         bench_serving.main()
 
 
+def _connect():
+    """Block until the backend answers, retrying forever.
+
+    Each failed axon init takes ~25 min to return UNAVAILABLE (observed
+    2026-07-31: attempts at 04:47->05:12->05:38, metronomic), and a retry in
+    the same process genuinely re-attempts — so this loop IS the patient
+    knocker. Gating here means no measurement phase ever burns its variants
+    on a dead tunnel; the moment a connect succeeds, every phase runs."""
+    from _common import maybe_force_cpu
+
+    maybe_force_cpu()
+    import jax
+
+    attempt = 0
+    while True:
+        attempt += 1
+        t0 = time.time()
+        try:
+            devs = jax.devices()
+            plat = devs[0].platform
+            if plat == "cpu" and os.environ.get("BENCH_FORCE_CPU") != "1":
+                raise RuntimeError("backend fell back to cpu (TPU unavailable)")
+            print(f"connect attempt {attempt}: backend up — {plat} "
+                  f"x{len(devs)} ({time.time() - t0:.0f}s)", flush=True)
+            return
+        except RuntimeError as e:
+            print(f"connect attempt {attempt}: {str(e)[:140]} "
+                  f"({time.time() - t0:.0f}s); retrying", flush=True)
+
+
 def main():
     phases = os.environ.get("BENCH_PHASES", "sweep,attn,serving").split(",")
+    _connect()
     # imports stay inside the phase fences: a broken unselected module must
     # not cost the whole claim
     table = {"sweep": _sweep, "attn": _attn, "serving": _serving}
