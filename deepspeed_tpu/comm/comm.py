@@ -181,6 +181,24 @@ def broadcast_in_program(tensor, axis_name, src=0):
 # ---------------------------------------------------------------------------------
 # Host-control plane (eager, multi-host)
 # ---------------------------------------------------------------------------------
+def _rank_from_hostlist(hosts_csv):
+    """Rank = this host's index in the pdsh broadcast host list. Matches the
+    fully-qualified name first, then the short name (pdsh -w lists are
+    usually short names while gethostname() may be an FQDN)."""
+    import socket
+
+    hosts = [h.strip() for h in hosts_csv.split(",") if h.strip()]
+    fqdn = socket.gethostname()
+    short = fqdn.split(".")[0]
+    for candidate in (fqdn, short):
+        if candidate in hosts:
+            return hosts.index(candidate)
+    raise RuntimeError(
+        f"init_distributed: this host ({fqdn}) is not in DS_TPU_HOSTS "
+        f"({hosts_csv}) — the pdsh transport must launch on exactly the "
+        f"listed hosts")
+
+
 _initialized = False
 
 
@@ -226,9 +244,16 @@ def init_distributed(dist_backend=None, timeout=None, init_method=None, rank=-1,
                 "address — set DS_TPU_COORDINATOR (or MASTER_ADDR) to the host "
                 "that runs process 0")
         port = os.environ.get("MASTER_PORT", "8476")
-        process_id = int(_env_first(
+        pid_env = _env_first(
             "DS_TPU_PROCESS_ID", "RANK", "SLURM_PROCID", "OMPI_COMM_WORLD_RANK",
-            "PMI_RANK", default="0"))
+            "PMI_RANK")
+        if pid_env == "" and os.environ.get("DS_TPU_HOSTS"):
+            # pdsh transport: the SAME command line reaches every host, so the
+            # rank is derived from this host's position in the broadcast host
+            # list (the reference's launch.py node_rank-from-world-info role,
+            # multinode_runner.py:51 PDSHRunner)
+            pid_env = str(_rank_from_hostlist(os.environ["DS_TPU_HOSTS"]))
+        process_id = int(pid_env or "0")
         jax.distributed.initialize(
             coordinator_address=f"{coordinator}:{port}",
             num_processes=num_processes,

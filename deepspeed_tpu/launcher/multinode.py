@@ -16,11 +16,13 @@ and world size from ``SLURM_PROCID``/``SLURM_NTASKS``,
 the reference's base64 world-info blob threaded through ``launch.py``.
 """
 
+import os
+import shlex
 import shutil
 import subprocess
 import sys
 
-__all__ = ["SlurmRunner", "OpenMPIRunner", "MPICHRunner",
+__all__ = ["PDSHRunner", "SlurmRunner", "OpenMPIRunner", "MPICHRunner",
            "MULTINODE_RUNNERS"]
 
 
@@ -160,5 +162,53 @@ class MPICHRunner(_Transport):
         return cmd + self._python_exec(user_script, user_args)
 
 
+class PDSHRunner(_Transport):
+    """``pdsh`` transport (reference ``multinode_runner.py:51``).
+
+    pdsh broadcasts ONE command line to every host (``-w h1,h2``), so unlike
+    the ssh runner it cannot inline a per-host rank. Instead the command
+    exports the host list itself (``DS_TPU_HOSTS``) and each process derives
+    its rank from its own hostname's position at ``init_distributed`` time —
+    the role the reference fills by threading a world-info blob through
+    ``launch.py``. ``-S`` propagates the worst remote exit code; ``-f``
+    matches the reference's fanout of 1024.
+    """
+
+    name = "pdsh"
+
+    def __init__(self, hosts, *, coordinator=None, master_port=8476, **kw):
+        """``hosts``: ordered host list (rank = position). ``coordinator``
+        defaults to hosts[0]."""
+        if isinstance(hosts, str):
+            hosts = [h.strip() for h in hosts.split(",") if h.strip()]
+        if not hosts:
+            raise ValueError("pdsh transport needs a non-empty host list")
+        super().__init__(len(hosts), **kw)
+        self.hosts = list(hosts)
+        self.coordinator = coordinator or self.hosts[0]
+        self.master_port = int(master_port)
+
+    def backend_exists(self):
+        return bool(shutil.which("pdsh"))
+
+    def build_cmd(self, user_script, user_args=()):
+        env = {
+            "DS_TPU_HOSTS": ",".join(self.hosts),
+            "DS_TPU_NUM_PROCESSES": str(self.num_hosts),
+            "DS_TPU_COORDINATOR": self.coordinator,
+            "MASTER_PORT": str(self.master_port),
+            "PDSH_RCMD_TYPE": "ssh",
+        }
+        env.update(self.exports)
+        exports = " ".join(f"export {k}={shlex.quote(str(v))};"
+                           for k, v in sorted(env.items()))
+        py = " ".join(shlex.quote(c)
+                      for c in self._python_exec(user_script, user_args))
+        remote = f"{exports} cd {shlex.quote(os.getcwd())} && {py}"
+        return (["pdsh", "-S", "-f", "1024", "-w", ",".join(self.hosts)]
+                + self.launcher_args + [remote])
+
+
 MULTINODE_RUNNERS = {r.name: r
-                     for r in (SlurmRunner, OpenMPIRunner, MPICHRunner)}
+                     for r in (PDSHRunner, SlurmRunner, OpenMPIRunner,
+                               MPICHRunner)}

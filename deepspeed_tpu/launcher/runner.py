@@ -50,10 +50,11 @@ def parse_args(args=None):
                              "host over ssh (reference PDSH runner role)")
     parser.add_argument("--ssh_port", type=int, default=22)
     parser.add_argument("--launcher", type=str, default="",
-                        choices=["", "ssh", "slurm", "openmpi", "mpich"],
+                        choices=["", "ssh", "pdsh", "slurm", "openmpi",
+                                 "mpich"],
                         help="multi-node transport (reference --launcher): "
-                             "ssh | slurm (srun) | openmpi | mpich (mpirun); "
-                             "one process per HOST either way")
+                             "ssh | pdsh | slurm (srun) | openmpi | mpich "
+                             "(mpirun); one process per HOST either way")
     parser.add_argument("--launcher_args", type=str, default="",
                         help="extra args passed through to srun/mpirun")
     parser.add_argument("--slurm_comment", type=str, default="",
@@ -255,6 +256,26 @@ def main(args=None):
             if args.deepspeed_config else None
         logger.info(f"ds_tpu: ssh launch on {len(hosts)} hosts")
         return runner.run(cmd, extra)
+    if args.launcher == "pdsh":
+        import shlex
+
+        from .multinode import PDSHRunner
+
+        if not resource_pool:
+            raise ValueError("--launcher pdsh needs --hostfile")
+        hosts = sorted(resource_pool)  # position in this list = rank
+        exports = {}
+        if args.deepspeed_config:
+            exports["DS_TPU_CONFIG"] = args.deepspeed_config
+        runner = PDSHRunner(
+            hosts, coordinator=args.master_addr or hosts[0],
+            master_port=args.master_port, exports=exports,
+            launcher_args=shlex.split(args.launcher_args), module=args.module)
+        if not runner.backend_exists():
+            logger.warning("ds_tpu: pdsh not found on PATH; the built "
+                           "command may fail to execute")
+        logger.info(f"ds_tpu: pdsh launch on {len(hosts)} hosts")
+        return runner.run(args.user_script, args.user_args)
     if args.launcher in ("slurm", "openmpi", "mpich"):
         import shlex
 

@@ -380,3 +380,71 @@ def test_cli_mpich_writes_hydra_machinefile(tmp_path, monkeypatch):
     rc = R.main(["--hostfile", str(hf), "--launcher", "mpich", "train.py"])
     assert rc == 0
     assert open(captured["hostfile"]).read() == "tpu-0\ntpu-1\n"
+
+
+def test_pdsh_runner_builds_broadcast_command():
+    """PDSH transport (reference multinode_runner.py:51 semantics): ONE command
+    broadcast to every host via -w, rendezvous env inlined as exports, rank
+    derived per-host from DS_TPU_HOSTS at init_distributed time."""
+    from deepspeed_tpu.launcher.multinode import PDSHRunner
+
+    r = PDSHRunner(["tpu-0", "tpu-1", "tpu-2"], master_port=9999,
+                   exports={"XLA_FLAGS": "--foo"})
+    cmd = r.build_cmd("train.py", ["--epochs", "2"])
+    assert cmd[:5] == ["pdsh", "-S", "-f", "1024", "-w"]
+    assert cmd[5] == "tpu-0,tpu-1,tpu-2"
+    remote = cmd[6]
+    assert "export DS_TPU_HOSTS=tpu-0,tpu-1,tpu-2;" in remote
+    assert "export DS_TPU_NUM_PROCESSES=3;" in remote
+    assert "export DS_TPU_COORDINATOR=tpu-0;" in remote
+    assert "export MASTER_PORT=9999;" in remote
+    assert "export PDSH_RCMD_TYPE=ssh;" in remote
+    assert "export XLA_FLAGS=--foo;" in remote
+    assert remote.endswith("train.py --epochs 2")
+    # no per-host rank in the broadcast command — that's the whole point
+    assert "DS_TPU_PROCESS_ID" not in remote
+
+
+def test_pdsh_rank_from_hostname(monkeypatch):
+    """The pdsh rank derivation: hostname position in DS_TPU_HOSTS, FQDN or
+    short name; an unlisted host is an error, not rank 0."""
+    import socket
+
+    from deepspeed_tpu.comm.comm import _rank_from_hostlist
+
+    monkeypatch.setattr(socket, "gethostname", lambda: "tpu-1.example.com")
+    assert _rank_from_hostlist("tpu-0,tpu-1,tpu-2") == 1
+    monkeypatch.setattr(socket, "gethostname", lambda: "tpu-2")
+    assert _rank_from_hostlist("tpu-0, tpu-1, tpu-2") == 2
+    monkeypatch.setattr(socket, "gethostname", lambda: "other")
+    try:
+        _rank_from_hostlist("tpu-0,tpu-1")
+        raise AssertionError("unlisted host must raise")
+    except RuntimeError as e:
+        assert "not in DS_TPU_HOSTS" in str(e)
+
+
+def test_cli_builds_pdsh_transport(tmp_path, monkeypatch):
+    """ds_tpu --launcher pdsh: hostfile -> ordered host list (rank order),
+    coordinator = first host, config forwarded in the broadcast exports."""
+    from deepspeed_tpu.launcher import runner as R
+
+    hf = tmp_path / "hostfile"
+    hf.write_text("tpu-1 slots=4\ntpu-0 slots=4\n")
+    captured = {}
+
+    def fake_run(self, user_script, user_args=()):
+        captured["cmd"] = self.build_cmd(user_script, user_args)
+        return 0
+
+    monkeypatch.setattr("deepspeed_tpu.launcher.multinode._Transport.run",
+                        fake_run)
+    rc = R.main(["--hostfile", str(hf), "--launcher", "pdsh",
+                 "--deepspeed_config", "/tmp/ds.json", "train.py"])
+    assert rc == 0
+    cmd = captured["cmd"]
+    assert cmd[:5] == ["pdsh", "-S", "-f", "1024", "-w"]
+    assert cmd[5] == "tpu-0,tpu-1"
+    assert "export DS_TPU_HOSTS=tpu-0,tpu-1;" in cmd[6]
+    assert "export DS_TPU_COORDINATOR=tpu-0;" in cmd[6]
+    assert "export DS_TPU_CONFIG=/tmp/ds.json;" in cmd[6]
