@@ -209,6 +209,52 @@ class PDSHRunner(_Transport):
                 + self.launcher_args + [remote])
 
 
+class MVAPICHRunner(_Transport):
+    """``mpirun`` (MVAPICH2/Hydra) transport (reference
+    ``multinode_runner.py:256``).
+
+    One process per node via ``-ppn 1``; env forwarded with ``-env K V``.
+    Keeps the reference's DL-friendly MV2 defaults that apply off-GPU
+    (``MV2_SUPPORT_DL``, affinity off for MPI_THREAD_MULTIPLE, CMA off,
+    backtraces on); the CUDA-specific ones are dropped — the data plane here
+    is ICI/DCN owned by XLA, MPI only bootstraps rank startup."""
+
+    name = "mvapich"
+
+    MV2_DEFAULTS = {
+        "MV2_SMP_USE_CMA": "0",
+        "MV2_DEBUG_SHOW_BACKTRACE": "1",
+        "MV2_SUPPORT_DL": "1",
+        "MV2_ENABLE_AFFINITY": "0",
+    }
+
+    def __init__(self, num_hosts, *, hostfile="", **kw):
+        super().__init__(num_hosts, **kw)
+        self.hostfile = hostfile
+        for k, v in self.MV2_DEFAULTS.items():
+            self.exports.setdefault(k, v)
+
+    def backend_exists(self):
+        # `mpiname` is MVAPICH's own id tool (reference checks its banner)
+        if not shutil.which("mpiname"):
+            return False
+        try:
+            out = subprocess.run(["mpiname"], capture_output=True, text=True,
+                                 timeout=10)
+            return "mvapich" in (out.stdout + out.stderr).lower()
+        except (OSError, subprocess.TimeoutExpired):
+            return False
+
+    def build_cmd(self, user_script, user_args=()):
+        cmd = ["mpirun", "-np", str(self.num_hosts), "-ppn", "1"]
+        if self.hostfile:
+            cmd += ["--hostfile", self.hostfile]
+        cmd += self.launcher_args
+        for k, v in sorted(self.exports.items()):
+            cmd += ["-env", k, str(v)]
+        return cmd + self._python_exec(user_script, user_args)
+
+
 MULTINODE_RUNNERS = {r.name: r
                      for r in (PDSHRunner, SlurmRunner, OpenMPIRunner,
-                               MPICHRunner)}
+                               MPICHRunner, MVAPICHRunner)}

@@ -51,7 +51,7 @@ def parse_args(args=None):
     parser.add_argument("--ssh_port", type=int, default=22)
     parser.add_argument("--launcher", type=str, default="",
                         choices=["", "ssh", "pdsh", "slurm", "openmpi",
-                                 "mpich"],
+                                 "mpich", "mvapich"],
                         help="multi-node transport (reference --launcher): "
                              "ssh | pdsh | slurm (srun) | openmpi | mpich "
                              "(mpirun); one process per HOST either way")
@@ -276,7 +276,7 @@ def main(args=None):
                            "command may fail to execute")
         logger.info(f"ds_tpu: pdsh launch on {len(hosts)} hosts")
         return runner.run(args.user_script, args.user_args)
-    if args.launcher in ("slurm", "openmpi", "mpich"):
+    if args.launcher in ("slurm", "openmpi", "mpich", "mvapich"):
         import shlex
 
         from .multinode import MULTINODE_RUNNERS
@@ -321,7 +321,7 @@ def main(args=None):
                 import tempfile
 
                 line = ("{h} slots=1\n" if args.launcher == "openmpi"
-                        else "{h}\n")
+                        else "{h}\n")  # mpich/mvapich: plain host lines
                 eff = tempfile.NamedTemporaryFile(
                     "w", prefix="ds_tpu_hosts_", suffix=".txt", delete=False)
                 for h in sorted(resource_pool):

@@ -448,3 +448,22 @@ def test_cli_builds_pdsh_transport(tmp_path, monkeypatch):
     assert "export DS_TPU_HOSTS=tpu-0,tpu-1;" in cmd[6]
     assert "export DS_TPU_COORDINATOR=tpu-0;" in cmd[6]
     assert "export DS_TPU_CONFIG=/tmp/ds.json;" in cmd[6]
+
+
+def test_mvapich_runner_builds_mpirun_command():
+    """MVAPICH transport (reference multinode_runner.py:256 semantics): one
+    process per node via -ppn 1, env via -env K V, MV2 DL defaults kept."""
+    from deepspeed_tpu.launcher.multinode import MVAPICHRunner
+
+    r = MVAPICHRunner(2, hostfile="/tmp/hf",
+                      exports={"DS_TPU_COORDINATOR": "h0"})
+    cmd = r.build_cmd("train.py")
+    assert cmd[:5] == ["mpirun", "-np", "2", "-ppn", "1"]
+    assert ["--hostfile", "/tmp/hf"] == cmd[5:7]
+    joined = " ".join(cmd)
+    assert "-env DS_TPU_COORDINATOR h0" in joined
+    assert "-env MV2_SUPPORT_DL 1" in joined
+    assert "-env MV2_ENABLE_AFFINITY 0" in joined
+    # user exports beat the MV2 defaults
+    r2 = MVAPICHRunner(1, exports={"MV2_SUPPORT_DL": "0"})
+    assert "-env MV2_SUPPORT_DL 0" in " ".join(r2.build_cmd("t.py"))
