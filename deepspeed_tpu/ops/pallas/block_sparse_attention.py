@@ -22,6 +22,7 @@ import math
 import numpy as np
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -270,6 +271,12 @@ class BlockSparseAttention:
 
         def fwd(q, k, v):
             out, lse = self._forward(q, k, v)
+            # named residuals so remat policies ("minimal") can save them —
+            # without the lse name the backward re-runs the whole forward
+            # kernel per layer just to regenerate it (same fix as
+            # ops/pallas/flash_attention.py _vjp_fwd)
+            out = checkpoint_name(out, "attn_out")
+            lse = checkpoint_name(lse, "attn_lse")
             return out, (q, k, v, out, lse)
 
         def bwd(res, g):
