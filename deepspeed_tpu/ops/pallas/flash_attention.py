@@ -140,10 +140,23 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_kv, interpret,
     kr = k.transpose(0, 2, 1, 3).reshape(b * h, s_kv, d)
     vr = v.transpose(0, 2, 1, 3).reshape(b * h, s_kv, d)
 
+    q_offset = s_kv - s_q
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal, block_q=bq, block_kv=bkv,
-        q_offset=s_kv - s_q, n_kvb=n_kvb, emit_lse=need_lse,
+        q_offset=q_offset, n_kvb=n_kvb, emit_lse=need_lse,
     )
+
+    if causal:
+        # above-diagonal iterations are compute-skipped (pl.when) but Pallas
+        # would still DMA whatever block the index_map names — clamp them to
+        # the diagonal block so the revisit-dedup skips the fetch (at long
+        # seq this halves K/V HBM traffic)
+        def kv_index(i, j, kb):
+            return (i, jnp.minimum(kb, (j * bq + bq - 1 + q_offset) // bkv), 0)
+    else:
+        def kv_index(i, j, kb):
+            return (i, kb, 0)
+
     out_specs = [pl.BlockSpec((1, bq, d), lambda i, j, kb: (i, j, 0),
                               memory_space=pltpu.VMEM)]
     out_shape = [jax.ShapeDtypeStruct((b * h, s_q, d), q.dtype)]
@@ -156,8 +169,8 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_kv, interpret,
         grid=(b * h, s_q // bq, n_kvb),
         in_specs=[
             pl.BlockSpec((1, bq, d), lambda i, j, kb: (i, j, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, bkv, d), lambda i, j, kb: (i, kb, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, bkv, d), lambda i, j, kb: (i, kb, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bkv, d), kv_index, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bkv, d), kv_index, memory_space=pltpu.VMEM),
         ],
         out_specs=out_specs,
         out_shape=out_shape,
@@ -329,14 +342,32 @@ def _flash_bwd(q, k, v, out, lse, g, causal, scale, block_q, block_kv, interpret
     qr, kr, vr = to3(q, s_q), to3(k, s_kv), to3(v, s_kv)
     orr, gr = to3(out, s_q), to3(g, s_q)
 
+    if causal:
+        # clamp skipped above-diagonal fetches to the diagonal block so the
+        # revisit-dedup skips their DMA (see _flash_fwd)
+        def kv_index(i, j, kb):
+            return (i, jnp.minimum(kb, (j * bq + bq - 1 + q_offset) // bkv), 0)
+
+        def q_index_dkv(i, jkv, qb):
+            # dkv grid iterates q blocks; blocks before the kv block's causal
+            # reach are compute-skipped — clamp their fetch to the first
+            # contributing q block
+            return (i, jnp.maximum(qb, (jkv * bkv - q_offset) // bq), 0)
+    else:
+        def kv_index(i, j, kb):
+            return (i, kb, 0)
+
+        def q_index_dkv(i, jkv, qb):
+            return (i, qb, 0)
+
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, causal=causal, block_q=bq,
                           block_kv=bkv, q_offset=q_offset, n_kvb=n_kvb),
         grid=(b * h, n_qb, n_kvb),
         in_specs=[
             pl.BlockSpec((1, bq, d), lambda i, j, kb: (i, j, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, bkv, d), lambda i, j, kb: (i, kb, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, bkv, d), lambda i, j, kb: (i, kb, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bkv, d), kv_index, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bkv, d), kv_index, memory_space=pltpu.VMEM),
             pl.BlockSpec((1, bq, d), lambda i, j, kb: (i, j, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((1, bq, d), lambda i, j, kb: (i, j, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((1, bq, LANES), lambda i, j, kb: (i, j, 0), memory_space=pltpu.VMEM),
@@ -359,12 +390,12 @@ def _flash_bwd(q, k, v, out, lse, g, causal, scale, block_q, block_kv, interpret
                           block_kv=bkv, q_offset=q_offset, n_qb=n_qb),
         grid=(b * h, n_kvb, n_qb),
         in_specs=[
-            pl.BlockSpec((1, bq, d), lambda i, j, qb: (i, qb, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bq, d), q_index_dkv, memory_space=pltpu.VMEM),
             pl.BlockSpec((1, bkv, d), lambda i, j, qb: (i, j, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((1, bkv, d), lambda i, j, qb: (i, j, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, bq, d), lambda i, j, qb: (i, qb, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, bq, d), lambda i, j, qb: (i, qb, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, bq, LANES), lambda i, j, qb: (i, qb, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bq, d), q_index_dkv, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bq, d), q_index_dkv, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bq, LANES), q_index_dkv, memory_space=pltpu.VMEM),
         ],
         out_specs=[
             pl.BlockSpec((1, bkv, d), lambda i, j, qb: (i, j, 0), memory_space=pltpu.VMEM),
