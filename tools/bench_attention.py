@@ -40,6 +40,11 @@ def main():
     def flash(q, k, v):
         return flash_attention(q, k, v, causal=True)
 
+    # v5e HBM is 16 GB; an on-device OOM can wedge the axon tunnel for hours
+    # (PERF.md "Environment caveat") — over-memory variants must be skipped by
+    # ANALYSIS, not by crashing (same contract as sweep_bench.compile_step)
+    hbm_budget = float(os.environ.get("BENCH_HBM_BUDGET", 14.5e9))
+
     def bench(fn, q, k, v, n=8):
         if fwd_only:
             f = jax.jit(lambda q, k, v: fn(q, k, v))
@@ -47,12 +52,21 @@ def main():
             f = jax.jit(jax.grad(
                 lambda q, k, v: fn(q, k, v).astype(jnp.float32).sum(),
                 argnums=(0, 1, 2)))
-        out = f(q, k, v)  # compile
+        compiled = f.lower(q, k, v).compile()
+        mem = compiled.memory_analysis()
+        if mem is not None:
+            need = (mem.temp_size_in_bytes + mem.argument_size_in_bytes +
+                    mem.output_size_in_bytes)
+            if need > hbm_budget:
+                raise MemoryError(
+                    f"projected {need / 1e9:.1f} GB > {hbm_budget / 1e9:.1f} GB"
+                    f" budget (skipped before touching the device)")
+        out = compiled(q, k, v)  # first run
         leaf = jax.tree_util.tree_leaves(out)[0]
         np.asarray(jax.device_get(leaf.ravel()[0]))  # fence (axon tunnel)
         t0 = time.perf_counter()
         for _ in range(n):
-            out = f(q, k, v)
+            out = compiled(q, k, v)
         leaf = jax.tree_util.tree_leaves(out)[0]
         np.asarray(jax.device_get(leaf.ravel()[0]))
         return (time.perf_counter() - t0) / n
@@ -71,8 +85,12 @@ def main():
         # BENCH_BLOCKS="128x256,256x512,512x512:256x512": sweep flash kernel
         # block sizes (block_q x block_kv, optional ":bq_bwd x bkv_bwd") —
         # the tuning knob VERDICT r2 flagged. TPU-only: the CPU fallback path
-        # ignores block sizes.
-        blocks = os.environ.get("BENCH_BLOCKS", "")
+        # ignores block sizes. On a real chip, default to a small tile sweep
+        # so the crossover table ships with tuning data.
+        default_blocks = ""
+        if jax.default_backend() == "tpu":
+            default_blocks = "512x512:256x512,512x1024:512x512"
+        blocks = os.environ.get("BENCH_BLOCKS", default_blocks)
         if blocks:
             from deepspeed_tpu.ops.flash_attention import parse_block_spec
             from deepspeed_tpu.ops.pallas.flash_attention import (
