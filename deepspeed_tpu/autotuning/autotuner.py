@@ -145,7 +145,9 @@ class Autotuner:
     # ------------------------------------------------------------------
     def search_space(self, n_devices, global_batch):
         zero_stages = [0, 1, 2, 3]
-        remats = ["minimal", None]
+        # minimal_nomlp: recompute the fc GEMM instead of saving mlp_hidden —
+        # the compile-prune stage discards it wherever "minimal" already fits
+        remats = ["minimal", "minimal_nomlp", None]
         offloads = [None, "cpu"]
         micros = [m for m in (1, 2, 4, 8, 16)
                   if global_batch % (m * 1) == 0]
