@@ -108,6 +108,9 @@ class TransformerConfig:
     # Sequence parallelism: shard the sequence dim over the ``seq`` mesh axis with
     # ring attention (set by the engine; see parallel/ring_attention.py)
     sequence_parallel: bool = False
+    # Chunk each ring tile's kv axis: peak memory O(s_local * ring_inner_block)
+    # instead of O(s_local^2) per ring step. None = whole-tile (short s_local).
+    ring_inner_block: typing.Optional[int] = None
     # Activation quantization (reference compression/basic_layer.py:17 QuantAct
     # via compression.apply_to_model_config): fake-quantize the attention/MLP
     # residual-branch outputs in-graph. 0 = off.
@@ -308,10 +311,12 @@ def block_apply(cfg, p, x, mask=None, rope=None, alibi=None, deterministic=True,
                 # already inside the pipeline's manual region over {pipe, seq}
                 out = ring_attention_manual(q, k, v, kv_mask=kv_mask,
                                             causal=cfg.causal,
-                                            scale=cfg.attn_scale)
+                                            scale=cfg.attn_scale,
+                                            inner_block=cfg.ring_inner_block)
             else:
                 out = ring_attention(q, k, v, cfg.mesh, kv_mask=kv_mask,
-                                     causal=cfg.causal, scale=cfg.attn_scale)
+                                     causal=cfg.causal, scale=cfg.attn_scale,
+                                     inner_block=cfg.ring_inner_block)
             out = checkpoint_name(out, "attn_out")
             return o_proj(out)
         # pallas paths: plain attention only — padding mask / alibi / dropout

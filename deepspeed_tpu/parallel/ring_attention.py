@@ -31,9 +31,24 @@ from .topology import SEQ_AXIS
 _NEG = -1e30
 
 
-def _ring_attention_local(q, k, v, kv_mask, *, scale, causal, remat_steps):
+def _fit_inner(requested, sl):
+    """Largest inner kv-chunk <= requested that divides the local block."""
+    b = max(1, min(requested, sl))
+    while sl % b:
+        b -= 1
+    return b
+
+
+def _ring_attention_local(q, k, v, kv_mask, *, scale, causal, remat_steps,
+                          inner_block=None):
     """Per-device body. q/k/v: [b, sl, h, dh] local blocks; kv_mask: [b, sl] bool
-    for the local K/V block (True = attend) or None."""
+    for the local K/V block (True = attend) or None.
+
+    ``inner_block``: chunk each ring tile's kv axis so the per-step score
+    matrix is [b, h, sl, inner_block] instead of [b, h, sl, sl] — online
+    softmax is associative, so the inner chunk scan carries the same
+    (o, m, l) triple. At long per-device sequence this turns the ring's
+    peak memory from O(sl^2) into O(sl * inner_block)."""
     S = jax.lax.axis_size(SEQ_AXIS)
     my_idx = jax.lax.axis_index(SEQ_AXIS)
     b, sl, h, dh = q.shape
@@ -47,21 +62,21 @@ def _ring_attention_local(q, k, v, kv_mask, *, scale, causal, remat_steps):
     perm = [(i, (i + 1) % S) for i in range(S)]
     q_pos = my_idx * sl + jnp.arange(sl)
 
-    def step(carry, r):
-        o, m, l, k_blk, v_blk, mask_blk = carry
-        kv_idx = (my_idx - r) % S
-        kv_pos = kv_idx * sl + jnp.arange(sl)
+    inner = _fit_inner(inner_block, sl) if inner_block else sl
+    n_inner = sl // inner
 
+    def tile_update(o, m, l, k_sub, v_sub, kv_pos, mask_sub):
+        """One online-softmax update against a kv chunk (any width)."""
         # bf16 dot inputs + fp32 accumulation (MXU native mode) — upcasting
         # q/k to fp32 first would run fp32xfp32 matmuls at a fraction of
         # bf16 throughput
-        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k_blk,
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k_sub,
                             preferred_element_type=jnp.float32) * scale
-        allowed = jnp.ones((sl, sl), bool)
+        allowed = jnp.ones((sl, kv_pos.shape[0]), bool)
         if causal:
             allowed = q_pos[:, None] >= kv_pos[None, :]
-        if mask_blk is not None:
-            allowed = allowed & mask_blk[:, None, None, :]
+        if mask_sub is not None:
+            allowed = allowed & mask_sub[:, None, None, :]
         scores = jnp.where(allowed, scores, _NEG)
 
         blk_max = jnp.max(scores, axis=-1)            # [b, h, q]
@@ -69,15 +84,38 @@ def _ring_attention_local(q, k, v, kv_mask, *, scale, causal, remat_steps):
         correction = jnp.exp(m - new_m)
         p = jnp.exp(scores - new_m[..., None])        # [b, h, q, k]
         new_l = l * correction + jnp.sum(p, axis=-1)
-        pv = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v_blk.dtype), v_blk,
+        pv = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v_sub.dtype), v_sub,
                         preferred_element_type=jnp.float32)
         new_o = o * correction.transpose(0, 2, 1)[..., None] + pv
+        return new_o, new_m, new_l
+
+    def step(carry, r):
+        o, m, l, k_blk, v_blk, mask_blk = carry
+        kv_idx = (my_idx - r) % S
+        kv_base = kv_idx * sl
+
+        if n_inner == 1:
+            o, m, l = tile_update(o, m, l, k_blk, v_blk,
+                                  kv_base + jnp.arange(sl), mask_blk)
+        else:
+            def sub(c2, t):
+                o2, m2, l2 = c2
+                k_sub = jax.lax.dynamic_slice_in_dim(k_blk, t * inner, inner, 1)
+                v_sub = jax.lax.dynamic_slice_in_dim(v_blk, t * inner, inner, 1)
+                m_sub = (jax.lax.dynamic_slice_in_dim(mask_blk, t * inner,
+                                                      inner, 1)
+                         if mask_blk is not None else None)
+                kv_pos = kv_base + t * inner + jnp.arange(inner)
+                return tile_update(o2, m2, l2, k_sub, v_sub, kv_pos, m_sub), None
+
+            (o, m, l), _ = jax.lax.scan(sub, (o, m, l),
+                                        jnp.arange(n_inner))
 
         k_nxt = jax.lax.ppermute(k_blk, SEQ_AXIS, perm)
         v_nxt = jax.lax.ppermute(v_blk, SEQ_AXIS, perm)
         mask_nxt = (jax.lax.ppermute(mask_blk, SEQ_AXIS, perm)
                     if mask_blk is not None else None)
-        return (new_o, new_m, new_l, k_nxt, v_nxt, mask_nxt), None
+        return (o, m, l, k_nxt, v_nxt, mask_nxt), None
 
     if remat_steps:
         step = jax.checkpoint(step)
@@ -87,7 +125,7 @@ def _ring_attention_local(q, k, v, kv_mask, *, scale, causal, remat_steps):
 
 
 def ring_attention_manual(q, k, v, *, kv_mask=None, causal=True, scale=None,
-                          remat_steps=True):
+                          remat_steps=True, inner_block=None):
     """Ring attention for callers ALREADY inside a manual region whose axis set
     includes ``seq`` (e.g. the pipeline's shard_map with
     ``axis_names={'pipe','seq'}`` — shard_maps don't nest, so the pipeline
@@ -97,11 +135,12 @@ def ring_attention_manual(q, k, v, *, kv_mask=None, causal=True, scale=None,
     if scale is None:
         scale = q.shape[-1] ** -0.5
     return _ring_attention_local(q, k, v, kv_mask, scale=scale, causal=causal,
-                                 remat_steps=remat_steps)
+                                 remat_steps=remat_steps,
+                                 inner_block=inner_block)
 
 
 def ring_attention(q, k, v, mesh, *, kv_mask=None, causal=True, scale=None,
-                   remat_steps=True):
+                   remat_steps=True, inner_block=None):
     """Exact attention with the sequence dim sharded over the ``seq`` mesh axis.
 
     Args:
@@ -112,6 +151,8 @@ def ring_attention(q, k, v, mesh, *, kv_mask=None, causal=True, scale=None,
         (padding masks; rotates around the ring with K/V).
       causal: apply causal masking on global positions.
       remat_steps: recompute each ring tile in backward (O(s_local) memory).
+      inner_block: chunk each ring tile's kv axis (see _ring_attention_local)
+        — peak memory O(s_local * inner_block) instead of O(s_local^2).
 
     Returns [batch, seq, heads, head_dim], same dtype as q.
     """
@@ -122,7 +163,7 @@ def ring_attention(q, k, v, mesh, *, kv_mask=None, causal=True, scale=None,
         raise ValueError(f"seq len {q.shape[1]} not divisible by seq axis {S}")
 
     fn = functools.partial(_ring_attention_local, scale=scale, causal=causal,
-                           remat_steps=remat_steps)
+                           remat_steps=remat_steps, inner_block=inner_block)
     qkv_spec = P(None, SEQ_AXIS, None, None)
     mask_spec = P(None, SEQ_AXIS)
     if kv_mask is None:
