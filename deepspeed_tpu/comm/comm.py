@@ -182,17 +182,17 @@ def broadcast_in_program(tensor, axis_name, src=0):
 # Host-control plane (eager, multi-host)
 # ---------------------------------------------------------------------------------
 def _rank_from_hostlist(hosts_csv):
-    """Rank = this host's index in the pdsh broadcast host list. Matches the
-    fully-qualified name first, then the short name (pdsh -w lists are
-    usually short names while gethostname() may be an FQDN)."""
+    """Rank = this host's index in the pdsh broadcast host list. Short and
+    fully-qualified spellings match in either direction (a pdsh -w list of
+    FQDNs with a short gethostname(), or vice versa)."""
     import socket
 
     hosts = [h.strip() for h in hosts_csv.split(",") if h.strip()]
     fqdn = socket.gethostname()
     short = fqdn.split(".")[0]
-    for candidate in (fqdn, short):
-        if candidate in hosts:
-            return hosts.index(candidate)
+    for i, h in enumerate(hosts):
+        if h == fqdn or h == short or h.split(".")[0] in (fqdn, short):
+            return i
     raise RuntimeError(
         f"init_distributed: this host ({fqdn}) is not in DS_TPU_HOSTS "
         f"({hosts_csv}) — the pdsh transport must launch on exactly the "

@@ -23,7 +23,7 @@ import subprocess
 import sys
 
 __all__ = ["PDSHRunner", "SlurmRunner", "OpenMPIRunner", "MPICHRunner",
-           "MULTINODE_RUNNERS"]
+           "MVAPICHRunner", "MULTINODE_RUNNERS"]
 
 
 class _Transport:
@@ -185,6 +185,16 @@ class PDSHRunner(_Transport):
             raise ValueError("pdsh transport needs a non-empty host list")
         super().__init__(len(hosts), **kw)
         self.hosts = list(hosts)
+        if coordinator:
+            # jax.distributed runs the coordinator service in PROCESS 0, and
+            # rank = position in this list — so the coordinator host must be
+            # first or every rank dials a host where nothing listens
+            if coordinator not in self.hosts:
+                raise ValueError(
+                    f"pdsh coordinator {coordinator!r} is not in the host "
+                    f"list {self.hosts}")
+            self.hosts.remove(coordinator)
+            self.hosts.insert(0, coordinator)
         self.coordinator = coordinator or self.hosts[0]
         self.master_port = int(master_port)
 
@@ -197,7 +207,6 @@ class PDSHRunner(_Transport):
             "DS_TPU_NUM_PROCESSES": str(self.num_hosts),
             "DS_TPU_COORDINATOR": self.coordinator,
             "MASTER_PORT": str(self.master_port),
-            "PDSH_RCMD_TYPE": "ssh",
         }
         env.update(self.exports)
         exports = " ".join(f"export {k}={shlex.quote(str(v))};"
@@ -205,7 +214,10 @@ class PDSHRunner(_Transport):
         py = " ".join(shlex.quote(c)
                       for c in self._python_exec(user_script, user_args))
         remote = f"{exports} cd {shlex.quote(os.getcwd())} && {py}"
-        return (["pdsh", "-S", "-f", "1024", "-w", ",".join(self.hosts)]
+        # -R ssh on pdsh's OWN argv: the rcmd module is chosen before any
+        # remote shell runs, so an exported env var could never select it
+        return (["pdsh", "-S", "-R", "ssh", "-f", "1024",
+                 "-w", ",".join(self.hosts)]
                 + self.launcher_args + [remote])
 
 

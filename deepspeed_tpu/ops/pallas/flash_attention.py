@@ -37,9 +37,9 @@ LANES = 128
 
 
 def _fit_block(requested, seq):
-    """Largest block <= requested that divides seq (backward clamps block sizes,
-    which must never silently truncate the grid)."""
-    b = min(requested, seq)
+    """Largest block in [1, requested] that divides seq (backward clamps block
+    sizes, which must never silently truncate the grid)."""
+    b = max(1, min(requested, seq))
     while seq % b:
         b -= 1
     return b

@@ -26,17 +26,10 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from ..ops.pallas.flash_attention import _fit_block as _fit_inner
 from .topology import SEQ_AXIS
 
 _NEG = -1e30
-
-
-def _fit_inner(requested, sl):
-    """Largest inner kv-chunk <= requested that divides the local block."""
-    b = max(1, min(requested, sl))
-    while sl % b:
-        b -= 1
-    return b
 
 
 def _ring_attention_local(q, k, v, kv_mask, *, scale, causal, remat_steps,

@@ -391,18 +391,25 @@ def test_pdsh_runner_builds_broadcast_command():
     r = PDSHRunner(["tpu-0", "tpu-1", "tpu-2"], master_port=9999,
                    exports={"XLA_FLAGS": "--foo"})
     cmd = r.build_cmd("train.py", ["--epochs", "2"])
-    assert cmd[:5] == ["pdsh", "-S", "-f", "1024", "-w"]
-    assert cmd[5] == "tpu-0,tpu-1,tpu-2"
-    remote = cmd[6]
+    # -R ssh on pdsh's own argv (the rcmd module is chosen before any remote
+    # shell runs, so an exported env var could never select it)
+    assert cmd[:7] == ["pdsh", "-S", "-R", "ssh", "-f", "1024", "-w"]
+    assert cmd[7] == "tpu-0,tpu-1,tpu-2"
+    remote = cmd[8]
     assert "export DS_TPU_HOSTS=tpu-0,tpu-1,tpu-2;" in remote
     assert "export DS_TPU_NUM_PROCESSES=3;" in remote
     assert "export DS_TPU_COORDINATOR=tpu-0;" in remote
     assert "export MASTER_PORT=9999;" in remote
-    assert "export PDSH_RCMD_TYPE=ssh;" in remote
     assert "export XLA_FLAGS=--foo;" in remote
     assert remote.endswith("train.py --epochs 2")
     # no per-host rank in the broadcast command — that's the whole point
     assert "DS_TPU_PROCESS_ID" not in remote
+    # the coordinator must be rank 0 (jax.distributed serves from process 0):
+    # an explicit coordinator reorders the host list; an unlisted one raises
+    r2 = PDSHRunner(["tpu-0", "tpu-1", "tpu-2"], coordinator="tpu-2")
+    assert r2.hosts == ["tpu-2", "tpu-0", "tpu-1"]
+    with pytest.raises(ValueError, match="not in the host list"):
+        PDSHRunner(["tpu-0"], coordinator="elsewhere")
 
 
 def test_pdsh_rank_from_hostname(monkeypatch):
@@ -416,6 +423,8 @@ def test_pdsh_rank_from_hostname(monkeypatch):
     assert _rank_from_hostlist("tpu-0,tpu-1,tpu-2") == 1
     monkeypatch.setattr(socket, "gethostname", lambda: "tpu-2")
     assert _rank_from_hostlist("tpu-0, tpu-1, tpu-2") == 2
+    # FQDN host list with a short local hostname (and vice versa) both match
+    assert _rank_from_hostlist("tpu-0.cluster.internal,tpu-2.cluster.internal") == 1
     monkeypatch.setattr(socket, "gethostname", lambda: "other")
     try:
         _rank_from_hostlist("tpu-0,tpu-1")
@@ -443,11 +452,11 @@ def test_cli_builds_pdsh_transport(tmp_path, monkeypatch):
                  "--deepspeed_config", "/tmp/ds.json", "train.py"])
     assert rc == 0
     cmd = captured["cmd"]
-    assert cmd[:5] == ["pdsh", "-S", "-f", "1024", "-w"]
-    assert cmd[5] == "tpu-0,tpu-1"
-    assert "export DS_TPU_HOSTS=tpu-0,tpu-1;" in cmd[6]
-    assert "export DS_TPU_COORDINATOR=tpu-0;" in cmd[6]
-    assert "export DS_TPU_CONFIG=/tmp/ds.json;" in cmd[6]
+    assert cmd[:7] == ["pdsh", "-S", "-R", "ssh", "-f", "1024", "-w"]
+    assert cmd[7] == "tpu-0,tpu-1"
+    assert "export DS_TPU_HOSTS=tpu-0,tpu-1;" in cmd[8]
+    assert "export DS_TPU_COORDINATOR=tpu-0;" in cmd[8]
+    assert "export DS_TPU_CONFIG=/tmp/ds.json;" in cmd[8]
 
 
 def test_mvapich_runner_builds_mpirun_command():
