@@ -60,6 +60,28 @@ def _attn_with_cache(cfg, p_attn, h, k_cache, v_cache, pos, kv_len, rope=None,
     k_full = L._repeat_kv(k_cache[:, :kv_len], cfg.n_heads // cfg.kv_heads)
     v_full = L._repeat_kv(v_cache[:, :kv_len], cfg.n_heads // cfg.kv_heads)
 
+    # Prefill (q_len > 1: the multi-token pass — decode is always q_len == 1,
+    # and every q_len > 1 caller writes at pos=0) is plain causal attention
+    # over the first q_len cache slots: slot j >= q_len is in the causal
+    # future of every query, so the [q, max_len] window the dense path masks
+    # away never needs to exist. Route it through the flash kernel so TTFT
+    # doesn't pay the O(s^2) logits materialization. prefill_flash:
+    # True/False force, None = TPU backend only (the CPU fallback is the
+    # chunked-XLA flash, correct everywhere).
+    flash_wanted = cfg.prefill_flash
+    if flash_wanted is None:
+        flash_wanted = jax.default_backend() == "tpu"
+    if (flash_wanted and q_len > 1 and is_local is None
+            and cfg.position_embedding != "alibi"):
+        from ..ops.flash_attention import flash_attention
+
+        out = flash_attention(q, k_full[:, :q_len], v_full[:, :q_len],
+                              causal=True, scale=cfg.attn_scale,
+                              block_q=cfg.flash_block_q,
+                              block_kv=cfg.flash_block_kv)
+        out = L.linear_apply(p_attn["o"], out.reshape(b, q_len, -1))
+        return out, k_cache, v_cache
+
     # causal vs the cache: query i (global pos+i) sees cache slots <= pos+i
     kv_idx = jnp.arange(kv_len)[None, :]
     q_idx = pos + jnp.arange(q_len)[:, None]

@@ -111,6 +111,10 @@ class TransformerConfig:
     # Chunk each ring tile's kv axis: peak memory O(s_local * ring_inner_block)
     # instead of O(s_local^2) per ring step. None = whole-tile (short s_local).
     ring_inner_block: typing.Optional[int] = None
+    # Serving: route the prefill (q_len == kv_len) through the flash kernel so
+    # TTFT never materializes O(s^2) logits. None = auto (TPU backend only);
+    # True/False force. Decode steps always keep the dense cached path.
+    prefill_flash: typing.Optional[bool] = None
     # Activation quantization (reference compression/basic_layer.py:17 QuantAct
     # via compression.apply_to_model_config): fake-quantize the attention/MLP
     # residual-branch outputs in-graph. 0 = off.

@@ -431,3 +431,28 @@ def test_eos_early_stop_decode_matches_scan():
     out = eng.generate(np.asarray(ids), max_new_tokens=8, greedy=True,
                        eos_token_id=eos)
     assert out.shape == (3, 14)
+
+
+@pytest.mark.parametrize("kw", [dict(), dict(position_embedding="rope",
+                                             n_kv_heads=2)],
+                         ids=["gpt2ish", "rope-gqa"])
+def test_prefill_flash_matches_dense(kw):
+    """prefill_flash routes the multi-token prefill through the flash path;
+    logits must match the dense cached path (and the training forward)."""
+    cfg_dense = cfg_variant(prefill_flash=False, **kw)
+    cfg_flash = cfg_variant(prefill_flash=True, **kw)
+    model_d, model_f = CausalLM(cfg_dense), CausalLM(cfg_flash)
+    values, _ = split_params_axes(model_d.init(jax.random.PRNGKey(0)))
+    r = np.random.RandomState(0)
+    ids = jnp.asarray(r.randint(0, 64, (2, 12)), jnp.int32)
+
+    cache_d = init_cache(cfg_dense, 2, 16)
+    cache_f = init_cache(cfg_flash, 2, 16)
+    logits_d, cache_d = forward_with_cache(model_d, values, ids, cache_d, 0, 16)
+    logits_f, cache_f = forward_with_cache(model_f, values, ids, cache_f, 0, 16)
+    np.testing.assert_allclose(np.asarray(logits_f), np.asarray(logits_d),
+                               rtol=2e-4, atol=2e-5)
+    for s in ("k", "v"):
+        np.testing.assert_allclose(np.asarray(cache_f[s]),
+                                   np.asarray(cache_d[s]), rtol=1e-6,
+                                   atol=1e-6)
