@@ -77,6 +77,9 @@ class TransformerConfig:
     # never materialized (fwd or bwd). Big memory + bandwidth win at LLM vocabs.
     fused_ce: bool = True
     fused_ce_chunks: int = 8  # vocab chunks in the streaming CE (tuning knob)
+    # "pallas": forward via the streaming Pallas kernel (chunk logits never
+    # touch HBM, ops/pallas/cross_entropy.py); backward stays chunked XLA
+    fused_ce_impl: str = "xla"  # xla | pallas
     remat: bool = False
     remat_policy: str = "nothing_saveable"  # nothing_saveable | dots_with_no_batch_dims
     compute_dtype: typing.Any = jnp.bfloat16
@@ -775,7 +778,8 @@ class CausalLM:
                 bias = params["lm_head"].get("bias")  # GPT-J biased head
             return fused_cross_entropy(
                 x.reshape(-1, cfg.d_model), emb, labels.reshape(-1), bias,
-                n_chunks=cfg.fused_ce_chunks)
+                n_chunks=cfg.fused_ce_chunks, impl=cfg.fused_ce_impl,
+                interpret=cfg.attention_interpret)
         return cross_entropy_loss(self.head(params, x), labels)
 
     def apply(self, params, input_ids, positions=None, attention_mask=None,
@@ -856,7 +860,8 @@ class MaskedLM(CausalLM):
             return fused_cross_entropy(
                 h.reshape(-1, cfg.d_model), params["wte"]["weight"],
                 labels.reshape(-1), params["mlm_bias"]["bias"],
-                n_chunks=cfg.fused_ce_chunks)
+                n_chunks=cfg.fused_ce_chunks, impl=cfg.fused_ce_impl,
+                interpret=cfg.attention_interpret)
         logits = L.embedding_attend(params["wte"], h) \
             + params["mlm_bias"]["bias"].astype(cfg.compute_dtype)
         return cross_entropy_loss(logits, labels)

@@ -40,16 +40,22 @@ def _pad_emb(emb, padded_vocab):
     return jnp.pad(emb, ((0, padded_vocab - vocab), (0, 0)))
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
 def fused_cross_entropy(x, emb, labels, bias=None, ignore_index=-100,
-                        n_chunks=8):
+                        n_chunks=8, impl="xla", interpret=False):
     """Token-mean CE of ``softmax(x @ emb^T + bias)`` against ``labels``.
 
     x: [tokens, d] (compute dtype); emb: [V, d]; ``bias``: optional [V] logit
     bias (GPT-J-style biased LM head); labels: [tokens] int (``ignore_index``
     entries masked out). Returns a scalar fp32 loss.
+
+    ``impl="pallas"`` streams the forward through the Pallas kernel
+    (``ops/pallas/cross_entropy.py`` — chunk logits never touch HBM); the
+    backward is the chunked XLA path either way (its cost is two MXU GEMMs
+    XLA already runs at peak).
     """
-    loss, _ = _ce_fwd_impl(x, emb, labels, bias, ignore_index, n_chunks)
+    loss, _ = _ce_fwd_impl(x, emb, labels, bias, ignore_index, n_chunks,
+                           impl, interpret)
     return loss
 
 
@@ -59,7 +65,18 @@ def _pad_bias(bias, padded_vocab):
     return jnp.pad(bias, (0, padded_vocab - bias.shape[0]))
 
 
-def _ce_fwd_impl(x, emb, labels, bias, ignore_index, n_chunks):
+def _ce_fwd_impl(x, emb, labels, bias, ignore_index, n_chunks, impl="xla",
+                 interpret=False):
+    valid = labels != ignore_index
+    safe_labels = jnp.where(valid, labels, 0).astype(jnp.int32)
+    if impl == "pallas":
+        from .pallas.cross_entropy import pallas_ce_forward
+
+        lse, lab_logit = pallas_ce_forward(x, emb, safe_labels, bias,
+                                           interpret=interpret)
+        n_valid = jnp.maximum(jnp.sum(valid), 1)
+        loss = jnp.sum((lse - lab_logit) * valid) / n_valid
+        return loss, (lse, n_valid)
     tokens, d = x.shape
     vocab = emb.shape[0]
     nc, chunk, padded = _chunking(vocab, n_chunks)
@@ -67,9 +84,6 @@ def _ce_fwd_impl(x, emb, labels, bias, ignore_index, n_chunks):
     bias_c = None if bias is None \
         else _pad_bias(bias, padded).reshape(nc, chunk)
     starts = jnp.arange(nc, dtype=jnp.int32) * chunk
-
-    valid = labels != ignore_index
-    safe_labels = jnp.where(valid, labels, 0).astype(jnp.int32)
 
     def body(carry, inp):
         m, s, lab_logit = carry
@@ -105,13 +119,14 @@ def _ce_fwd_impl(x, emb, labels, bias, ignore_index, n_chunks):
     return loss, (lse, n_valid)
 
 
-def _ce_vjp_fwd(x, emb, labels, bias, ignore_index, n_chunks):
+def _ce_vjp_fwd(x, emb, labels, bias, ignore_index, n_chunks, impl,
+                interpret):
     loss, (lse, n_valid) = _ce_fwd_impl(x, emb, labels, bias, ignore_index,
-                                        n_chunks)
+                                        n_chunks, impl, interpret)
     return loss, (x, emb, labels, bias, lse, n_valid)
 
 
-def _ce_vjp_bwd(ignore_index, n_chunks, residuals, g):
+def _ce_vjp_bwd(ignore_index, n_chunks, impl, interpret, residuals, g):
     x, emb, labels, bias, lse, n_valid = residuals
     tokens, d = x.shape
     vocab = emb.shape[0]

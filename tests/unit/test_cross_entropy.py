@@ -111,3 +111,34 @@ def test_fused_ce_with_head_bias_matches_naive():
     for a, b_ in zip(g_r, g_f):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
                                    rtol=2e-4, atol=1e-6)
+
+
+@pytest.mark.parametrize("vocab", [96, 100])  # 100: non-dividing -> padded
+def test_pallas_ce_forward_matches_xla(vocab):
+    """The Pallas streaming forward must agree with the chunked XLA impl:
+    loss, and the grads (shared XLA backward fed by the Pallas lse)."""
+    x, emb, labels = _setup(tokens=64, d=32, vocab=vocab)
+
+    def loss(impl):
+        return fused_cross_entropy(x, emb, labels, None, -100, 4, impl, True)
+
+    np.testing.assert_allclose(np.asarray(loss("pallas")),
+                               np.asarray(loss("xla")), rtol=1e-5, atol=1e-6)
+    g_x = jax.grad(lambda x: fused_cross_entropy(x, emb, labels, None, -100,
+                                                 4, "pallas", True))(x)
+    g_ref = jax.grad(lambda x: fused_cross_entropy(x, emb, labels, None, -100,
+                                                   4, "xla", False))(x)
+    np.testing.assert_allclose(np.asarray(g_x), np.asarray(g_ref),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_pallas_ce_with_bias_and_all_ignored():
+    x, emb, labels = _setup(tokens=64, d=32, vocab=96)
+    bias = jnp.asarray(np.random.RandomState(3).randn(96) * 0.1, jnp.float32)
+    a = fused_cross_entropy(x, emb, labels, bias, -100, 4, "pallas", True)
+    b = fused_cross_entropy(x, emb, labels, bias, -100, 4, "xla", False)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5,
+                               atol=1e-6)
+    ign = jnp.full_like(labels, -100)
+    c = fused_cross_entropy(x, emb, ign, bias, -100, 4, "pallas", True)
+    assert np.isfinite(np.asarray(c))

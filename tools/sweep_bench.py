@@ -141,6 +141,10 @@ def main():
         # CE vocab-chunk count: fewer chunks = bigger head GEMMs per pass
         ("ce4-b12", {"fused_ce_chunks": 4}, 12),
         ("ce16-b12", {"fused_ce_chunks": 16}, 12),
+        # streaming Pallas CE forward: chunk logits never round-trip HBM
+        ("ce-pallas-b12", {"fused_ce_impl": "pallas"}, 12),
+        ("ce-pallas-flash-b24", {"fused_ce_impl": "pallas",
+                                 "attention_impl": "flash"}, 24),
     ]
     sel = os.environ.get("BENCH_SWEEP")
     if sel:
