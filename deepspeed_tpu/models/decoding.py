@@ -34,12 +34,14 @@ def init_cache(cfg, batch_size, max_len, dtype=None):
 
 
 def _attn_with_cache(cfg, p_attn, h, k_cache, v_cache, pos, kv_len, rope=None,
-                     is_local=None):
+                     is_local=None, prefill=False):
     """Attention for q block [b, q, d] against cache[:, :kv_len] after writing the
     new k/v at ``pos``. Returns (out [b, q, d], new k_cache, new v_cache).
 
     k_cache/v_cache: [b, max_len, kvh, dh]; pos: scalar write offset;
     kv_len: static upper bound on valid cache length (mask handles the rest).
+    ``prefill``: static caller promise that pos == 0 and the q block IS the
+    whole visible window — enables the flash fast path below.
     """
     b, q_len, d = h.shape
     q = L.linear_apply(p_attn["q"], h).reshape(b, q_len, cfg.n_heads, cfg.head_dim)
@@ -57,30 +59,34 @@ def _attn_with_cache(cfg, p_attn, h, k_cache, v_cache, pos, kv_len, rope=None,
     v_cache = jax.lax.dynamic_update_slice(v_cache, v.astype(v_cache.dtype),
                                            (0, pos, 0, 0))
 
-    k_full = L._repeat_kv(k_cache[:, :kv_len], cfg.n_heads // cfg.kv_heads)
-    v_full = L._repeat_kv(v_cache[:, :kv_len], cfg.n_heads // cfg.kv_heads)
-
-    # Prefill (q_len > 1: the multi-token pass — decode is always q_len == 1,
-    # and every q_len > 1 caller writes at pos=0) is plain causal attention
-    # over the first q_len cache slots: slot j >= q_len is in the causal
-    # future of every query, so the [q, max_len] window the dense path masks
-    # away never needs to exist. Route it through the flash kernel so TTFT
-    # doesn't pay the O(s^2) logits materialization. prefill_flash:
+    # Prefill is plain causal attention over the just-written prompt rows:
+    # cache slot j >= q_len is in the causal future of every query, so the
+    # [q, max_len] window the dense path masks away never needs to exist.
+    # Route it through the flash kernel so TTFT doesn't pay the O(s^2)
+    # logits materialization — on the fresh k/v (cast through the cache
+    # dtype to keep the dense path's numerics), repeated BEFORE any cache
+    # read so no [b, max_len, heads, dh] tensor materializes. prefill_flash:
     # True/False force, None = TPU backend only (the CPU fallback is the
     # chunked-XLA flash, correct everywhere).
     flash_wanted = cfg.prefill_flash
     if flash_wanted is None:
         flash_wanted = jax.default_backend() == "tpu"
-    if (flash_wanted and q_len > 1 and is_local is None
+    if (flash_wanted and prefill and q_len > 1 and is_local is None
             and cfg.position_embedding != "alibi"):
         from ..ops.flash_attention import flash_attention
 
-        out = flash_attention(q, k_full[:, :q_len], v_full[:, :q_len],
+        n_rep = cfg.n_heads // cfg.kv_heads
+        out = flash_attention(q,
+                              L._repeat_kv(k.astype(k_cache.dtype), n_rep),
+                              L._repeat_kv(v.astype(v_cache.dtype), n_rep),
                               causal=True, scale=cfg.attn_scale,
                               block_q=cfg.flash_block_q,
                               block_kv=cfg.flash_block_kv)
         out = L.linear_apply(p_attn["o"], out.reshape(b, q_len, -1))
         return out, k_cache, v_cache
+
+    k_full = L._repeat_kv(k_cache[:, :kv_len], cfg.n_heads // cfg.kv_heads)
+    v_full = L._repeat_kv(v_cache[:, :kv_len], cfg.n_heads // cfg.kv_heads)
 
     # causal vs the cache: query i (global pos+i) sees cache slots <= pos+i
     kv_idx = jnp.arange(kv_len)[None, :]
@@ -128,7 +134,7 @@ def _mlp(cfg, p, h):
 
 
 def _block_cached(cfg, p, x, k_cache, v_cache, pos, kv_len, rope=None,
-                  is_local=None):
+                  is_local=None, prefill=False):
     """One block with cache. x: [b, q, d] compute dtype."""
     cast = lambda a: a.astype(cfg.compute_dtype) \
         if jnp.issubdtype(a.dtype, jnp.floating) else a
@@ -141,7 +147,8 @@ def _block_cached(cfg, p, x, k_cache, v_cache, pos, kv_len, rope=None,
 
     def attn(h):
         return _attn_with_cache(cfg, p_cast["attn"], h, k_cache, v_cache, pos,
-                                kv_len, rope=rope, is_local=is_local)
+                                kv_len, rope=rope, is_local=is_local,
+                                prefill=prefill)
 
     if cfg.parallel_attn_mlp:
         h = _norm_apply(cfg, p_cast["ln_1"], x)
@@ -160,11 +167,15 @@ def _block_cached(cfg, p, x, k_cache, v_cache, pos, kv_len, rope=None,
     return x, kc, vc
 
 
-def forward_with_cache(model, params, input_ids, cache, pos, kv_len):
+def forward_with_cache(model, params, input_ids, cache, pos, kv_len,
+                       prefill=False):
     """Run the model on ``input_ids`` [b, q] writing k/v into ``cache`` at ``pos``.
 
     Used for both prefill (q = prompt length, pos = 0) and decode (q = 1,
     pos = cursor). Returns (logits [b, q, vocab], new_cache).
+    ``prefill=True`` is the caller's static promise that pos == 0 and the
+    whole visible window is this q block — it unlocks the flash fast path
+    (callers with pos > 0 must leave it False).
     """
     cfg = model.config
     b, q_len = input_ids.shape
@@ -189,7 +200,8 @@ def forward_with_cache(model, params, input_ids, cache, pos, kv_len):
             h = carry
             p_i, kc, vc, loc = layer
             h, kc, vc = _block_cached(cfg, p_i, h, kc, vc, pos, kv_len,
-                                      rope=rope, is_local=loc)
+                                      rope=rope, is_local=loc,
+                                      prefill=prefill)
             return h, (kc, vc)
 
         h, (k_new, v_new) = jax.lax.scan(
@@ -199,7 +211,8 @@ def forward_with_cache(model, params, input_ids, cache, pos, kv_len):
         def scan_fn(carry, layer):
             h = carry
             p_i, kc, vc = layer
-            h, kc, vc = _block_cached(cfg, p_i, h, kc, vc, pos, kv_len, rope=rope)
+            h, kc, vc = _block_cached(cfg, p_i, h, kc, vc, pos, kv_len,
+                                      rope=rope, prefill=prefill)
             return h, (kc, vc)
 
         h, (k_new, v_new) = jax.lax.scan(
@@ -248,7 +261,8 @@ def prefill_and_first_token(model, params, ids, rng, temperature, *, max_len,
     across lengths)."""
     b, prompt_len = ids.shape
     cache = init_cache(model.config, b, max_len, dtype)
-    logits, cache = forward_with_cache(model, params, ids, cache, 0, max_len)
+    logits, cache = forward_with_cache(model, params, ids, cache, 0, max_len,
+                                       prefill=True)
     if true_len is None:
         last = logits[:, prompt_len - 1]
     else:

@@ -67,6 +67,11 @@ def _pad_bias(bias, padded_vocab):
 
 def _ce_fwd_impl(x, emb, labels, bias, ignore_index, n_chunks, impl="xla",
                  interpret=False):
+    if impl not in ("xla", "pallas"):
+        # checked here (not in the custom_vjp primal, which grad bypasses)
+        # so a typo'd config can never silently bench the wrong kernel
+        raise ValueError(f"fused_cross_entropy impl must be 'xla' or "
+                         f"'pallas', got {impl!r}")
     valid = labels != ignore_index
     safe_labels = jnp.where(valid, labels, 0).astype(jnp.int32)
     if impl == "pallas":

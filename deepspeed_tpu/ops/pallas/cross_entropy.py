@@ -25,10 +25,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from .flash_attention import _fit_block
-
-NEG_INF = -1e30
-LANES = 128
+from .flash_attention import LANES, NEG_INF, _fit_block
 
 
 def _ce_fwd_kernel(labels_ref, x_ref, e_ref, b_ref, lse_ref, lab_ref,
@@ -89,6 +86,10 @@ def pallas_ce_forward(x, emb, labels, bias=None, *, block_t=256, block_v=512,
     """
     tokens, d = x.shape
     vocab = emb.shape[0]
+    # compute-dtype GEMM inputs like the XLA path (e_c.astype(x.dtype)):
+    # fp32 master embeddings would stream at double width AND make the fwd
+    # lse diverge from the backward's recomputed compute-dtype logits
+    emb = emb.astype(x.dtype)
     bt = _fit_block(block_t, tokens)
     bv = min(block_v, vocab)
     n_vb = -(-vocab // bv)

@@ -448,8 +448,10 @@ def test_prefill_flash_matches_dense(kw):
 
     cache_d = init_cache(cfg_dense, 2, 16)
     cache_f = init_cache(cfg_flash, 2, 16)
-    logits_d, cache_d = forward_with_cache(model_d, values, ids, cache_d, 0, 16)
-    logits_f, cache_f = forward_with_cache(model_f, values, ids, cache_f, 0, 16)
+    logits_d, cache_d = forward_with_cache(model_d, values, ids, cache_d, 0,
+                                           16, prefill=True)
+    logits_f, cache_f = forward_with_cache(model_f, values, ids, cache_f, 0,
+                                           16, prefill=True)
     np.testing.assert_allclose(np.asarray(logits_f), np.asarray(logits_d),
                                rtol=2e-4, atol=2e-5)
     for s in ("k", "v"):
