@@ -142,3 +142,14 @@ def test_pallas_ce_with_bias_and_all_ignored():
     ign = jnp.full_like(labels, -100)
     c = fused_cross_entropy(x, emb, ign, bias, -100, 4, "pallas", True)
     assert np.isfinite(np.asarray(c))
+
+
+def test_pallas_ce_bf16_compute_fp32_master_emb():
+    """The kernel must cast a fp32 master embedding to the compute dtype like
+    the XLA path — loss parity under the mixed-precision training setup."""
+    x, emb, labels = _setup(tokens=64, d=32, vocab=96)
+    x16 = x.astype(jnp.bfloat16)
+    a = fused_cross_entropy(x16, emb, labels, None, -100, 4, "pallas", True)
+    b = fused_cross_entropy(x16, emb, labels, None, -100, 4, "xla", False)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5,
+                               atol=1e-6)
