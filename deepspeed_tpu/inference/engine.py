@@ -338,6 +338,24 @@ class InferenceEngine:
             result = _truncate_after_eos(np.asarray(result), prompt_len, eos_token_id)
         return result
 
+    def warmup(self, prompt_lens, max_new_tokens=32, batch_size=1,
+               temperature=1.0, top_k=0, greedy=True, eos_token_id=None):
+        """Precompile (and execute once) the prefill + decode programs for the
+        given prompt lengths, so no live request ever pays a compile — the
+        reference's capture-at-init role (cuda-graph capture on first forward,
+        ``inference/engine.py:500``). Lengths collapse into prompt buckets;
+        pass the production sampling shape (greedy/top_k/eos), since those
+        are part of the compile key. Returns the number of compiled programs.
+        """
+        rng = np.random.RandomState(0)
+        for p in prompt_lens:
+            ids = rng.randint(0, self.module.config.vocab_size,
+                              (batch_size, int(p))).astype(np.int32)
+            self.generate(ids, max_new_tokens=max_new_tokens,
+                          temperature=temperature, top_k=top_k, greedy=greedy,
+                          eos_token_id=eos_token_id)
+        return len(self._prefill_cache)
+
     @property
     def config(self):
         return self._config

@@ -458,3 +458,21 @@ def test_prefill_flash_matches_dense(kw):
         np.testing.assert_allclose(np.asarray(cache_f[s]),
                                    np.asarray(cache_d[s]), rtol=1e-6,
                                    atol=1e-6)
+
+
+def test_warmup_precompiles_buckets():
+    """engine.warmup compiles one program set per prompt bucket; live
+    requests with the same sampling shape then reuse them (no new keys)."""
+    cfg = cfg_variant()
+    model = CausalLM(cfg)
+    eng = deepspeed_tpu.init_inference(model, dtype="float32", max_tokens=64,
+                                       prompt_bucket_size=16)
+    n = eng.warmup([6, 11, 20], max_new_tokens=4)
+    assert n == 2  # {6, 11} share the 16-bucket; 20 lands in the 32-bucket
+
+    r = np.random.RandomState(7)
+    eng.generate(r.randint(0, 128, (1, 9)).astype(np.int32),
+                 max_new_tokens=4, greedy=True)
+    eng.generate(r.randint(0, 128, (1, 30)).astype(np.int32),
+                 max_new_tokens=4, greedy=True)
+    assert len(eng._prefill_cache) == 2  # nothing new compiled
