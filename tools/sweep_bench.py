@@ -103,48 +103,50 @@ def main():
     }
 
     variants = [
-        # (name, model overrides, batch size)
+        # (name, model overrides, batch size) — ordered by information value:
+        # if the tunnel dies mid-sweep, the rows that decide the bench
+        # defaults (xla-vs-flash, batch scaling, tiles, pallas CE) exist first
         ("base-b12", {}, 12),
-        ("b16", {}, 16),
-        ("b8", {}, 8),
+        ("flash-b12", {"attention_impl": "flash"}, 12),
+        ("flash-b24", {"attention_impl": "flash"}, 24),
+        # single kv block at seq 1024: one online-softmax step — no multi-step
+        # (m, l, acc) bookkeeping at all; big bwd tiles to match
+        ("flash-huge-b24", {"attention_impl": "flash", "flash_block_q": 512,
+                            "flash_block_kv": 1024, "flash_block_q_bwd": 512,
+                            "flash_block_kv_bwd": 1024}, 24),
+        # streaming Pallas CE forward: chunk logits never round-trip HBM
+        ("ce-pallas-flash-b24", {"fused_ce_impl": "pallas",
+                                 "attention_impl": "flash"}, 24),
         # bigger micro-batches: VERDICT r2's first hypothesis for the
         # 0.28->0.40 MFU gap (more rows per dispatch amortize bandwidth)
-        ("b20", {}, 20),
         ("b24", {}, 24),
         ("b32", {}, 32),
-        ("b24-noremat", {"remat": False}, 24),
-        ("flash-b12", {"attention_impl": "flash"}, 12),
-        ("noscan-b12", {"scan_layers": False}, 12),
-        ("densece-b12", {"fused_ce": False}, 12),
-        ("remat-dots-b12", {"remat_policy": "dots_with_no_batch_dims"}, 12),
-        ("noclip-b12", {}, 12),  # gradient_clipping removed below
+        # flash kills the O(s^2) probs activation AND (with the saved lse)
+        # the bwd fwd-kernel re-run — bigger micro-batches may now fit
+        ("flash-b32", {"attention_impl": "flash"}, 32),
+        # lean remat (no mlp_hidden save): trades one fc-GEMM recompute for
+        # ~60% of the per-layer activation HBM — room for larger batches
+        ("flash-b32-nomlp", {"attention_impl": "flash",
+                             "remat_policy": "minimal_nomlp"}, 32),
+        ("ce-pallas-b12", {"fused_ce_impl": "pallas"}, 12),
+        ("b16", {}, 16),
+        ("b20", {}, 20),
+        ("b8", {}, 8),
         ("flash-b16", {"attention_impl": "flash"}, 16),
         # flash tile-size variants (kernel defaults are 256x512 fwd, 256x256
         # bwd); larger tiles amortize the online-softmax bookkeeping
         ("flash-big-b12", {"attention_impl": "flash", "flash_block_q": 512,
                            "flash_block_kv": 1024, "flash_block_q_bwd": 256,
                            "flash_block_kv_bwd": 512}, 12),
-        ("flash-b24", {"attention_impl": "flash"}, 24),
-        # flash kills the O(s^2) probs activation AND (with the saved lse)
-        # the bwd fwd-kernel re-run — bigger micro-batches may now fit
-        ("flash-b32", {"attention_impl": "flash"}, 32),
         ("flash-b24-noremat", {"attention_impl": "flash", "remat": False}, 24),
-        # single kv block at seq 1024: one online-softmax step — no multi-step
-        # (m, l, acc) bookkeeping at all; big bwd tiles to match
-        ("flash-huge-b24", {"attention_impl": "flash", "flash_block_q": 512,
-                            "flash_block_kv": 1024, "flash_block_q_bwd": 512,
-                            "flash_block_kv_bwd": 1024}, 24),
-        # lean remat (no mlp_hidden save): trades one fc-GEMM recompute for
-        # ~60% of the per-layer activation HBM — room for larger batches
-        ("flash-b32-nomlp", {"attention_impl": "flash",
-                             "remat_policy": "minimal_nomlp"}, 32),
+        ("b24-noremat", {"remat": False}, 24),
+        ("noscan-b12", {"scan_layers": False}, 12),
+        ("densece-b12", {"fused_ce": False}, 12),
+        ("remat-dots-b12", {"remat_policy": "dots_with_no_batch_dims"}, 12),
+        ("noclip-b12", {}, 12),  # gradient_clipping removed below
         # CE vocab-chunk count: fewer chunks = bigger head GEMMs per pass
         ("ce4-b12", {"fused_ce_chunks": 4}, 12),
         ("ce16-b12", {"fused_ce_chunks": 16}, 12),
-        # streaming Pallas CE forward: chunk logits never round-trip HBM
-        ("ce-pallas-b12", {"fused_ce_impl": "pallas"}, 12),
-        ("ce-pallas-flash-b24", {"fused_ce_impl": "pallas",
-                                 "attention_impl": "flash"}, 24),
     ]
     sel = os.environ.get("BENCH_SWEEP")
     if sel:
