@@ -190,9 +190,18 @@ def _rank_from_hostlist(hosts_csv):
     hosts = [h.strip() for h in hosts_csv.split(",") if h.strip()]
     fqdn = socket.gethostname()
     short = fqdn.split(".")[0]
-    for i, h in enumerate(hosts):
-        if h == fqdn or h == short or h.split(".")[0] in (fqdn, short):
-            return i
+    matches = [i for i, h in enumerate(hosts)
+               if h == fqdn or h == short or h.split(".")[0] in (fqdn, short)]
+    if len(matches) > 1:
+        # e.g. DS_TPU_HOSTS="a.dc1,a.dc2" with gethostname()=="a": two hosts
+        # would silently derive the SAME rank and hang/corrupt jax.distributed
+        # init — refuse instead
+        raise RuntimeError(
+            f"init_distributed: hostname {fqdn} matches multiple entries of "
+            f"DS_TPU_HOSTS ({hosts_csv}) at indices {matches} — use "
+            f"fully-qualified names in the hostfile to disambiguate")
+    if matches:
+        return matches[0]
     raise RuntimeError(
         f"init_distributed: this host ({fqdn}) is not in DS_TPU_HOSTS "
         f"({hosts_csv}) — the pdsh transport must launch on exactly the "

@@ -210,15 +210,23 @@ def main(args=None):
     resource_pool = fetch_hostfile(args.hostfile)
     if resource_pool:
         resource_pool = parse_inclusion_exclusion(resource_pool, args.include, args.exclude)
-        hosts = sorted(resource_pool)
+        hosts = list(resource_pool)
         num_nodes = len(hosts) if args.num_nodes < 0 else args.num_nodes
         master = args.master_addr or hosts[0]
         node_rank = args.node_rank
         if node_rank < 0:
-            import socket
+            # FQDN/short matching in either direction (the same rule as
+            # comm._rank_from_hostlist) — an exact-string lookup silently
+            # gave every host rank 0 when the hostfile spelled FQDNs but
+            # gethostname() returned short names
+            from ..comm.comm import _rank_from_hostlist
 
-            hostname = socket.gethostname()
-            node_rank = hosts.index(hostname) if hostname in hosts else 0
+            try:
+                node_rank = _rank_from_hostlist(",".join(hosts))
+            except RuntimeError as e:
+                if "matches multiple" in str(e):
+                    raise  # duplicate ranks would hang jax.distributed init
+                node_rank = 0  # launching from a non-worker host
         env["DS_TPU_NUM_PROCESSES"] = str(num_nodes)
         env["DS_TPU_COORDINATOR"] = master
         env["DS_TPU_PROCESS_ID"] = str(node_rank)
@@ -249,7 +257,7 @@ def main(args=None):
         raise ValueError("--launcher ssh needs a non-empty --hostfile "
                          "(a missing path silently resolves to no hosts)")
     if args.launcher == "ssh":
-        hosts = sorted(resource_pool)
+        hosts = list(resource_pool)
         runner = SshRunner(hosts, args.master_addr or hosts[0],
                            args.master_port, ssh_port=args.ssh_port)
         extra = {"DS_TPU_CONFIG": args.deepspeed_config} \
@@ -263,7 +271,7 @@ def main(args=None):
 
         if not resource_pool:
             raise ValueError("--launcher pdsh needs --hostfile")
-        hosts = sorted(resource_pool)  # position in this list = rank
+        hosts = list(resource_pool)  # hostfile order = rank order (reference multinode_runner semantics)
         exports = {}
         if args.deepspeed_config:
             exports["DS_TPU_CONFIG"] = args.deepspeed_config
@@ -294,7 +302,13 @@ def main(args=None):
             raise ValueError(
                 f"--launcher {args.launcher} needs --master_addr when no "
                 f"hostfile is given (the coordinator must be one of the hosts)")
-        master = args.master_addr or sorted(resource_pool)[0]
+        master = args.master_addr or list(resource_pool)[0]
+        if args.launcher == "slurm" and resource_pool and not args.master_addr:
+            # srun assigns SLURM_PROCID in Slurm's canonical (sorted) node
+            # order, NOT --nodelist order — the default coordinator must be
+            # the host that receives task 0, or every rank dials a host where
+            # no jax.distributed coordinator listens
+            master = sorted(resource_pool)[0]
         exports = {"DS_TPU_COORDINATOR": master,
                    "MASTER_PORT": str(args.master_port)}
         if args.deepspeed_config:
@@ -306,7 +320,9 @@ def main(args=None):
             if resource_pool:
                 # pin srun to the (already include/exclude-filtered) hostfile
                 # hosts — otherwise the allocation may place no task on the
-                # exported coordinator and every rank hangs at rendezvous
+                # exported coordinator and every rank hangs at rendezvous.
+                # Sorted: matches Slurm's canonical task-distribution order
+                # (nodelist order is not honored by srun)
                 kw.update(include="@".join(sorted(resource_pool)))
             else:
                 kw.update(include=args.include, exclude=args.exclude)
@@ -324,7 +340,7 @@ def main(args=None):
                         else "{h}\n")  # mpich/mvapich: plain host lines
                 eff = tempfile.NamedTemporaryFile(
                     "w", prefix="ds_tpu_hosts_", suffix=".txt", delete=False)
-                for h in sorted(resource_pool):
+                for h in resource_pool:
                     eff.write(line.format(h=h))
                 eff.close()
                 kw.update(hostfile=eff.name)

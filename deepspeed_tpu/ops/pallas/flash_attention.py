@@ -127,6 +127,13 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_kv, interpret,
     """q,k,v: [b, s, h, d] -> out [b, s, h, d] (+ lse [b*h, s_q, 128] fp32)."""
     b, s_q, h, d = q.shape
     s_kv = k.shape[1]
+    if causal and s_q > s_kv:
+        # the causal offset math assumes queries align to the END of the kv
+        # sequence (q_offset >= 0); with s_q > s_kv early q blocks would have
+        # no finalize step and return uninitialized output
+        raise ValueError(
+            f"causal flash attention requires s_q <= s_kv, got s_q={s_q} "
+            f"s_kv={s_kv}")
     scale = scale if scale is not None else 1.0 / math.sqrt(d)
 
     # clamp to the largest divisor <= requested — a non-dividing request (e.g.
@@ -152,7 +159,12 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_kv, interpret,
         # the diagonal block so the revisit-dedup skips the fetch (at long
         # seq this halves K/V HBM traffic)
         def kv_index(i, j, kb):
-            return (i, jnp.minimum(kb, (j * bq + bq - 1 + q_offset) // bkv), 0)
+            # outer maximum: with s_q > s_kv (unsupported, but reachable via
+            # the generic entry point) q_offset < 0 makes the clamp limit
+            # negative — keep the index in range instead of handing the DMA
+            # an out-of-range block
+            return (i, jnp.maximum(
+                jnp.minimum(kb, (j * bq + bq - 1 + q_offset) // bkv), 0), 0)
     else:
         def kv_index(i, j, kb):
             return (i, kb, 0)
@@ -332,6 +344,10 @@ def _dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dk_ref, dv_ref,
 def _flash_bwd(q, k, v, out, lse, g, causal, scale, block_q, block_kv, interpret):
     b, s_q, h, d = q.shape
     s_kv = k.shape[1]
+    if causal and s_q > s_kv:
+        raise ValueError(
+            f"causal flash attention requires s_q <= s_kv, got s_q={s_q} "
+            f"s_kv={s_kv}")
     scale = scale if scale is not None else 1.0 / math.sqrt(d)
     bq = _fit_block(block_q, s_q)
     bkv = _fit_block(block_kv, s_kv)
@@ -346,7 +362,12 @@ def _flash_bwd(q, k, v, out, lse, g, causal, scale, block_q, block_kv, interpret
         # clamp skipped above-diagonal fetches to the diagonal block so the
         # revisit-dedup skips their DMA (see _flash_fwd)
         def kv_index(i, j, kb):
-            return (i, jnp.minimum(kb, (j * bq + bq - 1 + q_offset) // bkv), 0)
+            # outer maximum: with s_q > s_kv (unsupported, but reachable via
+            # the generic entry point) q_offset < 0 makes the clamp limit
+            # negative — keep the index in range instead of handing the DMA
+            # an out-of-range block
+            return (i, jnp.maximum(
+                jnp.minimum(kb, (j * bq + bq - 1 + q_offset) // bkv), 0), 0)
 
         def q_index_dkv(i, jkv, qb):
             # dkv grid iterates q blocks; blocks before the kv block's causal

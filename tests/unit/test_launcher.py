@@ -201,8 +201,10 @@ def test_cli_builds_slurm_transport(tmp_path, monkeypatch):
     assert rc == 0
     cmd = captured["cmd"]
     assert cmd[:4] == ["srun", "-n", "2", "--ntasks-per-node=1"]
-    # srun is pinned to the hostfile hosts so the exported coordinator
-    # (tpu-0) is guaranteed a task
+    # slurm is the one transport where hostfile order does NOT set rank
+    # order: srun assigns SLURM_PROCID in Slurm's canonical (sorted) node
+    # order regardless of --nodelist order, so the default coordinator must
+    # be sorted()[0] (tpu-0) — the host that actually receives task 0
     assert ["--nodelist", "tpu-0,tpu-1"] == cmd[4:6]
     assert ("--export=ALL,DS_TPU_CONFIG=/tmp/ds.json,"
             "DS_TPU_COORDINATOR=tpu-0,MASTER_PORT=8476") in cmd
@@ -431,6 +433,11 @@ def test_pdsh_rank_from_hostname(monkeypatch):
         raise AssertionError("unlisted host must raise")
     except RuntimeError as e:
         assert "not in DS_TPU_HOSTS" in str(e)
+    # ambiguous short names: a.dc1 and a.dc2 both match hostname 'a' — two
+    # hosts deriving the same rank would hang jax.distributed init; refuse
+    monkeypatch.setattr(socket, "gethostname", lambda: "a")
+    with pytest.raises(RuntimeError, match="matches multiple"):
+        _rank_from_hostlist("a.dc1,a.dc2")
 
 
 def test_cli_builds_pdsh_transport(tmp_path, monkeypatch):
@@ -453,9 +460,12 @@ def test_cli_builds_pdsh_transport(tmp_path, monkeypatch):
     assert rc == 0
     cmd = captured["cmd"]
     assert cmd[:7] == ["pdsh", "-S", "-R", "ssh", "-f", "1024", "-w"]
-    assert cmd[7] == "tpu-0,tpu-1"
-    assert "export DS_TPU_HOSTS=tpu-0,tpu-1;" in cmd[8]
-    assert "export DS_TPU_COORDINATOR=tpu-0;" in cmd[8]
+    # hostfile order, NOT lexicographic: rank order must match the hostfile
+    # (reference multinode_runner convention — 'tpu-10' must not outrank
+    # 'tpu-2' just because of string sort)
+    assert cmd[7] == "tpu-1,tpu-0"
+    assert "export DS_TPU_HOSTS=tpu-1,tpu-0;" in cmd[8]
+    assert "export DS_TPU_COORDINATOR=tpu-1;" in cmd[8]
     assert "export DS_TPU_CONFIG=/tmp/ds.json;" in cmd[8]
 
 

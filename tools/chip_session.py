@@ -5,7 +5,7 @@ where wedges happen (observed 2026-07-31: a 10 s gap between two TPU
 processes wedged the tunnel for >30 min; a ~60 s gap worked). This runner
 holds a single claim for the whole measurement plan:
 
-    python tools/chip_session.py                 # sweep + attention + serving
+    python tools/chip_session.py     # sweep + profile + attention + serving
     BENCH_PHASES="sweep,attn" python tools/chip_session.py
 
 Each phase is fenced with try/except so one failure doesn't cost the rest.
@@ -38,6 +38,12 @@ def _sweep():
     import sweep_bench
 
     sweep_bench.main()
+
+
+def _profile():
+    import profile_step
+
+    profile_step.main()
 
 
 def _attn():
@@ -81,17 +87,28 @@ def _connect():
             print(f"connect attempt {attempt}: backend up — {plat} "
                   f"x{len(devs)} ({time.time() - t0:.0f}s)", flush=True)
             return
-        except RuntimeError as e:
-            print(f"connect attempt {attempt}: {str(e)[:140]} "
-                  f"({time.time() - t0:.0f}s); retrying", flush=True)
+        except Exception as e:
+            # catch everything (not just RuntimeError): a failed backend init
+            # surfacing as an unexpected exception type must not kill the
+            # knocker after hours of waiting (KeyboardInterrupt/SystemExit
+            # still propagate — they are not Exception subclasses)
+            print(f"connect attempt {attempt}: {type(e).__name__}: "
+                  f"{str(e)[:140]} ({time.time() - t0:.0f}s); retrying",
+                  flush=True)
+            if time.time() - t0 < 10:
+                # a normal failed axon init takes ~25 min; an instant failure
+                # means something is broken locally — don't busy-loop
+                time.sleep(30)
 
 
 def main():
-    phases = os.environ.get("BENCH_PHASES", "sweep,attn,serving").split(",")
+    phases = os.environ.get(
+        "BENCH_PHASES", "sweep,profile,attn,serving").split(",")
     _connect()
     # imports stay inside the phase fences: a broken unselected module must
     # not cost the whole claim
-    table = {"sweep": _sweep, "attn": _attn, "serving": _serving}
+    table = {"sweep": _sweep, "profile": _profile, "attn": _attn,
+             "serving": _serving}
     for p in phases:
         p = p.strip()
         if p in table:
