@@ -96,13 +96,21 @@ class Autotuner:
     """
 
     def __init__(self, model_factory, base_config, *, device_memory_bytes=None,
-                 peak_flops=None, hbm_bw=None, results_dir=None):
+                 peak_flops=None, hbm_bw=None, results_dir=None,
+                 zero_stages=None, remats=None, offloads=None, micros=None):
         self.model_factory = model_factory
         self.base_config = dict(base_config)
         self.device_memory = device_memory_bytes or self._detect_memory()
         # roofline constants for the estimate (defaults: v5e-ish)
         self.peak_flops = peak_flops or 100e12
         self.hbm_bw = hbm_bw or 6e11
+        # user-constrained search space (reference autotuning config lets the
+        # user scope the sweep, e.g. ``"zero_optimization": {"stage": [1, 2]}``
+        # in autotuner.py:404 tune's space) — None means the full default axis
+        self.zero_stages = zero_stages
+        self.remats = remats
+        self.offloads = offloads
+        self.micros = micros
         # experiment ledger (reference autotuning_results/ contract,
         # autotuner.py:404): every candidate's outcome is appended to
         # <results_dir>/ledger.jsonl as it lands, and a re-run resumes from it
@@ -144,12 +152,15 @@ class Autotuner:
 
     # ------------------------------------------------------------------
     def search_space(self, n_devices, global_batch):
-        zero_stages = [0, 1, 2, 3]
+        zero_stages = self.zero_stages if self.zero_stages is not None \
+            else [0, 1, 2, 3]
         # minimal_nomlp: recompute the fc GEMM instead of saving mlp_hidden —
         # the compile-prune stage discards it wherever "minimal" already fits
-        remats = ["minimal", "minimal_nomlp", None]
-        offloads = [None, "cpu"]
-        micros = [m for m in (1, 2, 4, 8, 16)
+        remats = self.remats if self.remats is not None \
+            else ["minimal", "minimal_nomlp", None]
+        offloads = self.offloads if self.offloads is not None else [None, "cpu"]
+        micros = [m for m in (self.micros if self.micros is not None
+                              else (1, 2, 4, 8, 16))
                   if global_batch % (m * 1) == 0]
         meshes = _factor_meshes(n_devices)
         cands = []

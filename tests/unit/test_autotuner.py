@@ -35,6 +35,21 @@ def test_search_space_respects_divisibility():
         assert dp * c["mesh"]["model"] == 8
 
 
+def test_search_space_user_constraints():
+    """Reference autotuning config scopes the sweep (user-specified stage
+    lists etc.); the constructor kwargs are that knob here."""
+    tuner = Autotuner(_factory(), BASE, device_memory_bytes=2 ** 40,
+                      zero_stages=[2], remats=["minimal"], offloads=[None],
+                      micros=[2, 4])
+    cands = tuner.search_space(n_devices=8, global_batch=8)
+    assert cands, "constrained space must not be empty"
+    for c in cands:
+        assert c["zero_optimization"]["stage"] == 2
+        assert "offload_optimizer" not in c["zero_optimization"]
+        assert c["_remat"] == "minimal"
+        assert c["train_micro_batch_size_per_gpu"] in (2, 4)
+
+
 def test_tune_picks_a_measured_config(tmp_path):
     rng = np.random.RandomState(0)
     batch = {"input_ids": rng.randint(0, 128, (8, 32)).astype(np.int32)}

@@ -187,6 +187,23 @@ def main():
             engine = model = None
     print(f"\nbest: {best[0]} at {best[1]:.0f} tok/s")
 
+    # autotuner roofline validation rides the same claim (VERDICT r3 #9: the
+    # est_time ranking has never been checked on chip). Chained here rather
+    # than as a chip_session phase so an already-running session — which
+    # imports this module lazily at phase time — still picks it up.
+    if os.environ.get("BENCH_AUTOTUNE", "1") == "1":
+        try:
+            import validate_autotuner
+
+            print("\n===== autotuner validation =====", flush=True)
+            validate_autotuner.main()
+        except Exception as e:
+            import traceback
+
+            traceback.print_exc()
+            print(f"autotuner validation FAILED: {type(e).__name__}: "
+                  f"{str(e)[:200]}", flush=True)
+
 
 if __name__ == "__main__":
     main()
