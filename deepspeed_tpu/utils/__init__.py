@@ -1,4 +1,18 @@
 from .logging import logger, log_dist
 from .timer import SynchronizedWallClockTimer, ThroughputTimer
+from .tensor_fragment import (
+    param_names,
+    safe_get_full_fp32_param,
+    safe_get_full_grad,
+    safe_get_full_optimizer_state,
+    safe_set_full_fp32_param,
+    safe_set_full_optimizer_state,
+)
 
-__all__ = ["logger", "log_dist", "SynchronizedWallClockTimer", "ThroughputTimer"]
+__all__ = [
+    "logger", "log_dist", "SynchronizedWallClockTimer", "ThroughputTimer",
+    "param_names",
+    "safe_get_full_fp32_param", "safe_get_full_grad",
+    "safe_get_full_optimizer_state", "safe_set_full_fp32_param",
+    "safe_set_full_optimizer_state",
+]

@@ -1,0 +1,227 @@
+"""Debug access to full (unsharded) params, grads, and optimizer state.
+
+Reference ``deepspeed/utils/tensor_fragment.py:91-124``:
+``safe_get_full_fp32_param`` / ``safe_get_full_grad`` /
+``safe_get_full_optimizer_state`` and the ``safe_set_*`` write-back variants —
+the APIs users reach for when debugging a sharded run, where naively reading
+``param.data`` would see only this rank's fragment.
+
+Torch addresses fragments through the parameter object; here parameters are
+pytree leaves, addressed by tree path — ``"wte/weight"``, a ``("wte",
+"weight")`` tuple, or list indices as decimal segments (``"blocks/0/w"``).
+``param_names(engine)`` enumerates every valid path.
+
+All getters return host numpy arrays of the FULL value regardless of the
+engine's sharding (ZeRO-1/2/3 state/grad/param specs, TP axes): a
+``device_get`` on a sharded ``jax.Array`` assembles every addressable shard.
+Setters re-place the edited value into the leaf's original device sharding.
+Single-controller scope: in a multi-host run each process only addresses its
+own shards — gather debugging belongs on a one-process mesh (the reference's
+APIs similarly require a live partition group to all-gather through).
+
+ZeRO-Offload engines keep fp32 masters and optimizer state host-side in
+native/NVMe layouts; the param getter serves them from the device mirror, but
+grad/state access raises with a pointer to ``state_for_checkpoint``.
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+def _split(path):
+    if isinstance(path, str):
+        parts = [p for p in path.split("/") if p]
+    elif isinstance(path, (tuple, list)):
+        parts = list(path)
+    else:
+        raise TypeError(f"path must be str or tuple, got {type(path)!r}")
+    if not parts:
+        raise KeyError("empty parameter path")
+    return parts
+
+
+def _resolve(tree, path):
+    """Walk ``tree`` by the segments of ``path``; returns the leaf."""
+    node = tree
+    for part in _split(path):
+        if isinstance(node, dict):
+            if part not in node:
+                raise KeyError(
+                    f"path segment {part!r} not found; available: "
+                    f"{sorted(node.keys())}")
+            node = node[part]
+        elif isinstance(node, (list, tuple)):
+            node = node[int(part)]
+        else:
+            raise KeyError(f"cannot descend into {type(node).__name__} "
+                           f"at segment {part!r}")
+    return node
+
+
+def _replace(tree, path, value):
+    """Functionally replace the leaf at ``path``; returns a new tree."""
+    parts = _split(path)
+
+    def rec(node, i):
+        if i == len(parts):
+            return value
+        part = parts[i]
+        if isinstance(node, dict):
+            if part not in node:
+                raise KeyError(
+                    f"path segment {part!r} not found; available: "
+                    f"{sorted(node.keys())}")
+            out = dict(node)
+            out[part] = rec(node[part], i + 1)
+            return out
+        if isinstance(node, (list, tuple)):
+            idx = int(part)
+            seq = list(node)
+            seq[idx] = rec(seq[idx], i + 1)
+            return type(node)(seq) if isinstance(node, tuple) else seq
+        raise KeyError(f"cannot descend into {type(node).__name__} "
+                       f"at segment {part!r}")
+
+    return rec(tree, 0)
+
+
+def param_names(engine):
+    """Every parameter path of the engine, ``"a/b/c"``-joined."""
+    flat, _ = jax.tree_util.tree_flatten_with_path(engine.params)
+    names = []
+    for keypath, _ in flat:
+        segs = []
+        for k in keypath:
+            if hasattr(k, "key"):
+                segs.append(str(k.key))
+            elif hasattr(k, "idx"):
+                segs.append(str(k.idx))
+            else:
+                segs.append(str(k))
+        names.append("/".join(segs))
+    return names
+
+
+def _to_host_full(leaf):
+    return np.asarray(jax.device_get(leaf))
+
+
+def safe_get_full_fp32_param(engine, path):
+    """Full fp32 value of the parameter at ``path`` (reference
+    ``tensor_fragment.py:109 safe_get_full_fp32_param``). The engine stores
+    masters in fp32 (bf16/fp16 are compute dtypes), so this is the master."""
+    if getattr(engine, "_offloaded", None) is not None:
+        # offload keeps the device mirror in compute dtype; the fp32 master
+        # lives host-side inside the offload handler
+        return _to_host_full(
+            _resolve(engine._offloaded.masters, path)).astype(np.float32)
+    return _to_host_full(_resolve(engine.params, path)).astype(np.float32)
+
+
+def safe_set_full_fp32_param(engine, path, value):
+    """Write ``value`` back into the parameter at ``path``, preserving the
+    leaf's dtype and device sharding (reference ``safe_set_full_fp32_param``)."""
+    old = _resolve(engine.params, path)
+    arr = jnp.asarray(value, dtype=old.dtype)
+    if arr.shape != old.shape:
+        raise ValueError(f"shape mismatch for {path}: param {old.shape}, "
+                         f"value {arr.shape}")
+    if getattr(engine, "_offloaded", None) is not None:
+        # the device tree is only a compute-dtype MIRROR under offload: step()
+        # rebuilds it from the host fp32 masters, so a mirror-only write would
+        # be silently reverted at the next step and never reach checkpoints —
+        # the master is the write target
+        off = engine._offloaded
+        master = _resolve(off.masters, path)
+        host = np.asarray(value, dtype=np.float32)
+        if host.shape != master.shape:
+            raise ValueError(f"shape mismatch for {path}: master "
+                             f"{master.shape}, value {host.shape}")
+        if isinstance(master, np.ndarray):
+            # native cpu_adam path: the kernels update these buffers in place
+            # and _device_params reads them fresh — mutate, don't replace
+            np.copyto(master, host)
+        else:
+            off.masters = _replace(
+                off.masters, path, jax.device_put(host, off.cpu))
+    placed = jax.device_put(arr, old.sharding)
+    engine.params = _replace(engine.params, path, placed)
+
+
+def safe_get_full_grad(engine, path):
+    """Full fp32 gradient at ``path`` as the optimizer would see it, or None
+    when no gradient has been accumulated (reference ``safe_get_full_grad``
+    returns None outside the backward window).
+
+    Engine accumulation stores ``sum_micro(grad * loss_scale / gas)``;
+    dividing by the live loss scale recovers the effective gradient. Only the
+    ``forward()/backward()/step()`` API retains gradients — the fused
+    ``train_batch`` path consumes them inside one XLA dispatch.
+    """
+    if getattr(engine, "_offloaded", None) is not None and \
+            engine._acc_grads is None:
+        raise NotImplementedError(
+            "safe_get_full_grad on a ZeRO-Offload engine outside the "
+            "backward window: host grads are transient; read them between "
+            "backward() and step()")
+    if engine._acc_grads is None:
+        return None
+    leaf = _resolve(engine._acc_grads, path)
+    scale = float(engine._scale) if engine.fp16_enabled else 1.0
+    return _to_host_full(leaf).astype(np.float32) / scale
+
+
+_STATE_STEP_KEYS = ("step",)
+
+
+def _state_trees(engine):
+    state = engine.optimizer_state
+    if state is None and getattr(engine, "_offloaded", None) is not None:
+        # CPU offload keeps the state host-side; the XLA-CPU path exposes the
+        # same {"step", "exp_avg", ...} dict. Native/NVMe layouts (in-place
+        # numpy / on-disk leaves) have no tree to resolve against.
+        state = engine._offloaded.state
+        if state is None:
+            raise NotImplementedError(
+                "optimizer state is in the native/NVMe offload layout; use "
+                "engine._offloaded.state_for_checkpoint() to inspect it")
+    if not isinstance(state, dict):
+        raise TypeError(f"unexpected optimizer state layout: {type(state)!r}")
+    return {k: v for k, v in state.items() if k not in _STATE_STEP_KEYS}
+
+
+def safe_get_full_optimizer_state(engine, path, optim_state_key):
+    """Full fp32 optimizer state for the parameter at ``path`` — e.g.
+    ``"exp_avg"`` / ``"exp_avg_sq"`` for Adam (reference
+    ``safe_get_full_optimizer_state``). Raises KeyError listing the valid
+    state keys of the active optimizer."""
+    trees = _state_trees(engine)
+    if optim_state_key not in trees:
+        raise KeyError(f"optimizer has no state {optim_state_key!r}; "
+                       f"available: {sorted(trees.keys())}")
+    return _to_host_full(
+        _resolve(trees[optim_state_key], path)).astype(np.float32)
+
+
+def safe_set_full_optimizer_state(engine, path, value, optim_state_key):
+    """Write ``value`` into the optimizer state tensor for ``path``,
+    preserving dtype and sharding (reference ``safe_set_full_optimizer_state``)."""
+    trees = _state_trees(engine)
+    if optim_state_key not in trees:
+        raise KeyError(f"optimizer has no state {optim_state_key!r}; "
+                       f"available: {sorted(trees.keys())}")
+    old = _resolve(trees[optim_state_key], path)
+    arr = jnp.asarray(value, dtype=old.dtype)
+    if arr.shape != old.shape:
+        raise ValueError(f"shape mismatch for {path}: state {old.shape}, "
+                         f"value {arr.shape}")
+    placed = jax.device_put(arr, old.sharding)
+    full_path = [optim_state_key] + _split(path)
+    if engine.optimizer_state is not None:
+        engine.optimizer_state = _replace(
+            engine.optimizer_state, full_path, placed)
+    else:  # CPU-offload: the live tree is the handler's host-side state
+        engine._offloaded.state = _replace(
+            engine._offloaded.state, full_path, placed)
