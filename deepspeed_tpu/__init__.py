@@ -14,6 +14,7 @@ __version__ = "0.1.0"
 __git_branch__ = "main"
 
 from . import comm  # noqa: F401
+from . import pipe  # noqa: F401
 from . import zero  # noqa: F401
 from .accelerator import get_accelerator, set_accelerator  # noqa: F401
 from .config import DeepSpeedConfig, load_config  # noqa: F401

@@ -1,0 +1,471 @@
+"""Pipeline parallelism over arbitrary user module lists.
+
+Reference ``deepspeed/runtime/pipe/module.py``: ``PipelineModule`` consumes a
+list of ``LayerSpec`` / ``TiedLayerSpec`` (``module.py:29,:85``), partitions it
+into stages (``_partition_layers``, ``module.py:353`` — ``uniform`` /
+``parameters`` / ``type:regex`` / custom), and runs the instruction-list
+schedule over torch processes. Here the same user surface compiles to ONE
+differentiable XLA program, like the built-in transformer pipeline
+(``parallel/pipeline.py``) but without assuming a homogeneous stacked block:
+
+- **partitioning** is the same contiguous balanced split (method names match
+  the reference);
+- **per-stage parameters** are packed into per-dtype flat buffers of shape
+  ``[n_stages, max_len]`` carrying logical axes ``("layers", None)`` — the
+  existing sharding rule places them over the ``pipe`` mesh axis, so each
+  stage holds only its own (padded) parameters, ZeRO/engine machinery
+  unchanged;
+- **heterogeneous stage programs** run under ``shard_map`` as a
+  ``lax.switch`` on ``axis_index("pipe")`` — each branch statically unpacks
+  its stage's parameter structure from the local flat buffer and applies its
+  own layer sequence; the GPipe tick loop and ``ppermute`` rotation are the
+  ones from ``parallel/pipeline.py``;
+- **tied layers** (``TiedLayerSpec``, reference ``module.py:85``) share one
+  parameter tree passed replicated across ``pipe``; reverse-mode AD inserts
+  the psum of the tied cotangents — the reference's explicit tied-grad
+  all-reduce (``pipe/module.py:433 allreduce_tied_weight_gradients``) for
+  free.
+
+Static-shape constraints (by construction, not limitation of the schedule):
+every INTER-stage boundary must produce the same activation shape/dtype.
+Stage 0's raw input and the last stage's head/loss are exempt — the first
+stage consumes the raw microbatch, the last stage reduces to a scalar loss
+inside its branch, so embeddings and heads live inside the pipeline like the
+reference's.
+
+The 1F1B schedule keeps its memory guarantee only for the built-in
+transformer backbone; user module lists run GPipe (config
+``pipeline.schedule: 1f1b`` falls back with a warning — the manual per-tick
+vjp in ``pipeline_1f1b.py`` is specialized to embed/blocks/head trees).
+"""
+
+import dataclasses
+import inspect
+import re
+import typing
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from .topology import PIPE_AXIS, DATA_AXIS
+from ..models.layers import Param
+from ..utils.logging import logger
+
+
+class LayerSpec:
+    """One pipeline layer as an (init, apply) pair.
+
+    ``init_fn(rng) -> params`` (a pytree of arrays or ``Param`` leaves);
+    ``apply_fn(params, x)`` or ``apply_fn(params, x, rng)`` -> y.
+    Reference ``pipe/module.py:29 LayerSpec`` (class + args deferred build).
+    """
+
+    def __init__(self, init_fn, apply_fn, name=None):
+        self.init_fn = init_fn
+        self.apply_fn = apply_fn
+        self.name = name or getattr(apply_fn, "__name__", "layer")
+        try:
+            sig = inspect.signature(apply_fn)
+            self.takes_rng = len([
+                p for p in sig.parameters.values()
+                if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD)
+            ]) >= 3 or "rng" in sig.parameters
+        except (TypeError, ValueError):
+            self.takes_rng = False
+
+    def build(self, rng):
+        params = self.init_fn(rng)
+        # every leaf carries logical axes; plain arrays get replicated axes
+        return jax.tree_util.tree_map(
+            lambda v: v if isinstance(v, Param) else Param(v, (None,) * np.ndim(v)),
+            params, is_leaf=lambda x: isinstance(x, Param))
+
+    def apply(self, params, x, rng=None):
+        if self.takes_rng:
+            return self.apply_fn(params, x, rng)
+        return self.apply_fn(params, x)
+
+
+class TiedLayerSpec(LayerSpec):
+    """A layer whose parameters are shared with every other spec of the same
+    ``key`` (reference ``pipe/module.py:85 TiedLayerSpec``; the canonical use
+    is input embedding + output head). The first spec with a key builds the
+    parameters; later ones reuse them."""
+
+    def __init__(self, key, init_fn, apply_fn, name=None):
+        super().__init__(init_fn, apply_fn, name=name or key)
+        self.key = key
+
+
+def partition_balanced(weights, n_parts):
+    """Contiguous split of ``weights`` into ``n_parts`` non-empty groups
+    minimizing the max group weight (reference ``ds_utils.partition_balanced``
+    used by ``module.py:353``). Returns boundary indices of length
+    ``n_parts + 1``."""
+    n = len(weights)
+    if n_parts > n:
+        raise ValueError(f"cannot split {n} layers into {n_parts} stages")
+    prefix = np.concatenate([[0], np.cumsum(np.asarray(weights, np.float64))])
+
+    def fits(cap):
+        bounds, start = [0], 0
+        for _ in range(n_parts):
+            # furthest end with group weight <= cap, leaving enough layers
+            # for the remaining stages
+            end = int(np.searchsorted(prefix, prefix[start] + cap, "right")) - 1
+            end = min(end, n - (n_parts - len(bounds)))
+            if end <= start:
+                return None
+            bounds.append(end)
+            start = end
+        return bounds if bounds[-1] == n else None
+
+    lo = float(np.max(weights)) if n else 0.0
+    hi = float(prefix[-1])
+    best = fits(hi)
+    for _ in range(50):  # binary search on capacity
+        mid = (lo + hi) / 2
+        b = fits(mid)
+        if b is not None:
+            best, hi = b, mid
+        else:
+            lo = mid
+    assert best is not None
+    return best
+
+
+@dataclasses.dataclass
+class PipelineModuleConfig:
+    """Engine-facing knobs (duck-typed by ``runtime/engine.py:95-127``)."""
+
+    pipeline_stages: int = 1
+    pipeline_microbatches: int = 1
+    mesh: typing.Any = None
+    compute_dtype: typing.Any = jnp.float32
+    remat: bool = False
+    # GPipe only for user module lists (see module docstring)
+    causal: bool = False
+    final_layernorm: bool = False
+
+
+class PipelineModule:
+    """Pipeline-train an arbitrary layer list (reference
+    ``pipe/module.py:85 PipelineModule``).
+
+    Args:
+      layers: list of ``LayerSpec`` / ``TiedLayerSpec``.
+      loss_fn: ``loss_fn(y, batch) -> scalar`` — mean loss of the microbatch;
+        receives the last layer's output and the (micro)batch dict.
+      partition_method: ``"uniform"`` (equal layer counts), ``"parameters"``
+        (balance parameter counts), ``"type:<regex>"`` (balance the count of
+        layers whose name matches), or an explicit boundary list like
+        ``[0, 3, n]`` (reference ``module.py:374-396``).
+      input_key: batch dict key holding the first layer's input.
+    """
+
+    def __init__(self, layers, loss_fn, partition_method="parameters",
+                 input_key="inputs"):
+        if not layers:
+            raise ValueError("PipelineModule needs at least one layer")
+        self.specs = list(layers)
+        self.loss_fn = loss_fn
+        self.partition_method = partition_method
+        self.input_key = input_key
+        self.config = PipelineModuleConfig()
+        self._layouts = None  # static packing metadata, set by init()
+
+    # -- partitioning ------------------------------------------------------------
+    def _stage_bounds(self, layer_weights):
+        method = self.partition_method
+        S = self.config.pipeline_stages
+        if isinstance(method, (list, tuple)):
+            bounds = list(method)
+            if len(bounds) != S + 1 or bounds[0] != 0 or bounds[-1] != len(self.specs) \
+                    or any(b >= e for b, e in zip(bounds, bounds[1:])):
+                raise ValueError(
+                    f"explicit partition {method} must be {S + 1} strictly "
+                    f"increasing bounds from 0 to {len(self.specs)}")
+            return bounds
+        if method == "uniform":
+            return partition_balanced([1.0] * len(self.specs), S)
+        if method == "parameters":
+            return partition_balanced(layer_weights, S)
+        if isinstance(method, str) and method.startswith("type:"):
+            pat = re.compile(method[len("type:"):], re.IGNORECASE)
+            w = [1.0 if pat.search(s.name) else 0.0 for s in self.specs]
+            if not any(w):
+                raise ValueError(f"partition {method!r} matched no layer names "
+                                 f"({[s.name for s in self.specs]})")
+            return partition_balanced(w, S)
+        raise ValueError(f"unknown partition_method {method!r}")
+
+    # -- init --------------------------------------------------------------------
+    def init(self, rng):
+        """Build all layer params; with pipeline_stages > 1, pack non-tied
+        stage params into per-dtype ``[S, max_len]`` flat buffers whose
+        ``("layers", None)`` axes shard them over ``pipe``."""
+        tied, layer_params = {}, []
+        for i, spec in enumerate(self.specs):
+            if isinstance(spec, TiedLayerSpec):
+                if spec.key not in tied:
+                    tied[spec.key] = spec.build(jax.random.fold_in(rng, i))
+                layer_params.append(None)
+            else:
+                layer_params.append(spec.build(jax.random.fold_in(rng, i)))
+
+        S = self.config.pipeline_stages
+        if S <= 1:
+            self._layouts = None
+            return {"layers": [p if p is not None else {} for p in layer_params],
+                    "tied": tied}
+
+        weights = [
+            0.0 if p is None else float(sum(
+                int(np.prod(l.value.shape))
+                for l in jax.tree_util.tree_leaves(
+                    p, is_leaf=lambda x: isinstance(x, Param))))
+            for p in layer_params]
+        bounds = self._stage_bounds(weights)
+        self._bounds = bounds
+
+        # pack: per stage, per dtype, the concatenation of raveled leaves (in
+        # tree_flatten order); static layout records (dtype, offset, shape,
+        # treedef) per layer so each switch branch can unpack its own stage
+        layouts, sizes = [], {}
+        stage_flat = []
+        for s in range(S):
+            layer_entries = []
+            offsets, chunks = {}, {}
+            for li in range(bounds[s], bounds[s + 1]):
+                p = layer_params[li]
+                if p is None:
+                    layer_entries.append(None)
+                    continue
+                vals, treedef = jax.tree_util.tree_flatten(
+                    jax.tree_util.tree_map(
+                        lambda x: x.value, p,
+                        is_leaf=lambda x: isinstance(x, Param)))
+                leaves = []
+                for v in vals:
+                    dt = jnp.result_type(v).name
+                    off = offsets.get(dt, 0)
+                    size = int(np.prod(np.shape(v))) if np.ndim(v) else 1
+                    leaves.append((dt, off, tuple(np.shape(v))))
+                    offsets[dt] = off + size
+                    chunks.setdefault(dt, []).append(jnp.ravel(v))
+                layer_entries.append((treedef, leaves))
+            layouts.append(layer_entries)
+            stage_flat.append({
+                dt: jnp.concatenate(parts) if parts else None
+                for dt, parts in chunks.items()})
+            for dt, off in offsets.items():
+                sizes[dt] = max(sizes.get(dt, 0), off)
+
+        self._layouts = layouts
+        buffers = {}
+        for dt, L in sizes.items():
+            rows = []
+            for s in range(S):
+                flat = stage_flat[s].get(dt)
+                if flat is None:
+                    flat = jnp.zeros((0,), dtype=dt)
+                rows.append(jnp.pad(flat, (0, L - flat.shape[0])))
+            buffers[dt] = Param(jnp.stack(rows), ("layers", None))
+        return {"stages": buffers, "tied": tied}
+
+    # -- application -------------------------------------------------------------
+    def _unpack_stage(self, stage_buffers, s):
+        """Rebuild stage ``s``'s per-layer param trees from the flat buffers.
+        ``stage_buffers[dt]`` is the LOCAL row ``[L]`` (inside shard_map) or
+        the global ``[S, L]`` (outside; pass ``s`` to row-select)."""
+        out = []
+        for entry in self._layouts[s]:
+            if entry is None:
+                out.append(None)
+                continue
+            treedef, leaves = entry
+            vals = []
+            for dt, off, shape in leaves:
+                buf = stage_buffers[dt]
+                size = int(np.prod(shape)) if shape else 1
+                vals.append(jax.lax.dynamic_slice_in_dim(
+                    buf, off, size, 0).reshape(shape))
+            out.append(jax.tree_util.tree_unflatten(treedef, vals))
+        return out
+
+    def _layer_apply(self, spec, params, tied, x, rng, layer_idx):
+        p = tied[spec.key] if isinstance(spec, TiedLayerSpec) else params
+        if isinstance(p, dict) and not isinstance(spec, TiedLayerSpec) and p == {}:
+            p = None
+        vals = jax.tree_util.tree_map(
+            lambda l: l.value if isinstance(l, Param) else l, p,
+            is_leaf=lambda x_: isinstance(x_, Param))
+        r = jax.random.fold_in(rng, layer_idx) if rng is not None else None
+        fn = spec.apply
+        if self.config.remat:
+            fn = jax.checkpoint(
+                lambda pp, xx, rr: spec.apply(pp, xx, rr), static_argnums=())
+            return fn(vals, x, r)
+        return fn(vals, x, r)
+
+    def _sequential(self, params, batch, rng):
+        """pipe=1 path (also the parity baseline): plain layer chain."""
+        x = batch[self.input_key]
+        tied = params.get("tied", {})
+        for i, spec in enumerate(self.specs):
+            x = self._layer_apply(spec, params["layers"][i], tied, x, rng, i)
+        return x
+
+    def loss(self, params, batch, deterministic=True, dropout_rng=None, **_):
+        rng = None if deterministic else dropout_rng
+        S = self.config.pipeline_stages
+        if S <= 1:
+            y = self._sequential(params, batch, rng)
+            return self.loss_fn(y, batch)
+        return self._pipelined_loss(params, batch, rng)
+
+    def _pipelined_loss(self, params, batch, rng):
+        cfg = self.config
+        mesh, S, M = cfg.mesh, cfg.pipeline_stages, cfg.pipeline_microbatches
+        if mesh is None:
+            raise ValueError("pipeline_stages > 1 requires config.mesh")
+        x = batch[self.input_key]
+        b = x.shape[0]
+        if b % M:
+            raise ValueError(f"batch {b} not divisible by microbatches {M}")
+        tied = params["tied"]
+        bounds = self._bounds
+        mb = b // M
+
+        def stage_program(s, p_list, tied_vals, h, rng_t):
+            for k, li in enumerate(range(bounds[s], bounds[s + 1])):
+                h = self._layer_apply(
+                    self.specs[li], p_list[k], tied_vals, h, rng_t, li)
+            return h
+
+        # boundary shape check: stage programs are heterogeneous, but every
+        # inter-stage hand-off must agree (static shapes; the reference's
+        # _send_tensor_meta handshake has no XLA equivalent by design)
+        # the engine hands loss() the VALUES tree (Param wrappers stripped by
+        # split_params_axes); direct module use may still pass Param leaves
+        unwrap = lambda l: l.value if isinstance(l, Param) else l
+        stage_params = [
+            self._unpack_stage(
+                {dt: unwrap(buf)[s] for dt, buf in params["stages"].items()}, s)
+            for s in range(S)]
+        shapes = []
+        cur = jax.eval_shape(lambda a: a[:mb], x)
+        for s in range(S):
+            cur = jax.eval_shape(
+                lambda h, s=s: stage_program(s, stage_params[s], tied, h, None),
+                cur)
+            shapes.append((cur.shape, cur.dtype))
+        boundary = shapes[0]
+        for s in range(1, S - 1):
+            if shapes[s] != boundary:
+                raise ValueError(
+                    f"inter-stage activation mismatch: stage 0 emits "
+                    f"{boundary}, stage {s} emits {shapes[s]} — pipeline "
+                    f"boundaries must have one static shape/dtype (pick "
+                    f"partition bounds that cut at uniform points)")
+        bshape, bdtype = boundary
+
+        # [b, ...] -> [M, mb, ...], microbatch rows sharded over data
+        def to_microbatches(a):
+            a = jnp.reshape(a, (M, mb) + a.shape[1:])
+            return jax.lax.with_sharding_constraint(
+                a, NamedSharding(mesh, P(None, DATA_AXIS)))
+
+        # f32 across the shard_map boundary for replicated (P()) inputs: AD's
+        # psum of their cotangent miscompiles in bf16 under the partial-manual
+        # partitioner (see parallel/pipeline.py); originals restored inside
+        def to_boundary(a):
+            return a.astype(jnp.float32) \
+                if jnp.issubdtype(a.dtype, jnp.floating) and a.dtype != jnp.float32 \
+                else a
+
+        batch_ms = jax.tree_util.tree_map(
+            lambda a: to_microbatches(to_boundary(a)), dict(batch))
+        batch_dtypes = {k: v.dtype for k, v in batch.items()}
+        tied_vals_host = jax.tree_util.tree_map(
+            unwrap, tied, is_leaf=lambda x_: isinstance(x_, Param))
+        tied_b = jax.tree_util.tree_map(to_boundary, tied_vals_host)
+        tied_dtypes = jax.tree_util.tree_map(lambda a: a.dtype, tied_vals_host)
+        buffers = {dt: unwrap(buf) for dt, buf in params["stages"].items()}
+
+        perm = [(i, (i + 1) % S) for i in range(S)]
+
+        def pipe_fn(bufs, tied_in, batch_in):
+            stage = jax.lax.axis_index(PIPE_AXIS)
+            local = {dt: v[0] for dt, v in bufs.items()}
+            tied_vals = jax.tree_util.tree_map(
+                lambda a, dt: a.astype(dt), tied_in, tied_dtypes)
+
+            def branch(s):
+                p_list = self._unpack_stage(local, s)
+
+                def run(h_in, raw_mb, tail_mb, rng_t):
+                    h = stage_program(s, p_list, tied_vals,
+                                      raw_mb if s == 0 else h_in, rng_t)
+                    if s == S - 1:
+                        # head output may differ from the boundary shape: the
+                        # loss reduces to a scalar inside the branch, and the
+                        # rotating slot gets a dummy
+                        loss = self.loss_fn(h, tail_mb).astype(jnp.float32)
+                        return jnp.zeros(bshape, bdtype), loss
+                    return h.astype(bdtype), jnp.zeros((), jnp.float32)
+
+                return run
+
+            branches = [branch(s) for s in range(S)]
+            T = M + S - 1
+
+            def tick(carry, t):
+                h_state, losses = carry
+                tm = jnp.clip(t, 0, M - 1)
+                raw_x = jax.lax.dynamic_index_in_dim(
+                    batch_in[self.input_key], tm, 0, False
+                ).astype(batch_dtypes[self.input_key])
+                idx = t - (S - 1)
+                cidx = jnp.clip(idx, 0, M - 1)
+                tail = {
+                    k: jax.lax.dynamic_index_in_dim(a, cidx, 0, False)
+                    .astype(batch_dtypes[k])
+                    for k, a in batch_in.items()}
+                rng_t = None
+                if rng is not None:
+                    # the stage's in-flight microbatch id is t - stage:
+                    # folding it keeps dropout independent per micro-step
+                    rng_t = jax.random.fold_in(
+                        rng, jnp.clip(t - stage, 0, M - 1))
+                h_out, loss_t = jax.lax.switch(
+                    stage, branches, h_state, raw_x, tail, rng_t)
+                sel = (stage == S - 1) & (idx >= 0)
+                cur = jax.lax.dynamic_index_in_dim(losses, cidx, 0, False)
+                losses = jax.lax.dynamic_update_index_in_dim(
+                    losses, jnp.where(sel, loss_t, cur), cidx, 0)
+                h_next = jax.lax.ppermute(h_out, PIPE_AXIS, perm)
+                return (h_next, losses), None
+
+            (_, losses), _ = jax.lax.scan(
+                tick, (jnp.zeros(bshape, bdtype), jnp.zeros((M,), jnp.float32)),
+                jnp.arange(T))
+            # only the last stage holds real losses; replicate via psum (f32)
+            total = jax.lax.psum(
+                jnp.where(stage == S - 1, jnp.sum(losses), 0.0), PIPE_AXIS)
+            return total / M
+
+        buf_specs = {dt: P(PIPE_AXIS, None) for dt in buffers}
+        tied_specs = jax.tree_util.tree_map(lambda _: P(), tied_b)
+        batch_specs = jax.tree_util.tree_map(lambda _: P(), batch_ms)
+        sm = jax.shard_map(
+            pipe_fn, mesh=mesh,
+            in_specs=(buf_specs, tied_specs, batch_specs),
+            out_specs=P(),
+            axis_names={PIPE_AXIS},
+            check_vma=False,
+        )
+        return sm(buffers, tied_b, batch_ms)

@@ -1,0 +1,12 @@
+"""``deepspeed_tpu.pipe`` — the reference's ``deepspeed.pipe`` namespace
+(``deepspeed/pipe/__init__.py``): pipeline-parallel training over user module
+lists. See ``parallel/pipeline_module.py`` for the TPU design."""
+
+from .parallel.pipeline_module import (  # noqa: F401
+    LayerSpec,
+    PipelineModule,
+    TiedLayerSpec,
+    partition_balanced,
+)
+
+__all__ = ["LayerSpec", "PipelineModule", "TiedLayerSpec", "partition_balanced"]

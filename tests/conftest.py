@@ -38,6 +38,17 @@ jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
 import pytest  # noqa: E402
 
 
+@pytest.fixture(autouse=True)
+def _collect_cycles():
+    """Engines captured in jit closures die by CYCLE collection, not refcount;
+    collecting between tests keeps live-buffer accounting (e.g.
+    test_destroy_releases_device_buffers) independent of test order."""
+    yield
+    import gc
+
+    gc.collect()
+
+
 @pytest.fixture(scope="session")
 def devices8():
     import jax
