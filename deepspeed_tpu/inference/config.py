@@ -51,6 +51,10 @@ class DeepSpeedInferenceConfig(ConfigModel):
     quant: QuantizationConfig = None
     moe: MoEInferenceConfig = None
     replace_with_kernel_inject: bool = False  # accepted for config compat; no-op
+    # reference mode-1 user injection policy (inference/engine.py:190), as
+    # {path_regex: "column"|"row"|"replicate"|axes_tuple} — see
+    # module_inject/policy.py
+    injection_policy: typing.Any = None
     seed: int = 0
 
     def _validate(self):

@@ -68,6 +68,8 @@ class InferenceEngine:
 
     # ------------------------------------------------------------------------------
     def _init_parameters(self, model_parameters):
+        # unknown models (no Param axes metadata) default every leaf to
+        # replicated — the injection_policy below is how users TP-place them
         if model_parameters is not None:
             if isinstance(model_parameters, tuple) and len(model_parameters) == 2:
                 values, axes = model_parameters
@@ -76,15 +78,28 @@ class InferenceEngine:
         else:
             params_shape = jax.eval_shape(self.module.init, self._rng)
             axes = jax.tree_util.tree_map(
-                lambda p: p.axes, params_shape, is_leaf=lambda x: isinstance(x, Param))
+                lambda p: p.axes if isinstance(p, Param)
+                else (None,) * len(p.shape),
+                params_shape, is_leaf=lambda x: isinstance(x, Param))
             values = None
 
         if values is not None:
             shapes = jax.tree_util.tree_map(lambda v: tuple(v.shape), values)
         else:
             shapes = jax.tree_util.tree_map(
-                lambda p: tuple(p.value.shape), params_shape,
-                is_leaf=lambda x: isinstance(x, Param))
+                lambda p: tuple((p.value if isinstance(p, Param) else p).shape),
+                params_shape, is_leaf=lambda x: isinstance(x, Param))
+
+        if self._config.injection_policy:
+            from ..module_inject.policy import apply_injection_policy
+
+            if self.mesh.shape.get(MODEL_AXIS, 1) <= 1:
+                raise ConfigError(
+                    "injection_policy given but tensor_parallel.tp_size is 1 "
+                    "— the policy would silently serve a replicated model; "
+                    "set tensor_parallel={'enabled': True, 'tp_size': N}")
+            axes = apply_injection_policy(
+                self._config.injection_policy, axes, shapes)
 
         # inference keeps params in the serving dtype (no fp32 masters) and TP-only
         # sharding (zero_stage=0: no data-sharded params)
@@ -93,8 +108,9 @@ class InferenceEngine:
 
         if values is None:
             init_fn = lambda rng: jax.tree_util.tree_map(
-                lambda a: a.astype(self.dtype),
-                split_params_axes(self.module.init(rng))[0])
+                lambda a: (a.value if isinstance(a, Param) else a)
+                .astype(self.dtype),
+                self.module.init(rng), is_leaf=lambda x: isinstance(x, Param))
             with self.mesh:
                 self.params = jax.jit(init_fn, out_shardings=self.param_shardings)(self._rng)
         else:
@@ -195,7 +211,13 @@ class InferenceEngine:
         input_ids = jnp.asarray(input_ids)
         b, s = input_ids.shape
         bucket = max(int(self._config.prompt_bucket_size), 1)
-        causal = getattr(self.module.config, "causal", True)
+        # no config = unknown model: don't assume causality — right-padding a
+        # bidirectional model would let pad tokens attend into real positions
+        # and silently corrupt the logits (skipping the bucket only costs one
+        # compile per distinct length)
+        mod_cfg = getattr(self.module, "config", None)
+        causal = getattr(mod_cfg, "causal", True) if mod_cfg is not None \
+            else False
         padded = s
         if causal and s % bucket:
             padded = min(-(-s // bucket) * bucket, self._config.max_tokens)
@@ -234,6 +256,11 @@ class InferenceEngine:
         serving layer's job, as in the reference's simple generate patching).
         Returns [b, prompt_len + max_new_tokens] int32.
         """
+        if not hasattr(self.module, "config"):
+            raise ConfigError(
+                "generate() needs a zoo-style model (config with kv cache "
+                "geometry + prefill/decode methods); an injection-policy-"
+                "served unknown model supports forward() scoring only")
         input_ids = jnp.asarray(input_ids, jnp.int32)
         b, prompt_len = input_ids.shape
         max_len = prompt_len + max_new_tokens

@@ -14,3 +14,4 @@ from .hf import (  # noqa: F401
     load_hf_checkpoint,
     hf_model_from_pretrained,
 )
+from .policy import apply_injection_policy  # noqa: F401

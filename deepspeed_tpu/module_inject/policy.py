@@ -1,0 +1,102 @@
+"""User injection policy: TP-shard a model the framework doesn't know.
+
+Reference mode-1 injection (``deepspeed/inference/engine.py:190``
+``injection_policy={TransformerLayer: ('attention.out_proj', 'mlp.down')}``):
+the user names each layer's ROW-parallel output projections and DeepSpeed
+splits the rest column-wise. Here sharding is logical-axes data, so the policy
+maps parameter-path regexes to placements and the engine derives the specs —
+no module surgery, works for any pytree model:
+
+    deepspeed_tpu.init_inference(
+        model=my_model,
+        tensor_parallel={"enabled": True, "tp_size": 4},
+        injection_policy={
+            r"attn/(wq|wk|wv)": "column",   # output dim over the model axis
+            r"attn/wo":         "row",      # input dim; XLA inserts the psum
+            r"mlp/up":          "column",
+            r"mlp/down":        "row",
+        })
+
+Values: ``"column"`` (last dim sharded — the Megatron ColumnParallelLinear),
+``"row"`` (first dim sharded — RowParallelLinear; the SPMD partitioner places
+the all-reduce the reference codes by hand in ``module_inject/layers.py``),
+``"replicate"``, or an explicit logical-axes tuple like ``(None, "heads")``
+(the training-side "bring-your-own-axes" vocabulary of
+``parallel/sharding.py:DEFAULT_TP_RULES``).
+
+Patterns are ``re.search``-ed against ``"/"``-joined leaf paths; the FIRST
+matching pattern (insertion order) wins. A pattern matching no parameter is
+an error — silent typos would serve a replicated (slow, memory-hungry) model.
+"""
+
+import re
+
+import jax
+
+from ..config.base import ConfigError
+from ..utils.tensor_fragment import keypath_str
+
+_COLUMN = "column"
+_ROW = "row"
+_REPLICATE = "replicate"
+
+
+def _spec_to_axes(spec, ndim, path):
+    if isinstance(spec, (tuple, list)):
+        if len(spec) != ndim:
+            raise ConfigError(
+                f"injection_policy: axes {tuple(spec)} for {path} has "
+                f"{len(spec)} entries but the parameter has {ndim} dims")
+        return tuple(spec)
+    if spec == _REPLICATE:
+        return (None,) * ndim
+    if ndim < 1:
+        raise ConfigError(
+            f"injection_policy: cannot {spec}-shard 0-d parameter {path}")
+    if spec == _COLUMN:
+        return (None,) * (ndim - 1) + ("mlp",)
+    if spec == _ROW:
+        return ("mlp",) + (None,) * (ndim - 1)
+    raise ConfigError(
+        f"injection_policy: unknown placement {spec!r} for {path} — use "
+        f"'column', 'row', 'replicate', or an explicit logical-axes tuple")
+
+
+def apply_injection_policy(policy, axes_tree, shapes_tree):
+    """Override logical axes for every leaf whose path matches a policy
+    pattern. Returns the new axes tree; raises on patterns that matched
+    nothing and on shard dims the mesh math can't honor later (non-tuple
+    axes)."""
+    if not policy:
+        return axes_tree
+    compiled = [(pat, re.compile(pat), spec) for pat, spec in policy.items()]
+    matched = set()
+
+    is_axes = lambda x: isinstance(x, tuple) and all(
+        isinstance(a, (str, type(None))) for a in x)
+    flat, treedef = jax.tree_util.tree_flatten_with_path(
+        axes_tree, is_leaf=is_axes)
+    # flatten shapes with the AXES treedef: independent is_leaf predicates
+    # would desynchronize on pytrees that use tuples as containers
+    shape_flat = treedef.flatten_up_to(shapes_tree)
+
+    out = []
+    for (keypath, axes), shape in zip(flat, shape_flat):
+        path = keypath_str(keypath)
+        for pat, rx, spec in compiled:
+            if rx.search(path):
+                # placement: first match wins; the typo check below still
+                # credits shadowed patterns so they don't read as typos
+                axes = _spec_to_axes(spec, len(shape), path)
+                break
+        for pat, rx, _ in compiled:
+            if rx.search(path):
+                matched.add(pat)
+        out.append(axes)
+    missing = [pat for pat, _, _ in compiled if pat not in matched]
+    if missing:
+        sample = [keypath_str(kp) for kp, _ in flat[:20]]
+        raise ConfigError(
+            f"injection_policy: pattern(s) {missing} matched no parameter — "
+            f"paths look like {sample}")
+    return jax.tree_util.tree_unflatten(treedef, out)

@@ -87,21 +87,24 @@ def _replace(tree, path, value):
     return rec(tree, 0)
 
 
+def keypath_str(keypath):
+    """jax key-path -> the ``"a/b/c"`` spelling used by every path-addressed
+    API here (fragment getters, injection policies, checkpoint names)."""
+    segs = []
+    for k in keypath:
+        if hasattr(k, "key"):
+            segs.append(str(k.key))
+        elif hasattr(k, "idx"):
+            segs.append(str(k.idx))
+        else:
+            segs.append(str(k))
+    return "/".join(segs)
+
+
 def param_names(engine):
     """Every parameter path of the engine, ``"a/b/c"``-joined."""
     flat, _ = jax.tree_util.tree_flatten_with_path(engine.params)
-    names = []
-    for keypath, _ in flat:
-        segs = []
-        for k in keypath:
-            if hasattr(k, "key"):
-                segs.append(str(k.key))
-            elif hasattr(k, "idx"):
-                segs.append(str(k.idx))
-            else:
-                segs.append(str(k))
-        names.append("/".join(segs))
-    return names
+    return [keypath_str(keypath) for keypath, _ in flat]
 
 
 def _to_host_full(leaf):
