@@ -157,6 +157,12 @@ class InferenceEngine:
             return tree
 
         # only block matmuls; embeddings/norms stay in the serving dtype
+        if not (isinstance(self.params, dict) and "blocks" in self.params):
+            raise ConfigError(
+                "quant.enabled needs a zoo-style model (params with a "
+                "'blocks' subtree whose matmuls read quantized kernels); an "
+                "injection-policy-served unknown model must be served "
+                "unquantized")
         self.params = dict(self.params)
         self.params["blocks"] = walk(self.params["blocks"],
                                      self.param_shardings["blocks"])

@@ -8,6 +8,9 @@ from .registry import (get_model, MODEL_CONFIGS, gpt2_config, opt_config,
 from .simple import SimpleModel, random_batch
 from .spatial import (DSUNet, DSVAE, SpatialConfig, SpatialUNet,
                       SpatialVAEDecoder)
+from .diffusers_import import (load_diffusers_unet, load_diffusers_vae_decoder,
+                               export_diffusers_unet,
+                               export_diffusers_vae_decoder)
 
 __all__ = [
     "MaskedLM",
@@ -18,6 +21,10 @@ __all__ = [
     "SpatialConfig",
     "SpatialUNet",
     "SpatialVAEDecoder",
+    "load_diffusers_unet",
+    "load_diffusers_vae_decoder",
+    "export_diffusers_unet",
+    "export_diffusers_vae_decoder",
     "Param",
     "split_params_axes",
     "CausalLM",

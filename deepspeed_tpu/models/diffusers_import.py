@@ -1,0 +1,330 @@
+"""Stable-Diffusion (diffusers-format) checkpoint import for the spatial models.
+
+Reference ``model_implementations/diffusers/unet.py:73`` +
+``module_inject/replace_module.py:184``: the reference injects kernels into a
+live diffusers ``UNet2DConditionModel``/``AutoencoderKL``. Here the
+checkpoint is *mapped* (the same philosophy as ``module_inject/hf.py``): a
+diffusers safetensors/torch state dict loads into the
+``SpatialUNet(diffusers_geometry=True)`` / ``SpatialVAEDecoder`` pytrees.
+
+Layout conversions: torch conv ``[O, I, kh, kw]`` -> HWIO ``[kh, kw, I, O]``;
+torch linear ``[O, I]`` -> ``[I, O]``; norm ``weight/bias`` -> ``scale/bias``.
+diffusers' attention ``to_q/to_k/to_v`` carry no bias — imported as zeros
+(numerically identical).
+
+Every checkpoint key must be consumed (or match an explicit ignore pattern:
+the VAE file also carries the encoder) and every model leaf must be filled —
+a silent partial load would "work" and produce garbage samples.
+
+Usage::
+
+    cfg = SpatialConfig(base_channels=320, channel_mults=(1, 2, 4, 4),
+                        n_res_blocks=2, n_heads=8, context_dim=768,
+                        groups=32, diffusers_geometry=True)
+    unet = DSUNet(SpatialUNet(cfg),
+                  params=load_diffusers_unet("unet/", cfg))
+
+``export_diffusers_unet`` / ``export_diffusers_vae_decoder`` are the exact
+inverses (used by the round-trip tests; also lets edited weights save back).
+"""
+
+import os
+import re
+
+import numpy as np
+
+import jax
+
+from .spatial import SpatialConfig  # noqa: F401  (re-export convenience)
+
+
+def _np(v):
+    if hasattr(v, "detach"):  # torch tensor
+        v = v.detach().cpu().numpy()
+    return np.asarray(v)
+
+
+def load_state_dict(path_or_state):
+    """Accept a dict (torch/numpy values), a safetensors file, a torch .bin
+    file, or a diffusers model directory containing either."""
+    if isinstance(path_or_state, dict):
+        return {k: _np(v) for k, v in path_or_state.items()}
+    path = path_or_state
+    if os.path.isdir(path):
+        for name in ("diffusion_pytorch_model.safetensors",
+                     "diffusion_pytorch_model.bin"):
+            cand = os.path.join(path, name)
+            if os.path.isfile(cand):
+                path = cand
+                break
+        else:
+            raise FileNotFoundError(
+                f"no diffusers weights (diffusion_pytorch_model.*) in {path}")
+    if path.endswith(".safetensors"):
+        from safetensors.numpy import load_file
+
+        return dict(load_file(path))
+    import torch
+
+    return {k: _np(v) for k, v in
+            torch.load(path, map_location="cpu", weights_only=True).items()}
+
+
+class _Mapper:
+    """Consumes checkpoint keys; tracks what was read so leftovers error."""
+
+    def __init__(self, state):
+        self.state = state
+        self.used = set()
+
+    def take(self, key):
+        if key not in self.state:
+            raise KeyError(f"diffusers checkpoint is missing {key!r} — wrong "
+                           f"config geometry for this file?")
+        self.used.add(key)
+        return self.state[key]
+
+    def conv(self, pre):
+        return {"kernel": np.transpose(self.take(pre + ".weight"), (2, 3, 1, 0)),
+                "bias": self.take(pre + ".bias")}
+
+    def linear(self, pre, zeros_bias_dim=None):
+        w = self.take(pre + ".weight").T
+        if pre + ".bias" in self.state:
+            b = self.take(pre + ".bias")
+        else:  # diffusers to_q/to_k/to_v have no bias
+            b = np.zeros((zeros_bias_dim if zeros_bias_dim is not None
+                          else w.shape[1],), w.dtype)
+        return {"kernel": w, "bias": b}
+
+    def norm(self, pre):
+        return {"scale": self.take(pre + ".weight"),
+                "bias": self.take(pre + ".bias")}
+
+    def resnet(self, pre, temb):
+        p = {"norm1": self.norm(pre + ".norm1"),
+             "conv1": self.conv(pre + ".conv1"),
+             "norm2": self.norm(pre + ".norm2"),
+             "conv2": self.conv(pre + ".conv2")}
+        if temb:
+            p["temb"] = self.linear(pre + ".time_emb_proj")
+        if pre + ".conv_shortcut.weight" in self.state:
+            p["skip"] = self.conv(pre + ".conv_shortcut")
+        return p
+
+    def attn_pair(self, pre):
+        return {"q": self.linear(pre + ".to_q"),
+                "k": self.linear(pre + ".to_k"),
+                "v": self.linear(pre + ".to_v"),
+                "o": self.linear(pre + ".to_out.0")}
+
+    def transformer2d(self, pre):
+        blocks = []
+        d = 0
+        while f"{pre}.transformer_blocks.{d}.norm1.weight" in self.state:
+            tb = f"{pre}.transformer_blocks.{d}"
+            blocks.append({
+                "ln1": self.norm(tb + ".norm1"),
+                "attn1": self.attn_pair(tb + ".attn1"),
+                "ln2": self.norm(tb + ".norm2"),
+                "attn2": self.attn_pair(tb + ".attn2"),
+                "ln3": self.norm(tb + ".norm3"),
+                "ff_proj": self.linear(tb + ".ff.net.0.proj"),
+                "ff_out": self.linear(tb + ".ff.net.2"),
+            })
+            d += 1
+        if not blocks:
+            raise KeyError(f"no transformer_blocks under {pre}")
+        return {"norm": self.norm(pre + ".norm"),
+                "proj_in": self.conv(pre + ".proj_in"),
+                "blocks": blocks,
+                "proj_out": self.conv(pre + ".proj_out")}
+
+    def finish(self, ignore=()):
+        left = [k for k in self.state
+                if k not in self.used
+                and not any(re.match(pat, k) for pat in ignore)]
+        if left:
+            raise ValueError(
+                f"{len(left)} unconsumed checkpoint keys (geometry mismatch?):"
+                f" {sorted(left)[:12]}...")
+
+
+def load_diffusers_unet(path_or_state, config):
+    """diffusers UNet2DConditionModel state dict -> SpatialUNet
+    (``diffusers_geometry=True``) values pytree."""
+    if not config.diffusers_geometry:
+        raise ValueError("load_diffusers_unet needs "
+                         "SpatialConfig(diffusers_geometry=True)")
+    m = _Mapper(load_state_dict(path_or_state))
+    chans = [config.base_channels * mult for mult in config.channel_mults]
+    p = {"conv_in": m.conv("conv_in"),
+         "temb1": m.linear("time_embedding.linear_1"),
+         "temb2": m.linear("time_embedding.linear_2")}
+    down = []
+    for i in range(len(chans)):
+        blocks = []
+        for j in range(config.n_res_blocks):
+            blk = {"res": m.resnet(f"down_blocks.{i}.resnets.{j}", temb=True)}
+            if config.attn_at(i):
+                blk["attn"] = m.transformer2d(f"down_blocks.{i}.attentions.{j}")
+            blocks.append(blk)
+        ds = None
+        if i < len(chans) - 1:
+            ds = m.conv(f"down_blocks.{i}.downsamplers.0.conv")
+        down.append({"blocks": blocks, "downsample": ds})
+    p["down"] = down
+    p["mid"] = {"res1": m.resnet("mid_block.resnets.0", temb=True),
+                "attn": m.transformer2d("mid_block.attentions.0"),
+                "res2": m.resnet("mid_block.resnets.1", temb=True)}
+    up = []
+    for k in range(len(chans)):
+        level = len(chans) - 1 - k
+        blocks = []
+        for j in range(config.n_res_blocks + 1):
+            blk = {"res": m.resnet(f"up_blocks.{k}.resnets.{j}", temb=True)}
+            if config.attn_at(level):
+                blk["attn"] = m.transformer2d(f"up_blocks.{k}.attentions.{j}")
+            blocks.append(blk)
+        us = None
+        if k < len(chans) - 1:
+            us = m.conv(f"up_blocks.{k}.upsamplers.0.conv")
+        up.append({"blocks": blocks, "upsample": us})
+    p["up"] = up
+    p["norm_out"] = m.norm("conv_norm_out")
+    p["conv_out"] = m.conv("conv_out")
+    m.finish()
+    return p
+
+
+def load_diffusers_vae_decoder(path_or_state, config):
+    """diffusers AutoencoderKL state dict (decoder half + post_quant_conv) ->
+    SpatialVAEDecoder (``diffusers_geometry=True``) values pytree. Encoder and
+    quant_conv keys in a full-VAE file are ignored."""
+    if not config.diffusers_geometry:
+        raise ValueError("load_diffusers_vae_decoder needs "
+                         "SpatialConfig(diffusers_geometry=True)")
+    m = _Mapper(load_state_dict(path_or_state))
+    n_up = len(config.channel_mults)
+    p = {"post_quant_conv": m.conv("post_quant_conv"),
+         "conv_in": m.conv("decoder.conv_in"),
+         "mid": {"res1": m.resnet("decoder.mid_block.resnets.0", temb=False),
+                 "attn": {"group_norm": m.norm(
+                              "decoder.mid_block.attentions.0.group_norm"),
+                          **m.attn_pair("decoder.mid_block.attentions.0")},
+                 "res2": m.resnet("decoder.mid_block.resnets.1", temb=False)},
+         "up": []}
+    for k in range(n_up):
+        blocks = [m.resnet(f"decoder.up_blocks.{k}.resnets.{j}", temb=False)
+                  for j in range(config.n_res_blocks + 1)]
+        conv = None
+        if k < n_up - 1:
+            conv = m.conv(f"decoder.up_blocks.{k}.upsamplers.0.conv")
+        p["up"].append({"blocks": blocks, "conv": conv})
+    p["norm_out"] = m.norm("decoder.conv_norm_out")
+    p["conv_out"] = m.conv("decoder.conv_out")
+    m.finish(ignore=(r"encoder\.", r"quant_conv\."))
+    return p
+
+
+# ---------------------------------------------------------------------------------
+# exporters (exact inverses; round-trip tested)
+# ---------------------------------------------------------------------------------
+def _ex_conv(out, pre, p):
+    # ascontiguousarray: safetensors serializes the raw buffer, and a
+    # transposed VIEW would silently save the un-transposed data
+    out[pre + ".weight"] = np.ascontiguousarray(
+        np.transpose(np.asarray(p["kernel"]), (3, 2, 0, 1)))
+    out[pre + ".bias"] = np.asarray(p["bias"])
+
+
+def _ex_lin(out, pre, p):
+    out[pre + ".weight"] = np.ascontiguousarray(np.asarray(p["kernel"]).T)
+    out[pre + ".bias"] = np.asarray(p["bias"])
+
+
+def _ex_norm(out, pre, p):
+    out[pre + ".weight"] = np.asarray(p["scale"])
+    out[pre + ".bias"] = np.asarray(p["bias"])
+
+
+def _ex_resnet(out, pre, p):
+    _ex_norm(out, pre + ".norm1", p["norm1"])
+    _ex_conv(out, pre + ".conv1", p["conv1"])
+    _ex_norm(out, pre + ".norm2", p["norm2"])
+    _ex_conv(out, pre + ".conv2", p["conv2"])
+    if "temb" in p:
+        _ex_lin(out, pre + ".time_emb_proj", p["temb"])
+    if "skip" in p:
+        _ex_conv(out, pre + ".conv_shortcut", p["skip"])
+
+
+def _ex_attn_pair(out, pre, p):
+    for ours, theirs in (("q", "to_q"), ("k", "to_k"), ("v", "to_v")):
+        _ex_lin(out, f"{pre}.{theirs}", p[ours])
+    _ex_lin(out, pre + ".to_out.0", p["o"])
+
+
+def _ex_transformer2d(out, pre, p):
+    _ex_norm(out, pre + ".norm", p["norm"])
+    _ex_conv(out, pre + ".proj_in", p["proj_in"])
+    for d, tb in enumerate(p["blocks"]):
+        b = f"{pre}.transformer_blocks.{d}"
+        _ex_norm(out, b + ".norm1", tb["ln1"])
+        _ex_attn_pair(out, b + ".attn1", tb["attn1"])
+        _ex_norm(out, b + ".norm2", tb["ln2"])
+        _ex_attn_pair(out, b + ".attn2", tb["attn2"])
+        _ex_norm(out, b + ".norm3", tb["ln3"])
+        _ex_lin(out, b + ".ff.net.0.proj", tb["ff_proj"])
+        _ex_lin(out, b + ".ff.net.2", tb["ff_out"])
+    _ex_conv(out, pre + ".proj_out", p["proj_out"])
+
+
+def export_diffusers_unet(params, config):
+    out = {}
+    _ex_conv(out, "conv_in", params["conv_in"])
+    _ex_lin(out, "time_embedding.linear_1", params["temb1"])
+    _ex_lin(out, "time_embedding.linear_2", params["temb2"])
+    for i, stage in enumerate(params["down"]):
+        for j, blk in enumerate(stage["blocks"]):
+            _ex_resnet(out, f"down_blocks.{i}.resnets.{j}", blk["res"])
+            if "attn" in blk:
+                _ex_transformer2d(out, f"down_blocks.{i}.attentions.{j}",
+                                  blk["attn"])
+        if stage["downsample"] is not None:
+            _ex_conv(out, f"down_blocks.{i}.downsamplers.0.conv",
+                     stage["downsample"])
+    _ex_resnet(out, "mid_block.resnets.0", params["mid"]["res1"])
+    _ex_transformer2d(out, "mid_block.attentions.0", params["mid"]["attn"])
+    _ex_resnet(out, "mid_block.resnets.1", params["mid"]["res2"])
+    for k, stage in enumerate(params["up"]):
+        for j, blk in enumerate(stage["blocks"]):
+            _ex_resnet(out, f"up_blocks.{k}.resnets.{j}", blk["res"])
+            if "attn" in blk:
+                _ex_transformer2d(out, f"up_blocks.{k}.attentions.{j}",
+                                  blk["attn"])
+        if stage["upsample"] is not None:
+            _ex_conv(out, f"up_blocks.{k}.upsamplers.0.conv", stage["upsample"])
+    _ex_norm(out, "conv_norm_out", params["norm_out"])
+    _ex_conv(out, "conv_out", params["conv_out"])
+    return out
+
+
+def export_diffusers_vae_decoder(params, config):
+    out = {}
+    _ex_conv(out, "post_quant_conv", params["post_quant_conv"])
+    _ex_conv(out, "decoder.conv_in", params["conv_in"])
+    _ex_resnet(out, "decoder.mid_block.resnets.0", params["mid"]["res1"])
+    _ex_norm(out, "decoder.mid_block.attentions.0.group_norm",
+             params["mid"]["attn"]["group_norm"])
+    _ex_attn_pair(out, "decoder.mid_block.attentions.0", params["mid"]["attn"])
+    _ex_resnet(out, "decoder.mid_block.resnets.1", params["mid"]["res2"])
+    for k, stage in enumerate(params["up"]):
+        for j, res in enumerate(stage["blocks"]):
+            _ex_resnet(out, f"decoder.up_blocks.{k}.resnets.{j}", res)
+        if stage["conv"] is not None:
+            _ex_conv(out, f"decoder.up_blocks.{k}.upsamplers.0.conv",
+                     stage["conv"])
+    _ex_norm(out, "decoder.conv_norm_out", params["norm_out"])
+    _ex_conv(out, "decoder.conv_out", params["conv_out"])
+    return out

@@ -31,7 +31,16 @@ from .layers import Param
 
 @dataclasses.dataclass
 class SpatialConfig:
-    """Compact UNet/VAE geometry (diffusers UNet2DConditionModel-shaped)."""
+    """Compact UNet/VAE geometry (diffusers UNet2DConditionModel-shaped).
+
+    ``diffusers_geometry=True`` switches to the EXACT diffusers SD-1.x module
+    graph (skip bookkeeping incl. conv_in/downsampler outputs, n_res+1-resnet
+    up blocks, per-level cross-attention, proj_in/out + GEGLU transformer
+    blocks) so real Stable-Diffusion checkpoints load via
+    ``models/diffusers_import.py``. SD-1.5 itself is
+    ``SpatialConfig(base_channels=320, channel_mults=(1, 2, 4, 4),
+    n_res_blocks=2, n_heads=8, context_dim=768, groups=32,
+    diffusers_geometry=True)``."""
 
     in_channels: int = 4
     out_channels: int = 4
@@ -42,6 +51,15 @@ class SpatialConfig:
     context_dim: int = 0        # >0 enables cross-attention (text conditioning)
     groups: int = 16
     compute_dtype: object = jnp.float32
+    diffusers_geometry: bool = False
+    # cross-attention per resolution level (None = diffusers SD default:
+    # every level except the deepest)
+    attention_levels: tuple = None
+
+    def attn_at(self, level):
+        if self.attention_levels is not None:
+            return bool(self.attention_levels[level])
+        return level < len(self.channel_mults) - 1
 
 
 # ---------------------------------------------------------------------------------
@@ -172,6 +190,105 @@ def spatial_transformer_apply(cfg, p, x, context=None):
 
 
 # ---------------------------------------------------------------------------------
+# diffusers-exact blocks (diffusers_geometry=True; reference
+# model_implementations/diffusers/unet.py:73 wraps the real
+# UNet2DConditionModel — this is its module graph, TPU-native)
+# ---------------------------------------------------------------------------------
+def basic_transformer_init(rng, ch, n_heads, context_dim):
+    """diffusers ``BasicTransformerBlock``: ln1+self-attn, ln2+cross-attn,
+    ln3+GEGLU feed-forward. to_q/k/v carry no bias in diffusers; zero-bias
+    here is numerically identical and keeps one linear layout."""
+    r = jax.random.split(rng, 8)
+    inner = 4 * ch
+    ctx = context_dim or ch
+    return {
+        "ln1": L.layernorm_init(ch),
+        "attn1": {"q": L.linear_init(r[0], ch, ch, ("embed", "heads")),
+                  "k": L.linear_init(r[1], ch, ch, ("embed", "heads")),
+                  "v": L.linear_init(r[2], ch, ch, ("embed", "heads")),
+                  "o": L.linear_init(r[3], ch, ch, ("heads", "embed"))},
+        "ln2": L.layernorm_init(ch),
+        "attn2": {"q": L.linear_init(r[4], ch, ch, ("embed", "heads")),
+                  "k": L.linear_init(r[5], ctx, ch, (None, "heads")),
+                  "v": L.linear_init(r[6], ctx, ch, (None, "heads")),
+                  "o": L.linear_init(r[7], ch, ch, ("heads", "embed"))},
+        "ln3": L.layernorm_init(ch),
+        # GEGLU: one projection to 2*inner, split into value and gate
+        "ff_proj": L.linear_init(jax.random.fold_in(rng, 8), ch, 2 * inner,
+                                 ("embed", "mlp")),
+        "ff_out": L.linear_init(jax.random.fold_in(rng, 9), inner, ch,
+                                ("mlp", "embed")),
+    }
+
+
+def _mha(q_p, k_p, v_p, o_p, xq, xkv, n_heads):
+    b, s_q, c = xq.shape
+    hd = c // n_heads
+    q = L.linear_apply(q_p, xq).reshape(b, s_q, n_heads, hd)
+    k = L.linear_apply(k_p, xkv).reshape(b, xkv.shape[1], n_heads, hd)
+    v = L.linear_apply(v_p, xkv).reshape(b, xkv.shape[1], n_heads, hd)
+    a = L.dot_product_attention(q, k, v)
+    return L.linear_apply(o_p, a.reshape(b, s_q, c))
+
+
+def basic_transformer_apply(cfg, p, tokens, context=None):
+    t = L.layernorm_apply(p["ln1"], tokens)
+    tokens = tokens + _mha(p["attn1"]["q"], p["attn1"]["k"], p["attn1"]["v"],
+                           p["attn1"]["o"], t, t, cfg.n_heads)
+    t = L.layernorm_apply(p["ln2"], tokens)
+    kv = context if context is not None else t
+    tokens = tokens + _mha(p["attn2"]["q"], p["attn2"]["k"], p["attn2"]["v"],
+                           p["attn2"]["o"], t, kv, cfg.n_heads)
+    t = L.layernorm_apply(p["ln3"], tokens)
+    h = L.linear_apply(p["ff_proj"], t)
+    val, gate = jnp.split(h, 2, axis=-1)
+    return tokens + L.linear_apply(p["ff_out"], val * jax.nn.gelu(gate))
+
+
+def spatial_transformer2d_init(rng, ch, n_heads, context_dim, depth=1):
+    """diffusers ``Transformer2DModel`` (SD-1.x flavor): GroupNorm, 1x1-conv
+    proj_in, ``depth`` BasicTransformerBlocks, 1x1-conv proj_out, residual."""
+    r = jax.random.split(rng, depth + 2)
+    return {
+        "norm": groupnorm_init(ch),
+        "proj_in": conv2d_init(r[0], ch, ch, kernel=1),
+        "blocks": [basic_transformer_init(r[2 + i], ch, n_heads, context_dim)
+                   for i in range(depth)],
+        "proj_out": conv2d_init(r[1], ch, ch, kernel=1),
+    }
+
+
+def spatial_transformer2d_apply(cfg, p, x, context=None):
+    b, h, w, c = x.shape
+    res = x
+    x = groupnorm_apply(p["norm"], x, cfg.groups)
+    x = conv2d_apply(p["proj_in"], x)
+    tokens = x.reshape(b, h * w, c)
+    for blk in p["blocks"]:
+        tokens = basic_transformer_apply(cfg, blk, tokens, context)
+    x = conv2d_apply(p["proj_out"], tokens.reshape(b, h, w, c))
+    return res + x
+
+
+def vae_attention_init(rng, ch):
+    """diffusers VAE mid-block ``Attention`` (single head, linear q/k/v/out
+    over flattened tokens, GroupNorm in front)."""
+    r = jax.random.split(rng, 4)
+    return {"group_norm": groupnorm_init(ch),
+            "q": L.linear_init(r[0], ch, ch, ("embed", "heads")),
+            "k": L.linear_init(r[1], ch, ch, ("embed", "heads")),
+            "v": L.linear_init(r[2], ch, ch, ("embed", "heads")),
+            "o": L.linear_init(r[3], ch, ch, ("heads", "embed"))}
+
+
+def vae_attention_apply(cfg, p, x):
+    b, h, w, c = x.shape
+    t = groupnorm_apply(p["group_norm"], x, cfg.groups).reshape(b, h * w, c)
+    out = _mha(p["q"], p["k"], p["v"], p["o"], t, t, n_heads=1)
+    return x + out.reshape(b, h, w, c)
+
+
+# ---------------------------------------------------------------------------------
 # UNet (conditional, diffusers UNet2DConditionModel-shaped)
 # ---------------------------------------------------------------------------------
 class SpatialUNet:
@@ -186,6 +303,8 @@ class SpatialUNet:
 
     def init(self, rng):
         cfg = self.config
+        if cfg.diffusers_geometry:
+            return self._init_diffusers(rng)
         temb_dim = cfg.base_channels * 4
         chans = [cfg.base_channels * m for m in cfg.channel_mults]
         r = iter(jax.random.split(rng, 64))
@@ -232,11 +351,109 @@ class SpatialUNet:
         p["conv_out"] = conv2d_init(next(r), ch, cfg.out_channels)
         return p
 
+    def _init_diffusers(self, rng):
+        """EXACT diffusers UNet2DConditionModel graph: skips include conv_in
+        and downsampler outputs, up blocks run n_res+1 resnets, attention per
+        level (``attn_at``), Transformer2DModel blocks with proj_in/out."""
+        cfg = self.config
+        temb_dim = cfg.base_channels * 4
+        chans = [cfg.base_channels * m for m in cfg.channel_mults]
+        r = iter(jax.random.split(rng, 256))
+        p = {
+            "temb1": L.linear_init(next(r), cfg.base_channels, temb_dim,
+                                   (None, None)),
+            "temb2": L.linear_init(next(r), temb_dim, temb_dim, (None, None)),
+            "conv_in": conv2d_init(next(r), cfg.in_channels, chans[0]),
+        }
+        skip_chs = [chans[0]]
+        ch = chans[0]
+        down = []
+        for i, out_ch in enumerate(chans):
+            blocks = []
+            for _ in range(cfg.n_res_blocks):
+                blk = {"res": resnet_block_init(next(r), ch, out_ch, temb_dim)}
+                if cfg.attn_at(i):
+                    blk["attn"] = spatial_transformer2d_init(
+                        next(r), out_ch, cfg.n_heads, cfg.context_dim)
+                blocks.append(blk)
+                ch = out_ch
+                skip_chs.append(ch)
+            ds = None
+            if i < len(chans) - 1:
+                ds = conv2d_init(next(r), ch, ch)
+                skip_chs.append(ch)
+            down.append({"blocks": blocks, "downsample": ds})
+        p["down"] = down
+        p["mid"] = {
+            "res1": resnet_block_init(next(r), ch, ch, temb_dim),
+            "attn": spatial_transformer2d_init(next(r), ch, cfg.n_heads,
+                                               cfg.context_dim),
+            "res2": resnet_block_init(next(r), ch, ch, temb_dim),
+        }
+        up = []
+        for k, out_ch in enumerate(reversed(chans)):
+            level = len(chans) - 1 - k
+            blocks = []
+            for _ in range(cfg.n_res_blocks + 1):
+                skip = skip_chs.pop()
+                blk = {"res": resnet_block_init(next(r), ch + skip, out_ch,
+                                                temb_dim)}
+                if cfg.attn_at(level):
+                    blk["attn"] = spatial_transformer2d_init(
+                        next(r), out_ch, cfg.n_heads, cfg.context_dim)
+                blocks.append(blk)
+                ch = out_ch
+            us = conv2d_init(next(r), ch, ch) if k < len(chans) - 1 else None
+            up.append({"blocks": blocks, "upsample": us})
+        p["up"] = up
+        p["norm_out"] = groupnorm_init(ch)
+        p["conv_out"] = conv2d_init(next(r), ch, cfg.out_channels)
+        return p
+
+    def _apply_diffusers(self, params, sample, timestep, ctx):
+        cfg = self.config
+        dtype = cfg.compute_dtype
+        x = sample.astype(dtype)
+        temb = timestep_embedding(jnp.asarray(timestep), cfg.base_channels)
+        temb = L.linear_apply(params["temb2"], jax.nn.silu(
+            L.linear_apply(params["temb1"], temb.astype(dtype))))
+        x = conv2d_apply(params["conv_in"], x)
+        skips = [x]
+        for stage in params["down"]:
+            for blk in stage["blocks"]:
+                x = resnet_block_apply(cfg, blk["res"], x, temb)
+                if "attn" in blk:
+                    x = spatial_transformer2d_apply(cfg, blk["attn"], x, ctx)
+                skips.append(x)
+            if stage["downsample"] is not None:
+                x = conv2d_apply(stage["downsample"], x, stride=2)
+                skips.append(x)
+        x = resnet_block_apply(cfg, params["mid"]["res1"], x, temb)
+        x = spatial_transformer2d_apply(cfg, params["mid"]["attn"], x, ctx)
+        x = resnet_block_apply(cfg, params["mid"]["res2"], x, temb)
+        for stage in params["up"]:
+            for blk in stage["blocks"]:
+                skip = skips.pop()
+                x = resnet_block_apply(
+                    cfg, blk["res"], jnp.concatenate([x, skip], axis=-1), temb)
+                if "attn" in blk:
+                    x = spatial_transformer2d_apply(cfg, blk["attn"], x, ctx)
+            if stage["upsample"] is not None:
+                b, h, w, c = x.shape
+                x = jax.image.resize(x, (b, h * 2, w * 2, c), "nearest")
+                x = conv2d_apply(stage["upsample"], x)
+        x = groupnorm_apply(params["norm_out"], x, cfg.groups, act="silu")
+        return conv2d_apply(params["conv_out"], x).astype(dtype)
+
     def apply(self, params, sample, timestep, encoder_hidden_states=None):
         """sample: [b, h, w, in_ch] NHWC; timestep: [b]; encoder_hidden_states:
         [b, s, context_dim] or None. Returns the predicted noise [b, h, w, out_ch].
         """
         cfg = self.config
+        if cfg.diffusers_geometry:
+            ctx = None if encoder_hidden_states is None \
+                else encoder_hidden_states.astype(cfg.compute_dtype)
+            return self._apply_diffusers(params, sample, timestep, ctx)
         dtype = cfg.compute_dtype
         x = sample.astype(dtype)
         ctx = None if encoder_hidden_states is None \
@@ -290,7 +507,35 @@ class SpatialVAEDecoder:
     def init(self, rng):
         cfg = self.config
         ch = cfg.base_channels * cfg.channel_mults[-1]
-        r = iter(jax.random.split(rng, 32))
+        # legacy geometry keeps its original split count: threefry subkeys
+        # depend on n, so widening the split would silently change every
+        # seeded legacy init
+        r = iter(jax.random.split(rng, 96 if cfg.diffusers_geometry else 32))
+        if cfg.diffusers_geometry:
+            # EXACT diffusers AutoencoderKL decoder graph: post_quant_conv,
+            # mid (res, single-head Attention, res), up blocks with
+            # n_res_blocks+1 resnets each, upsamplers on all but the last
+            p = {"post_quant_conv": conv2d_init(
+                     next(r), cfg.in_channels, cfg.in_channels, kernel=1),
+                 "conv_in": conv2d_init(next(r), cfg.in_channels, ch),
+                 "mid": {"res1": resnet_block_init(next(r), ch, ch, 0),
+                         "attn": vae_attention_init(next(r), ch),
+                         "res2": resnet_block_init(next(r), ch, ch, 0)},
+                 "up": []}
+            stages = [cfg.base_channels * m for m in reversed(cfg.channel_mults)]
+            for i, out_ch in enumerate(stages):
+                blocks = []
+                for _ in range(cfg.n_res_blocks + 1):
+                    blocks.append(resnet_block_init(next(r), ch, out_ch, 0))
+                    ch = out_ch
+                p["up"].append({
+                    "blocks": blocks,
+                    "conv": conv2d_init(next(r), ch, ch)
+                    if i < len(stages) - 1 else None,
+                })
+            p["norm_out"] = groupnorm_init(ch)
+            p["conv_out"] = conv2d_init(next(r), ch, 3)
+            return p
         p = {"conv_in": conv2d_init(next(r), cfg.in_channels, ch),
              "mid": {"res1": resnet_block_init(next(r), ch, ch, 0),
                      "attn": spatial_transformer_init(next(r), ch, cfg.n_heads, 0),
@@ -311,6 +556,21 @@ class SpatialVAEDecoder:
     def apply(self, params, latents):
         cfg = self.config
         x = latents.astype(cfg.compute_dtype)
+        if cfg.diffusers_geometry:
+            x = conv2d_apply(params["post_quant_conv"], x)
+            x = conv2d_apply(params["conv_in"], x)
+            x = resnet_block_apply(cfg, params["mid"]["res1"], x)
+            x = vae_attention_apply(cfg, params["mid"]["attn"], x)
+            x = resnet_block_apply(cfg, params["mid"]["res2"], x)
+            for stage in params["up"]:
+                for res in stage["blocks"]:
+                    x = resnet_block_apply(cfg, res, x)
+                if stage["conv"] is not None:
+                    b, h, w, c = x.shape
+                    x = jax.image.resize(x, (b, h * 2, w * 2, c), "nearest")
+                    x = conv2d_apply(stage["conv"], x)
+            x = groupnorm_apply(params["norm_out"], x, cfg.groups, act="silu")
+            return conv2d_apply(params["conv_out"], x)
         x = conv2d_apply(params["conv_in"], x)
         x = resnet_block_apply(cfg, params["mid"]["res1"], x)
         x = spatial_transformer_apply(cfg, params["mid"]["attn"], x)

@@ -1,0 +1,179 @@
+"""Diffusers SD-1.x checkpoint import (reference
+``model_implementations/diffusers/unet.py:73`` + ``replace_module.py:184``):
+the spatial models load real Stable-Diffusion-format weights. Without the
+diffusers library installed, fidelity is pinned three ways: an
+export->import round trip (exact inverse mapping), the canonical SD key
+schema (golden key names a real checkpoint uses), and a forward-parity check
+through a safetensors file; with diffusers available, a real
+UNet2DConditionModel numerical parity test runs too."""
+
+import importlib.util
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.models import (
+    DSUNet, DSVAE, SpatialConfig, SpatialUNet, SpatialVAEDecoder,
+    export_diffusers_unet, export_diffusers_vae_decoder,
+    load_diffusers_unet, load_diffusers_vae_decoder, split_params_axes)
+
+HAS_DIFFUSERS = importlib.util.find_spec("diffusers") is not None
+
+# tiny SD-shaped geometry: 3 levels, attention on all but the deepest —
+# the same block-type pattern as SD-1.5 (CrossAttn, CrossAttn, Down)
+CFG = SpatialConfig(in_channels=4, out_channels=4, base_channels=32,
+                    channel_mults=(1, 2, 2), n_res_blocks=2, n_heads=4,
+                    context_dim=24, groups=8, diffusers_geometry=True)
+VCFG = SpatialConfig(in_channels=4, base_channels=32, channel_mults=(1, 2),
+                     n_res_blocks=1, n_heads=4, groups=8,
+                     diffusers_geometry=True)
+
+
+def _unet_values():
+    return split_params_axes(SpatialUNet(CFG).init(jax.random.PRNGKey(0)))[0]
+
+
+def test_unet_roundtrip_through_safetensors(tmp_path):
+    values = _unet_values()
+    sd = export_diffusers_unet(values, CFG)
+    from safetensors.numpy import save_file
+
+    f = str(tmp_path / "diffusion_pytorch_model.safetensors")
+    save_file(sd, f)
+    loaded = load_diffusers_unet(str(tmp_path), CFG)
+
+    flat_a = jax.tree_util.tree_leaves(values)
+    flat_b = jax.tree_util.tree_leaves(loaded)
+    assert len(flat_a) == len(flat_b)
+    for a, b in zip(flat_a, flat_b):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+
+    # and the loaded weights actually drive the model
+    unet = DSUNet(SpatialUNet(CFG), params=jax.tree_util.tree_map(
+        jnp.asarray, loaded))
+    out = unet(np.zeros((1, 16, 16, 4), np.float32), 3,
+               np.zeros((1, 6, 24), np.float32))
+    assert out.shape == (1, 16, 16, 4) and np.isfinite(np.asarray(out)).all()
+
+
+def test_unet_key_schema_is_canonical_sd():
+    """The exporter must speak the EXACT diffusers SD naming — these literal
+    keys exist in every real SD-1.x UNet checkpoint."""
+    keys = set(export_diffusers_unet(_unet_values(), CFG))
+    for k in [
+        "conv_in.weight",
+        "time_embedding.linear_1.weight",
+        "time_embedding.linear_2.bias",
+        "down_blocks.0.resnets.0.norm1.weight",
+        "down_blocks.0.resnets.0.conv1.weight",
+        "down_blocks.0.resnets.0.time_emb_proj.weight",
+        "down_blocks.0.attentions.0.proj_in.weight",
+        "down_blocks.0.attentions.0.transformer_blocks.0.attn1.to_q.weight",
+        "down_blocks.0.attentions.0.transformer_blocks.0.attn2.to_k.weight",
+        "down_blocks.0.attentions.0.transformer_blocks.0.ff.net.0.proj.weight",
+        "down_blocks.0.attentions.0.transformer_blocks.0.ff.net.2.weight",
+        "down_blocks.0.attentions.0.transformer_blocks.0.norm3.weight",
+        "down_blocks.0.downsamplers.0.conv.weight",
+        "down_blocks.1.resnets.0.conv_shortcut.weight",
+        "mid_block.resnets.0.norm1.weight",
+        "mid_block.attentions.0.proj_out.bias",
+        "mid_block.resnets.1.time_emb_proj.bias",
+        "up_blocks.0.resnets.2.conv1.weight",
+        "up_blocks.0.upsamplers.0.conv.weight",
+        "up_blocks.2.attentions.2.transformer_blocks.0.attn2.to_out.0.weight",
+        "conv_norm_out.weight",
+        "conv_out.bias",
+    ]:
+        assert k in keys, f"missing canonical SD key {k}"
+    # the deepest level has NO attention in SD's block-type pattern
+    assert not any(k.startswith("down_blocks.2.attentions") for k in keys)
+    # conv weights are 4-d OIHW, linears 2-d [out, in]
+    sd = export_diffusers_unet(_unet_values(), CFG)
+    assert sd["conv_in.weight"].shape == (32, 4, 3, 3)
+    assert sd["time_embedding.linear_1.weight"].shape == (128, 32)
+    assert sd["down_blocks.0.attentions.0.transformer_blocks.0"
+              ".ff.net.0.proj.weight"].shape == (2 * 4 * 32, 32)
+
+
+def test_unet_import_rejects_wrong_geometry():
+    sd = export_diffusers_unet(_unet_values(), CFG)
+    import dataclasses
+
+    wrong = dataclasses.replace(CFG, n_res_blocks=1)
+    with pytest.raises((KeyError, ValueError)):
+        load_diffusers_unet(sd, wrong)
+    with pytest.raises(ValueError, match="diffusers_geometry"):
+        load_diffusers_unet(sd, dataclasses.replace(CFG,
+                                                    diffusers_geometry=False))
+
+
+def test_vae_decoder_roundtrip_and_decode():
+    values = split_params_axes(
+        SpatialVAEDecoder(VCFG).init(jax.random.PRNGKey(1)))[0]
+    sd = export_diffusers_vae_decoder(values, VCFG)
+    for k in ["post_quant_conv.weight",
+              "decoder.conv_in.weight",
+              "decoder.mid_block.attentions.0.group_norm.weight",
+              "decoder.mid_block.attentions.0.to_q.weight",
+              "decoder.mid_block.resnets.1.conv2.bias",
+              "decoder.up_blocks.0.resnets.1.norm1.weight",
+              "decoder.up_blocks.0.upsamplers.0.conv.weight",
+              "decoder.conv_norm_out.weight",
+              "decoder.conv_out.weight"]:
+        assert k in sd, f"missing canonical VAE key {k}"
+    # a full-VAE file also contains the encoder: ignored, not an error
+    sd["encoder.conv_in.weight"] = np.zeros((1,), np.float32)
+    sd["quant_conv.weight"] = np.zeros((1,), np.float32)
+    loaded = load_diffusers_vae_decoder(sd, VCFG)
+    for a, b in zip(jax.tree_util.tree_leaves(values),
+                    jax.tree_util.tree_leaves(loaded)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+    vae = DSVAE(SpatialVAEDecoder(VCFG), params=jax.tree_util.tree_map(
+        jnp.asarray, loaded))
+    img = vae.decode(np.zeros((1, 8, 8, 4), np.float32))
+    assert img.shape == (1, 16, 16, 3)
+
+
+def test_unconsumed_keys_are_an_error():
+    sd = export_diffusers_unet(_unet_values(), CFG)
+    sd["some.leftover.weight"] = np.zeros((2,), np.float32)
+    with pytest.raises(ValueError, match="unconsumed"):
+        load_diffusers_unet(sd, CFG)
+
+
+@pytest.mark.skipif(not HAS_DIFFUSERS, reason="diffusers not installed")
+def test_numerical_parity_vs_real_diffusers():
+    """With diffusers available: build a tiny UNet2DConditionModel, load its
+    state dict here, and match its forward output."""
+    import torch
+    from diffusers import UNet2DConditionModel
+
+    ref = UNet2DConditionModel(
+        sample_size=16, in_channels=4, out_channels=4,
+        block_out_channels=(32, 64, 64), layers_per_block=2,
+        cross_attention_dim=24, attention_head_dim=8, norm_num_groups=8,
+        down_block_types=("CrossAttnDownBlock2D", "CrossAttnDownBlock2D",
+                          "DownBlock2D"),
+        up_block_types=("UpBlock2D", "CrossAttnUpBlock2D",
+                        "CrossAttnUpBlock2D"))
+    ref.eval()
+    # diffusers naming quirk: attention_head_dim=8 on UNet2DConditionModel
+    # actually means NUM heads = 8 — match it
+    cfg = SpatialConfig(in_channels=4, out_channels=4, base_channels=32,
+                        channel_mults=(1, 2, 2), n_res_blocks=2, n_heads=8,
+                        context_dim=24, groups=8, diffusers_geometry=True)
+    params = load_diffusers_unet(ref.state_dict(), cfg)
+    rng = np.random.RandomState(0)
+    sample = rng.randn(1, 4, 16, 16).astype(np.float32)   # torch NCHW
+    ctx = rng.randn(1, 6, 24).astype(np.float32)
+    with torch.no_grad():
+        want = ref(torch.tensor(sample), 3,
+                   torch.tensor(ctx)).sample.numpy()
+    unet = DSUNet(SpatialUNet(cfg), params=jax.tree_util.tree_map(
+        jnp.asarray, params))
+    got = np.asarray(unet(np.transpose(sample, (0, 2, 3, 1)), 3, ctx))
+    np.testing.assert_allclose(np.transpose(got, (0, 3, 1, 2)), want,
+                               rtol=1e-3, atol=1e-4)
