@@ -33,10 +33,15 @@ stage consumes the raw microbatch, the last stage reduces to a scalar loss
 inside its branch, so embeddings and heads live inside the pipeline like the
 reference's.
 
-The 1F1B schedule keeps its memory guarantee only for the built-in
-transformer backbone; user module lists run GPipe (config
-``pipeline.schedule: 1f1b`` falls back with a warning — the manual per-tick
-vjp in ``pipeline_1f1b.py`` is specialized to embed/blocks/head trees).
+Both schedules run over user lists: GPipe (AD through the tick scan) and
+1F1B (``build_1f1b_step`` — the default on pipe-only meshes; per-tick
+``jax.vjp`` over the stage switch bounds in-flight activations to O(stages)).
+TP/SP meshes fall back to GPipe with a warning — widening the manual region
+under a stage-varying switch is the transformer-specialized
+``pipeline_1f1b.py``'s job. Compile cost of the switch-vjp program grows
+with stage count (every branch is traced twice); deep-S pipelines on the
+virtual CPU mesh compile slowly, which is why the unit tests pin parity at
+S=2 (incl. M>S ring reuse) and only smoke S=4.
 """
 
 import dataclasses
@@ -327,9 +332,16 @@ class PipelineModule:
             return self.loss_fn(y, batch)
         return self._pipelined_loss(params, batch, rng)
 
-    def _pipelined_loss(self, params, batch, rng):
+    # -- shared pipelined-schedule plumbing (GPipe loss AND the 1F1B step) -------
+    def _pipelined_prep(self, params, batch, M, mesh):
+        """Everything both pipelined schedules need: boundary shape (checked),
+        microbatched/replicated inputs, flat buffers, and the uniform
+        per-stage program factory ``prog(s)(local_bufs, tied_vals, h_in,
+        raw_x, tail, rng_t) -> (boundary_out, loss_scalar)`` — one signature
+        for every stage so ``lax.switch`` (and ``jax.vjp`` over it, for 1F1B)
+        drives heterogeneous stages."""
         cfg = self.config
-        mesh, S, M = cfg.mesh, cfg.pipeline_stages, cfg.pipeline_microbatches
+        S = cfg.pipeline_stages
         if mesh is None:
             raise ValueError("pipeline_stages > 1 requires config.mesh")
         x = batch[self.input_key]
@@ -348,9 +360,9 @@ class PipelineModule:
 
         # boundary shape check: stage programs are heterogeneous, but every
         # inter-stage hand-off must agree (static shapes; the reference's
-        # _send_tensor_meta handshake has no XLA equivalent by design)
-        # the engine hands loss() the VALUES tree (Param wrappers stripped by
-        # split_params_axes); direct module use may still pass Param leaves
+        # _send_tensor_meta handshake has no XLA equivalent by design).
+        # The engine hands loss() the VALUES tree (Param wrappers stripped by
+        # split_params_axes); direct module use may still pass Param leaves.
         unwrap = lambda l: l.value if isinstance(l, Param) else l
         stage_params = [
             self._unpack_stage(
@@ -396,45 +408,75 @@ class PipelineModule:
         tied_dtypes = jax.tree_util.tree_map(lambda a: a.dtype, tied_vals_host)
         buffers = {dt: unwrap(buf) for dt, buf in params["stages"].items()}
 
+        def make_progs():
+            """Per-stage programs with EXPLICIT weight args (so 1F1B can vjp
+            w.r.t. them); GPipe partially applies the loop-invariant ones."""
+            progs = []
+            for s in range(S):
+                def run(local_bufs, tied_vals, h_in, mb_in, mb_tail, rng_t,
+                        s=s):
+                    # mb_in feeds stage 0 (raw input); mb_tail feeds the last
+                    # stage's loss — GPipe passes different microbatches (the
+                    # tick holds two in flight), 1F1B passes the same one
+                    p_list = self._unpack_stage(local_bufs, s)
+                    h = stage_program(
+                        s, p_list, tied_vals,
+                        mb_in[self.input_key] if s == 0 else h_in, rng_t)
+                    if s == S - 1:
+                        # head output may differ from the boundary shape: the
+                        # loss reduces to a scalar inside the branch, and the
+                        # rotating slot gets a dummy
+                        loss = self.loss_fn(h, mb_tail).astype(jnp.float32)
+                        return jnp.zeros(bshape, bdtype), loss
+                    return h.astype(bdtype), jnp.zeros((), jnp.float32)
+
+                progs.append(run)
+            return progs
+
+        return dict(S=S, M=M, mesh=mesh, bshape=bshape, bdtype=bdtype,
+                    batch_ms=batch_ms, batch_dtypes=batch_dtypes,
+                    tied_b=tied_b, tied_dtypes=tied_dtypes, buffers=buffers,
+                    make_progs=make_progs)
+
+    def _index_mb(self, pp, batch_in, m):
+        """Microbatch ``m`` of every batch leaf, original dtypes restored.
+        The stage-0 input is ``tail[self.input_key]`` — no separate gather."""
+        return {k: jax.lax.dynamic_index_in_dim(a, m, 0, False)
+                .astype(pp["batch_dtypes"][k])
+                for k, a in batch_in.items()}
+
+    def _sm_specs(self, pp):
+        buf_specs = {dt: P(PIPE_AXIS, None) for dt in pp["buffers"]}
+        tied_specs = jax.tree_util.tree_map(lambda _: P(), pp["tied_b"])
+        batch_specs = jax.tree_util.tree_map(lambda _: P(), pp["batch_ms"])
+        return buf_specs, tied_specs, batch_specs
+
+    def _pipelined_loss(self, params, batch, rng):
+        cfg = self.config
+        pp = self._pipelined_prep(params, batch, cfg.pipeline_microbatches,
+                                  cfg.mesh)
+        S, M = pp["S"], pp["M"]
         perm = [(i, (i + 1) % S) for i in range(S)]
 
         def pipe_fn(bufs, tied_in, batch_in):
             stage = jax.lax.axis_index(PIPE_AXIS)
             local = {dt: v[0] for dt, v in bufs.items()}
             tied_vals = jax.tree_util.tree_map(
-                lambda a, dt: a.astype(dt), tied_in, tied_dtypes)
-
-            def branch(s):
-                p_list = self._unpack_stage(local, s)
-
-                def run(h_in, raw_mb, tail_mb, rng_t):
-                    h = stage_program(s, p_list, tied_vals,
-                                      raw_mb if s == 0 else h_in, rng_t)
-                    if s == S - 1:
-                        # head output may differ from the boundary shape: the
-                        # loss reduces to a scalar inside the branch, and the
-                        # rotating slot gets a dummy
-                        loss = self.loss_fn(h, tail_mb).astype(jnp.float32)
-                        return jnp.zeros(bshape, bdtype), loss
-                    return h.astype(bdtype), jnp.zeros((), jnp.float32)
-
-                return run
-
-            branches = [branch(s) for s in range(S)]
+                lambda a, dt: a.astype(dt), tied_in, pp["tied_dtypes"])
+            progs = pp["make_progs"]()
+            branches = [
+                lambda h_in, raw_x, tail, rng_t, run=run:
+                run(local, tied_vals, h_in, raw_x, tail, rng_t)
+                for run in progs]
             T = M + S - 1
 
             def tick(carry, t):
                 h_state, losses = carry
                 tm = jnp.clip(t, 0, M - 1)
-                raw_x = jax.lax.dynamic_index_in_dim(
-                    batch_in[self.input_key], tm, 0, False
-                ).astype(batch_dtypes[self.input_key])
                 idx = t - (S - 1)
                 cidx = jnp.clip(idx, 0, M - 1)
-                tail = {
-                    k: jax.lax.dynamic_index_in_dim(a, cidx, 0, False)
-                    .astype(batch_dtypes[k])
-                    for k, a in batch_in.items()}
+                mb_in = self._index_mb(pp, batch_in, tm)
+                mb_tail = self._index_mb(pp, batch_in, cidx)
                 rng_t = None
                 if rng is not None:
                     # the stage's in-flight microbatch id is t - stage:
@@ -442,7 +484,7 @@ class PipelineModule:
                     rng_t = jax.random.fold_in(
                         rng, jnp.clip(t - stage, 0, M - 1))
                 h_out, loss_t = jax.lax.switch(
-                    stage, branches, h_state, raw_x, tail, rng_t)
+                    stage, branches, h_state, mb_in, mb_tail, rng_t)
                 sel = (stage == S - 1) & (idx >= 0)
                 cur = jax.lax.dynamic_index_in_dim(losses, cidx, 0, False)
                 losses = jax.lax.dynamic_update_index_in_dim(
@@ -451,21 +493,184 @@ class PipelineModule:
                 return (h_next, losses), None
 
             (_, losses), _ = jax.lax.scan(
-                tick, (jnp.zeros(bshape, bdtype), jnp.zeros((M,), jnp.float32)),
+                tick, (jnp.zeros(pp["bshape"], pp["bdtype"]),
+                       jnp.zeros((M,), jnp.float32)),
                 jnp.arange(T))
             # only the last stage holds real losses; replicate via psum (f32)
             total = jax.lax.psum(
                 jnp.where(stage == S - 1, jnp.sum(losses), 0.0), PIPE_AXIS)
             return total / M
 
-        buf_specs = {dt: P(PIPE_AXIS, None) for dt in buffers}
-        tied_specs = jax.tree_util.tree_map(lambda _: P(), tied_b)
-        batch_specs = jax.tree_util.tree_map(lambda _: P(), batch_ms)
+        buf_specs, tied_specs, batch_specs = self._sm_specs(pp)
         sm = jax.shard_map(
-            pipe_fn, mesh=mesh,
+            pipe_fn, mesh=pp["mesh"],
             in_specs=(buf_specs, tied_specs, batch_specs),
             out_specs=P(),
             axis_names={PIPE_AXIS},
             check_vma=False,
         )
-        return sm(buffers, tied_b, batch_ms)
+        return sm(pp["buffers"], pp["tied_b"], pp["batch_ms"])
+
+    def build_1f1b_step(self, mesh, n_microbatches):
+        """1F1B schedule over the user layer list (reference
+        ``schedule.py:189 TrainSchedule`` — in-flight activations O(stages),
+        not O(microbatches)); same tick math as
+        ``pipeline_1f1b.build_1f1b_train_step`` but stage programs are the
+        uniform-signature ``lax.switch`` branches, so ONE ``jax.vjp`` over the
+        switch is each stage's backward. Returns ``train_step(params, batch,
+        scale, rng) -> (loss, grads)`` with the engine's fwd_bwd contract
+        (grads carry the fp16 scale, loss is plain)."""
+        M = int(n_microbatches)
+
+        def train_step(params, batch, scale, rng):
+            pp = self._pipelined_prep(params, batch, M, mesh)
+            S = pp["S"]
+            bshape, bdtype = pp["bshape"], pp["bdtype"]
+            fwd_perm = [(i, (i + 1) % S) for i in range(S)]
+            bwd_perm = [(i, (i - 1) % S) for i in range(S)]
+
+            def pipe_fn(bufs, tied_in, batch_in):
+                stage = jax.lax.axis_index(PIPE_AXIS)
+                local = {dt: v[0] for dt, v in bufs.items()}
+                tied_vals = jax.tree_util.tree_map(
+                    lambda a, dt: a.astype(dt), tied_in, pp["tied_dtypes"])
+                progs = pp["make_progs"]()
+
+                def run_switch(lb, tv, h_in, mb, rng_t):
+                    # 1F1B: one microbatch per stage per tick — mb serves as
+                    # both the stage-0 input and the last-stage loss batch
+                    return jax.lax.switch(stage, progs, lb, tv, h_in, mb, mb,
+                                          rng_t)
+
+                def mb_rng(m):
+                    return jax.random.fold_in(rng, m) if rng is not None \
+                        else None
+
+                carry0 = {
+                    "h_recv": jnp.zeros(bshape, bdtype),
+                    "g_recv": jnp.zeros(bshape, jnp.float32),
+                    # ring buffer of S saved stage INPUTS (the only residual;
+                    # the backward tick recomputes the stage under vjp)
+                    "buf_h": jnp.zeros((S,) + bshape, bdtype),
+                    "g_bufs": jax.tree_util.tree_map(
+                        lambda a: jnp.zeros(a.shape, jnp.float32), local),
+                    "g_tied": jax.tree_util.tree_map(
+                        lambda a: jnp.zeros(a.shape, jnp.float32), tied_vals),
+                    "loss": jnp.zeros((), jnp.float32),
+                }
+
+                def tick(carry, t):
+                    # F(s,m) = s + 2m, B(s,m) = 2S-1-s + 2m: opposite parity,
+                    # producers one tick before consumers (pipeline_1f1b.py)
+                    m_f = jnp.clip((t - stage) // 2, 0, M - 1)
+                    do_f = (t >= stage) & ((t - stage) % 2 == 0) \
+                        & ((t - stage) // 2 < M)
+                    boff = 2 * S - 1 - stage
+                    m_b = jnp.clip((t - boff) // 2, 0, M - 1)
+                    do_b = (t >= boff) & ((t - boff) % 2 == 0) \
+                        & ((t - boff) // 2 < M)
+
+                    mb_f = self._index_mb(pp, batch_in, m_f)
+                    h_in = carry["h_recv"]
+
+                    def fwd_case(buf_h):
+                        h_out, _ = run_switch(local, tied_vals, h_in, mb_f,
+                                              mb_rng(m_f))
+                        return h_out, jax.lax.dynamic_update_index_in_dim(
+                            buf_h, h_in, m_f % S, 0)
+
+                    def no_fwd(buf_h):
+                        return jnp.zeros(bshape, bdtype), buf_h
+
+                    h_out, buf_h = jax.lax.cond(
+                        do_f, fwd_case, no_fwd, carry["buf_h"])
+
+                    mb_b = self._index_mb(pp, batch_in, m_b)
+
+                    def bwd_case(ops):
+                        g_bufs, g_tied, loss_acc = ops
+                        h_saved = carry["buf_h"][m_b % S]
+                        (h2, loss_v), f_vjp = jax.vjp(
+                            lambda lb, tv, h: run_switch(
+                                lb, tv, h, mb_b, mb_rng(m_b)),
+                            local, tied_vals, h_saved)
+                        is_last = (stage == S - 1)
+                        # cotangent seeds: mid stages chain the received
+                        # boundary cotangent; the last stage seeds the scalar
+                        # loss with scale/M (grads carry the fp16 scale)
+                        g_h2 = jnp.where(is_last, jnp.zeros(bshape, h2.dtype),
+                                         carry["g_recv"].astype(h2.dtype))
+                        g_ls = jnp.where(is_last,
+                                         (scale / M).astype(jnp.float32), 0.0)
+                        g_lb, g_tv, g_h_in = f_vjp((g_h2, g_ls))
+                        g_bufs = jax.tree_util.tree_map(
+                            lambda a, g: a + g.astype(jnp.float32),
+                            g_bufs, g_lb)
+                        g_tied = jax.tree_util.tree_map(
+                            lambda a, g: a + g.astype(jnp.float32),
+                            g_tied, g_tv)
+                        loss_acc = loss_acc + jnp.where(is_last, loss_v, 0.0)
+                        return (g_bufs, g_tied, loss_acc,
+                                g_h_in.astype(jnp.float32))
+
+                    def no_bwd(ops):
+                        g_bufs, g_tied, loss_acc = ops
+                        return (g_bufs, g_tied, loss_acc,
+                                jnp.zeros(bshape, jnp.float32))
+
+                    g_bufs, g_tied, loss_acc, g_send = jax.lax.cond(
+                        do_b, bwd_case, no_bwd,
+                        (carry["g_bufs"], carry["g_tied"], carry["loss"]))
+
+                    # rotate: activations forward, cotangents backward (the
+                    # two ppermutes run unconditionally — no collectives
+                    # inside the conds)
+                    new_carry = {
+                        "h_recv": jax.lax.ppermute(h_out, PIPE_AXIS, fwd_perm),
+                        "g_recv": jax.lax.ppermute(g_send, PIPE_AXIS, bwd_perm),
+                        "buf_h": buf_h,
+                        "g_bufs": g_bufs, "g_tied": g_tied, "loss": loss_acc,
+                    }
+                    return new_carry, None
+
+                carry, _ = jax.lax.scan(tick, carry0,
+                                        jnp.arange(2 * (M + S - 1)))
+                is_last = (stage == S - 1).astype(jnp.float32)
+                loss = jax.lax.psum(carry["loss"] * is_last, PIPE_AXIS) / M
+                # every stage contributed its own tied-grad partials: the psum
+                # IS the reference's tied-weight allreduce
+                g_tied = jax.tree_util.tree_map(
+                    lambda a: jax.lax.psum(a, PIPE_AXIS), carry["g_tied"])
+                # re-lift the stage dim: out_specs P(pipe, ...) concatenates
+                # each stage's [1, L] row back into the global [S, L] buffer
+                g_bufs = jax.tree_util.tree_map(
+                    lambda a: a[None], carry["g_bufs"])
+                return loss, g_bufs, g_tied
+
+            buf_specs, tied_specs, batch_specs = self._sm_specs(pp)
+            # Gather the weights to exactly their manual-region layout BEFORE
+            # entering the schedule: leftover data-axis (ZeRO-3) sharding
+            # would make the auto partitioner emit its all-gathers inside the
+            # stage-varying lax.cond branches — a rendezvous deadlock at
+            # runtime (same constraint as pipeline_1f1b.py's blocks_in)
+            buffers_in = {
+                dt: jax.lax.with_sharding_constraint(
+                    a, NamedSharding(mesh, P(PIPE_AXIS, None)))
+                for dt, a in pp["buffers"].items()}
+            tied_in = jax.tree_util.tree_map(
+                lambda a: jax.lax.with_sharding_constraint(
+                    a, NamedSharding(mesh, P())), pp["tied_b"])
+            sm = jax.shard_map(
+                pipe_fn, mesh=pp["mesh"],
+                in_specs=(buf_specs, tied_specs, batch_specs),
+                out_specs=(P(), {dt: P(PIPE_AXIS, None) for dt in
+                                 pp["buffers"]}, tied_specs),
+                axis_names={PIPE_AXIS},
+                check_vma=False,
+            )
+            loss, g_bufs, g_tied = sm(buffers_in, tied_in, pp["batch_ms"])
+            # fp32 grads in the params' tree structure (the apply step casts
+            # to fp32 anyway; structure is what the grad shardings care about)
+            return loss, {"stages": g_bufs, "tied": g_tied}
+
+        return train_step

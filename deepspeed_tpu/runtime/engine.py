@@ -494,6 +494,25 @@ class DeepSpeedEngine:
     # ------------------------------------------------------------------------------
     # compiled programs
     # ------------------------------------------------------------------------------
+    def _use_pm_1f1b(self, warn=False):
+        """1F1B for user PipelineModule layer lists (pipe-only meshes; TP/SP
+        widen the manual region in ways the generic switch-vjp schedule does
+        not support — those fall back to the module's GPipe loss)."""
+        from ..parallel.pipeline_module import PipelineModule
+
+        if not (self.pipe_stages > 1
+                and self._config.pipeline.schedule == "1f1b"
+                and isinstance(self.module, PipelineModule)):
+            return False
+        if self.mp_world_size > 1 or self.seq_parallel_size > 1:
+            if warn:
+                logger.warning(
+                    "PipelineModule schedule '1f1b' supports pipe x data "
+                    "meshes only (model=%d seq=%d); falling back to gpipe",
+                    self.mp_world_size, self.seq_parallel_size)
+            return False
+        return True
+
     def _use_1f1b(self, warn=False):
         """Single source of truth for 1F1B eligibility (used by the fwd_bwd
         builder AND the fused-step gate — they must never disagree)."""
@@ -547,8 +566,40 @@ class DeepSpeedEngine:
             self._fwd_bwd_fn = None
             self._eval_fn = None   # eval must see the same compressed net
 
+    def _wrap_1f1b_step(self, raw_step):
+        """Engine-level concerns the manual-vjp schedules don't see:
+        compression (compress once outside the schedule, pull the grads back
+        through its vjp — the fused step's exact pattern) and eval mode
+        (deterministic = no dropout rng, the generic fwd_bwd's trace-time
+        convention; mode flips rebuild the program)."""
+        def step(params, batch, scale, rng):
+            if not self._train_mode:
+                rng = None
+            if self._compression is None:
+                return raw_step(params, batch, scale, rng)
+            cp, pullback = jax.vjp(self._compress, params)
+            loss, grads = raw_step(cp, batch, scale, rng)
+            grads = jax.tree_util.tree_map(
+                lambda g, p: g.astype(p.dtype), grads, cp)
+            (grads,) = pullback(grads)
+            return loss, grads
+
+        return step
+
     def _build_fwd_bwd(self):
         gas = self.gradient_accumulation_steps_
+
+        if self._use_pm_1f1b(warn=True):
+            # 1F1B over a user PipelineModule layer list: the module builds
+            # the schedule (switch-vjp per tick); same fwd_bwd contract
+            step = self._wrap_1f1b_step(self.module.build_1f1b_step(
+                self.mesh, self._pipe_microbatches))
+            with self.mesh:
+                self._fwd_bwd_fn = jax.jit(
+                    step,
+                    out_shardings=(NamedSharding(self.mesh, P()),
+                                   self._grad_shardings))
+            return
 
         if self._use_1f1b(warn=True):
             # 1F1B: the whole microbatch window (fwd AND bwd, interleaved) is one
@@ -556,10 +607,10 @@ class DeepSpeedEngine:
             # microbatches (reference runtime/pipe/schedule.py:189 TrainSchedule).
             from ..parallel.pipeline_1f1b import build_1f1b_train_step
 
-            step = build_1f1b_train_step(
+            step = self._wrap_1f1b_step(build_1f1b_train_step(
                 self.module, self.mesh, self._pipe_microbatches,
                 blocks_param_specs=self.param_specs.get("blocks")
-                if isinstance(self.param_specs, dict) else None)
+                if isinstance(self.param_specs, dict) else None))
             with self.mesh:
                 self._fwd_bwd_fn = jax.jit(
                     step,
@@ -722,8 +773,9 @@ class DeepSpeedEngine:
 
     def _can_fuse_train_step(self):
         """One-dispatch train_batch: anything but the offloaded (host-step) path
-        and the 1F1B schedule (whose fwd+bwd program has its own contract)."""
-        return self._offloaded is None and not self._use_1f1b()
+        and the 1F1B schedules (whose fwd+bwd programs have their own contract)."""
+        return self._offloaded is None and not self._use_1f1b() \
+            and not self._use_pm_1f1b()
 
     def _fused_train_batch(self, micros):
         if self._train_step_fn is None:
