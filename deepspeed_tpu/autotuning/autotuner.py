@@ -111,6 +111,13 @@ class Autotuner:
         self.remats = remats
         self.offloads = offloads
         self.micros = micros
+        # model-based exploration (reference tuner/model_based_tuner.py):
+        # after the top-k measurements, recalibrate the roofline from the
+        # observed runs and measure any candidate the calibrated model says
+        # beats the measured best. calibration_ records the fitted factor.
+        self.model_based = True
+        self.explore_topk = 3
+        self.calibration_ = None
         # experiment ledger (reference autotuning_results/ contract,
         # autotuner.py:404): every candidate's outcome is appended to
         # <results_dir>/ledger.jsonl as it lands, and a re-run resumes from it
@@ -319,14 +326,14 @@ class Autotuner:
                      f"from {self._ledger_path()}", ranks=[0])
 
         engine = None  # drop the last estimation-phase engine before measuring
-        # rank by time per GLOBAL batch: the lowering is one micro step, so a
-        # small-micro/high-gas candidate must pay its accumulation factor
-        live = [r for r in results if r.status in ("estimated", "measured")]
-        live.sort(key=lambda r: r.est_time
-                  * max(r.config.get("gradient_accumulation_steps", 1), 1))
-        for res in live[:measured_topk]:
-            if res.status == "measured":
-                continue   # resumed from the ledger; don't re-measure
+
+        def global_time(r):
+            # time per GLOBAL batch: the lowering is one micro step, so a
+            # small-micro/high-gas candidate must pay its accumulation factor
+            return r.est_time * max(
+                r.config.get("gradient_accumulation_steps", 1), 1)
+
+        def measure(res):
             # drop the previous candidates' executables/buffers first — dozens
             # of live compiled engines on an emulated many-device CPU platform
             # starve the scheduler (observed as spurious collective aborts)
@@ -350,7 +357,41 @@ class Autotuner:
             res.measured_tokens_per_s = tokens / dt
             res.status = "measured"
             self._append_ledger(res)   # updated row; last write wins on resume
-            del engine
+            engine.destroy()
+
+        live = [r for r in results if r.status in ("estimated", "measured")]
+        live.sort(key=global_time)
+        for res in live[:measured_topk]:
+            if res.status != "measured":   # resumed rows don't re-measure
+                measure(res)
+
+        # -- model-based exploration (reference tuner/model_based_tuner.py +
+        # tuner/cost_model.py: fit a cost model over observed runs, use it to
+        # decide what else is worth measuring). Roofline flavor: the observed
+        # measured/predicted ratio recalibrates est_time; any unmeasured
+        # candidate whose RECALIBRATED estimate beats the measured best gets
+        # measured too (bounded by explore_topk) — the prior ranking measured
+        # the wrong k exactly when this set is non-empty.
+        # calibrate ONLY on the deterministic top-k set: folding exploration-
+        # measured rows back in would shift the median on every resumed run,
+        # promoting new candidates each time (non-idempotent resume)
+        measured_now = [r for r in live[:measured_topk]
+                        if r.status == "measured"
+                        and r.measured_tokens_per_s > 0]
+        if self.model_based and measured_now:
+            tokens_g = {id(r): (r.config["train_batch_size"]
+                                * batch["input_ids"].shape[1])
+                        for r in results}
+            ratio, promoted = self._cost_model_promote(
+                live, measured_now, tokens_g, global_time)
+            self.calibration_ = ratio
+            if promoted:
+                log_dist(
+                    f"autotune: cost model (x{ratio:.2f} calibration) "
+                    f"promotes {len(promoted)} candidate(s) past the measured "
+                    f"best; measuring up to {self.explore_topk}", ranks=[0])
+            for res in promoted[:self.explore_topk]:
+                measure(res)
 
         measured = [r for r in results if r.status == "measured"]
         best = max(measured, key=lambda r: r.measured_tokens_per_s) \
@@ -369,6 +410,25 @@ class Autotuner:
                       "w") as f:
                 json.dump(out, f, indent=1)
         return out, results
+
+    @staticmethod
+    def _cost_model_promote(live, measured_now, tokens_g, global_time):
+        """The fitted cost model: median measured/predicted ratio over the
+        observed runs, then the unmeasured candidates it predicts beat the
+        measured best, fastest-predicted first. Pure so it's testable."""
+        ratios = sorted(
+            (tokens_g[id(r)] / r.measured_tokens_per_s) / global_time(r)
+            for r in measured_now if global_time(r) > 0)
+        if not ratios:
+            # cost_analysis gave no flops/bytes (est_time 0): nothing to fit
+            return None, []
+        ratio = ratios[len(ratios) // 2]
+        best_t = min(tokens_g[id(r)] / r.measured_tokens_per_s
+                     for r in measured_now)
+        promoted = [r for r in live if r.status == "estimated"
+                    and global_time(r) * ratio < best_t]
+        promoted.sort(key=global_time)
+        return ratio, promoted
 
     @staticmethod
     def dump(results, path):

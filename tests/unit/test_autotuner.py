@@ -35,6 +35,57 @@ def test_search_space_respects_divisibility():
         assert dp * c["mesh"]["model"] == 8
 
 
+def test_cost_model_promotion():
+    """Reference model_based_tuner semantics: fit measured/predicted on
+    observed runs, promote unmeasured candidates the calibrated model says
+    beat the measured best — exactly the 'measured the wrong k' case."""
+    from deepspeed_tpu.autotuning.autotuner import TuneResult
+
+    def mk(est, tps=-1.0, status="estimated"):
+        r = TuneResult(config={"train_batch_size": 8,
+                               "gradient_accumulation_steps": 1})
+        r.est_time, r.measured_tokens_per_s, r.status = est, tps, status
+        return r
+
+    # two measured runs (the model under-predicted both 10x: ratio = 10);
+    # candidate c was ranked worse than b by raw est, but its calibrated
+    # time (0.2*10 = 2.0) beats the measured best (a: 8*32/100 = 2.56)
+    a = mk(0.3, tps=100.0, status="measured")
+    b = mk(0.4, tps=80.0, status="measured")
+    c = mk(0.2)
+    d = mk(5.0)  # calibrated 50 > best: not promoted
+    tokens_g = {id(r): 8 * 32 for r in (a, b, c, d)}
+    gt = lambda r: r.est_time
+    ratio, promoted = Autotuner._cost_model_promote(
+        [a, b, c, d], [a, b], tokens_g, gt)
+    assert 8.0 < ratio < 11.0
+    assert promoted == [c]
+
+    # single sample on the MIN-est candidate: its calibration reproduces its
+    # own measurement exactly, so nothing with a larger estimate can beat it
+    c2 = mk(0.2, tps=100.0, status="measured")
+    ratio1, promoted1 = Autotuner._cost_model_promote(
+        [c2, mk(0.3), mk(5.0)], [c2], {id(c2): 8 * 32}, gt)
+    assert promoted1 == []
+
+    # degenerate est_time == 0 measured rows must not crash the fit
+    z = mk(0.0, tps=50.0, status="measured")
+    ratio0, promoted0 = Autotuner._cost_model_promote(
+        [z, mk(0.4)], [z], {id(z): 8 * 32}, gt)
+    assert ratio0 is None and promoted0 == []
+
+
+def test_tune_sets_calibration(tmp_path):
+    rng = np.random.RandomState(0)
+    batch = {"input_ids": rng.randint(0, 128, (8, 32)).astype(np.int32)}
+    tuner = Autotuner(_factory(), BASE, device_memory_bytes=2 ** 40,
+                      zero_stages=[0], remats=[None], offloads=[None],
+                      micros=[4, 8])
+    best, results = tuner.tune(batch, measured_topk=2, measure_steps=1)
+    assert tuner.calibration_ is not None and tuner.calibration_ > 0
+    assert any(r.status == "measured" for r in results)
+
+
 def test_search_space_user_constraints():
     """Reference autotuning config scopes the sweep (user-specified stage
     lists etc.); the constructor kwargs are that knob here."""
