@@ -19,6 +19,7 @@ from . import zero  # noqa: F401
 from .accelerator import get_accelerator, set_accelerator  # noqa: F401
 from .config import DeepSpeedConfig, load_config  # noqa: F401
 from .comm.comm import init_distributed  # noqa: F401
+from .utils import OnDevice  # noqa: F401  (reference deepspeed.OnDevice)
 
 
 def initialize(args=None, model=None, optimizer=None, model_parameters=None,

@@ -1,4 +1,5 @@
 from .logging import logger, log_dist
+from .init_on_device import OnDevice
 from .timer import SynchronizedWallClockTimer, ThroughputTimer
 from .tensor_fragment import (
     param_names,
@@ -10,7 +11,8 @@ from .tensor_fragment import (
 )
 
 __all__ = [
-    "logger", "log_dist", "SynchronizedWallClockTimer", "ThroughputTimer",
+    "logger", "log_dist", "OnDevice",
+    "SynchronizedWallClockTimer", "ThroughputTimer",
     "param_names",
     "safe_get_full_fp32_param", "safe_get_full_grad",
     "safe_get_full_optimizer_state", "safe_set_full_fp32_param",

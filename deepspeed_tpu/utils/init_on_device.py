@@ -1,0 +1,65 @@
+"""``OnDevice`` — reference ``deepspeed/utils/init_on_device.py``.
+
+The reference monkey-patches torch tensor factories so ``with
+OnDevice(dtype, device='meta')`` builds a module of meta tensors (shapes
+only) or directly on a target GPU. The JAX design splits construction from
+materialization, so the two roles land differently:
+
+- ``device="meta"``: a documented shim (like ``zero.Init``). Models here are
+  LAZY — ``model.init`` is a function, and ``initialize()`` traces it with
+  ``jax.eval_shape`` and materializes straight into the sharded layout, which
+  is exactly what meta-device init exists to enable. Inside the context,
+  ``OnDevice.eval_shape(fn, *args)`` is provided for explicit shape-only
+  builds.
+- a concrete device: a thin wrapper over ``jax.default_device`` — arrays
+  created inside the context land there.
+
+``dtype``: when given, ``cast(tree)`` casts float leaves (the reference
+patches factories to the dtype; here dtype policy belongs to the model
+config, so the cast is explicit).
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+class OnDevice:
+    def __init__(self, dtype=None, device="meta", enabled=True):
+        self.dtype = dtype
+        self.device = device
+        self.enabled = enabled
+        self._ctx = None
+
+    def __enter__(self):
+        if self.enabled and self.device != "meta":
+            self._ctx = jax.default_device(self.device)
+            self._ctx.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        if self._ctx is not None:
+            self._ctx.__exit__(*exc)
+            self._ctx = None
+        return False
+
+    @staticmethod
+    def eval_shape(fn, *args, **kwargs):
+        """Shape-only build (the meta-device role): returns the pytree of
+        ShapeDtypeStructs ``fn`` would produce, materializing nothing."""
+        return jax.eval_shape(fn, *args, **kwargs)
+
+    def cast(self, tree):
+        """Cast float leaves to the context dtype (no-op without one, and a
+        no-op when the whole context is disabled, like the reference)."""
+        if self.dtype is None or not self.enabled:
+            return tree
+
+        def leaf(a):
+            if not jnp.issubdtype(jnp.result_type(a), jnp.floating):
+                return a
+            if isinstance(a, jax.ShapeDtypeStruct):
+                # meta-role leaves: re-type the abstract value
+                return jax.ShapeDtypeStruct(a.shape, self.dtype)
+            return jnp.asarray(a, self.dtype)  # arrays AND python scalars
+
+        return jax.tree_util.tree_map(leaf, tree)

@@ -224,3 +224,25 @@ def test_zero3_over_pipeline_module(devices8):
     engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=cfg)
     losses = [float(engine.train_batch(batch=_batch(seed=i))) for i in range(2)]
     assert np.isfinite(losses).all()
+
+
+def test_pm_1f1b_fp16_loss_scaling(devices8):
+    """fp16 dynamic loss scaling through the 1F1B schedule: grads carry the
+    scale (the engine's fwd_bwd contract), the apply unscales — fp16 losses
+    must match the GPipe schedule step for step (fp16 rounding drift is a
+    property of the dtype, not the schedule)."""
+    out = {}
+    for sched in ("1f1b", "gpipe"):
+        model = PipelineModule(_layers(), _loss_fn)
+        cfg = _config(gas=2)
+        cfg["mesh"] = {"pipe": 2}
+        cfg["pipeline"] = {"schedule": sched}
+        cfg["fp16"] = {"enabled": True, "initial_scale_power": 8}
+        engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=cfg)
+        if sched == "1f1b":
+            assert engine._use_pm_1f1b()
+        out[sched] = [float(engine.train_batch(batch=_batch(seed=i)))
+                      for i in range(3)]
+        assert np.isfinite(out[sched]).all()
+        assert float(engine.loss_scale) > 1.0  # scaling active, no skip
+    np.testing.assert_allclose(out["1f1b"], out["gpipe"], rtol=1e-4)

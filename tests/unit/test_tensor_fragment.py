@@ -182,3 +182,46 @@ def test_offload_masters_are_served():
     p3 = safe_get_full_fp32_param(e, name)
     # one step from zero moves by ~lr, not back to the pre-edit values
     assert np.abs(p3).max() < 0.1 * max(np.abs(p2).max(), 1e-3) + 1e-2
+
+
+def test_on_device_context():
+    """OnDevice (reference utils/init_on_device.py): meta role = shape-only
+    build; concrete role = placement; dtype role = explicit cast."""
+    import jax
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.utils import OnDevice
+
+    with OnDevice(dtype=jnp.bfloat16, device="meta") as ctx:
+        shapes = ctx.eval_shape(
+            lambda r: {"w": jax.random.normal(r, (4, 4))}, jax.random.PRNGKey(0))
+    assert shapes["w"].shape == (4, 4)
+    assert not hasattr(shapes["w"], "device_buffer")  # nothing materialized
+    casted = ctx.cast({"w": jnp.zeros((2,), jnp.float32),
+                       "i": jnp.zeros((2,), jnp.int32)})
+    assert casted["w"].dtype == jnp.bfloat16
+    assert casted["i"].dtype == jnp.int32
+
+    dev = jax.devices()[1]
+    with OnDevice(device=dev):
+        a = jnp.ones((2, 2))
+    assert list(a.devices()) == [dev]
+
+
+def test_on_device_cast_edge_cases():
+    import jax
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.utils import OnDevice
+
+    ctx = OnDevice(dtype=jnp.bfloat16)
+    # python scalars and abstract (meta) leaves both cast; disabled = no-op
+    out = ctx.cast({"lr": 0.5, "meta": jax.ShapeDtypeStruct((2,), jnp.float32)})
+    assert out["lr"].dtype == jnp.bfloat16
+    assert out["meta"].dtype == jnp.bfloat16 and out["meta"].shape == (2,)
+    off = OnDevice(dtype=jnp.bfloat16, enabled=False)
+    same = off.cast({"w": jnp.zeros((2,), jnp.float32)})
+    assert same["w"].dtype == jnp.float32
+    import deepspeed_tpu
+
+    assert deepspeed_tpu.OnDevice is OnDevice  # top-level like the reference
