@@ -174,6 +174,7 @@ def run_benchmark():
         d_ff=4096,
         compute_dtype=jnp.bfloat16,
         attention_impl=os.environ.get("BENCH_ATTN", "xla"),
+        attention_logits_dtype=os.environ.get("BENCH_ATTN_LOGITS", "fp32"),
         remat=os.environ.get("BENCH_NOREMAT", "") != "1",
         remat_policy=os.environ.get("BENCH_REMAT", "minimal"),
         scan_layers=os.environ.get("BENCH_SCAN", "1") == "1",

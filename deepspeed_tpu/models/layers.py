@@ -263,24 +263,41 @@ def _repeat_kv(x, n_rep):
 
 
 def dot_product_attention(q, k, v, mask=None, scale=None, dropout_rate=0.0,
-                          dropout_rng=None, alibi_bias=None):
+                          dropout_rng=None, alibi_bias=None,
+                          logits_dtype=None):
     """Plain XLA attention: softmax(q k^T / sqrt(d)) v, fp32 softmax.
 
     The reference's fused softmax/dropout kernels (csrc/transformer/softmax_kernels.cu,
     dropout_kernels.cu) are XLA fusions here; the flash/pallas path lives in
     ``ops/flash_attention.py`` and is selected by the model config.
     q,k,v: [batch, seq, heads, head_dim]
+
+    ``logits_dtype=jnp.bfloat16`` materializes the [b,h,q,kv] logits/probs in
+    bf16 (HALF the attention HBM traffic — the profiled single-chip MFU
+    bottleneck at the bench shape) with a max-subtracted exp and an fp32
+    normalization sum, so only the per-element mantissa rounds; default fp32
+    is bit-identical to before.
     """
     head_dim = q.shape[-1]
     scale = scale if scale is not None else 1.0 / math.sqrt(head_dim)
+    ldt = jnp.float32 if logits_dtype is None else jnp.dtype(logits_dtype)
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
-                        preferred_element_type=jnp.float32) * scale
+                        preferred_element_type=ldt) * jnp.asarray(scale, ldt)
     logits = checkpoint_name(logits, "attn_logits")
     if alibi_bias is not None:
-        logits = logits + alibi_bias
+        logits = logits + alibi_bias.astype(ldt)
     if mask is not None:
-        logits = jnp.where(mask, logits, jnp.finfo(jnp.float32).min)
-    probs = jax.nn.softmax(logits, axis=-1)
+        logits = jnp.where(mask, logits, jnp.finfo(ldt).min)
+    if ldt == jnp.float32:
+        probs = jax.nn.softmax(logits, axis=-1)
+    else:
+        # stable low-precision softmax: bf16 exp (keeps the [q,kv] tensor
+        # narrow in HBM); the row max is exact in any dtype (order-stable,
+        # no accumulation) — only the normalization SUM needs fp32
+        m = jnp.max(logits, axis=-1, keepdims=True)
+        e = jnp.exp(logits - m)
+        denom = jnp.sum(e.astype(jnp.float32), axis=-1, keepdims=True)
+        probs = (e.astype(jnp.float32) / denom).astype(ldt)
     probs = checkpoint_name(probs, "attn_probs")
     if dropout_rate > 0.0 and dropout_rng is not None:
         keep = jax.random.bernoulli(dropout_rng, 1.0 - dropout_rate, probs.shape)

@@ -84,6 +84,12 @@ class TransformerConfig:
     remat_policy: str = "nothing_saveable"  # nothing_saveable | dots_with_no_batch_dims
     compute_dtype: typing.Any = jnp.bfloat16
     attention_impl: str = "xla"  # xla | flash (pallas) | block_sparse (pallas)
+    # "bf16": materialize XLA-attention logits/probs in bf16 (fp32
+    # normalization sum) — halves the profiled [b,h,s,s] attention HBM
+    # traffic; opt-in, measured by the bench sweep ("fp32" = exact default).
+    # Applies to attention_impl="xla" only: flash/block_sparse never
+    # materialize the logits, which is their whole point.
+    attention_logits_dtype: str = "fp32"
     # block_sparse settings (reference sparse_attention_utils.py integration
     # role): pattern name + block size + pattern kwargs
     sparse_pattern: str = "fixed"  # dense|fixed|bigbird|bslongformer|variable
@@ -146,6 +152,18 @@ class TransformerConfig:
     # 2201.05596): a dense MLP runs alongside the experts; outputs are blended
     # by a learned 2-way softmax coefficient
     moe_use_residual: bool = False
+
+    def __post_init__(self):
+        # a typo here would silently run the exact fp32 path and let a
+        # "bf16-logits" benchmark report fp32 numbers — normalize and refuse
+        alias = {"bfloat16": "bf16", "float32": "fp32", "f32": "fp32"}
+        self.attention_logits_dtype = alias.get(
+            str(self.attention_logits_dtype).lower(),
+            str(self.attention_logits_dtype).lower())
+        if self.attention_logits_dtype not in ("fp32", "bf16"):
+            raise ValueError(
+                f"attention_logits_dtype must be 'fp32' or 'bf16', got "
+                f"{self.attention_logits_dtype!r}")
 
     @property
     def head_dim(self):
@@ -354,6 +372,8 @@ def block_apply(cfg, p, x, mask=None, rope=None, alibi=None, deterministic=True,
                 q, k, v, mask=dense_mask, scale=cfg.attn_scale,
                 dropout_rate=0.0 if deterministic else cfg.attn_dropout,
                 dropout_rng=drop_rng, alibi_bias=alibi,
+                logits_dtype=jnp.bfloat16
+                if cfg.attention_logits_dtype == "bf16" else None,
             )
         out = checkpoint_name(out, "attn_out")
         return o_proj(out)

@@ -58,8 +58,11 @@ class OnDevice:
             if not jnp.issubdtype(jnp.result_type(a), jnp.floating):
                 return a
             if isinstance(a, jax.ShapeDtypeStruct):
-                # meta-role leaves: re-type the abstract value
-                return jax.ShapeDtypeStruct(a.shape, self.dtype)
+                # meta-role leaves: re-type the abstract value, keeping its
+                # sharding (dropping it would materialize replicated later)
+                return jax.ShapeDtypeStruct(
+                    a.shape, self.dtype,
+                    sharding=getattr(a, "sharding", None))
             return jnp.asarray(a, self.dtype)  # arrays AND python scalars
 
         return jax.tree_util.tree_map(leaf, tree)

@@ -222,3 +222,48 @@ def test_gpt_neo_local_attention_scans():
                     jax.tree_util.tree_leaves(g_unr)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=1e-4, atol=1e-6)
+
+
+def test_bf16_attention_logits_close_to_fp32():
+    """attention_logits_dtype=bf16 (the HBM-halving sweep variant) must stay
+    numerically close to the exact fp32 softmax and TRAIN equivalently."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models import CausalLM, TransformerConfig
+
+    def build(ld):
+        return CausalLM(TransformerConfig(
+            vocab_size=128, max_seq_len=32, n_layers=2, n_heads=2, d_model=32,
+            d_ff=64, compute_dtype=jnp.float32, attention_logits_dtype=ld))
+
+    rng = np.random.RandomState(0)
+    batch = {"input_ids": rng.randint(0, 128, (8, 16)).astype(np.int32)}
+    losses = {}
+    for ld in ("fp32", "bf16"):
+        e, _, _, _ = deepspeed_tpu.initialize(model=build(ld), config={
+            "train_batch_size": 8,
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+            "steps_per_print": 10 ** 9})
+        losses[ld] = [float(e.train_batch(batch=batch)) for _ in range(3)]
+        e.destroy()
+    # bf16 logits round the mantissa, nothing else: a few 1e-3 of CE at most
+    np.testing.assert_allclose(losses["bf16"], losses["fp32"],
+                               rtol=5e-3, atol=5e-3)
+    assert losses["bf16"][-1] < losses["bf16"][0]
+
+
+def test_attention_logits_dtype_validation():
+    import pytest
+
+    from deepspeed_tpu.models import TransformerConfig
+
+    assert TransformerConfig(attention_logits_dtype="bfloat16"
+                             ).attention_logits_dtype == "bf16"
+    assert TransformerConfig(attention_logits_dtype="F32"
+                             ).attention_logits_dtype == "fp32"
+    with pytest.raises(ValueError, match="attention_logits_dtype"):
+        TransformerConfig(attention_logits_dtype="fp16")

@@ -117,6 +117,11 @@ def main():
         # streaming Pallas CE forward: chunk logits never round-trip HBM
         ("ce-pallas-flash-b24", {"fused_ce_impl": "pallas",
                                  "attention_impl": "flash"}, 24),
+        # bf16 attention logits: halves the PROFILED bottleneck ([b,h,s,s]
+        # fp32 HBM traffic) inside the default XLA attention — the direct
+        # structural answer to the r3 profile if flash doesn't win
+        ("bf16-logits-b12", {"attention_logits_dtype": "bf16"}, 12),
+        ("bf16-logits-b24", {"attention_logits_dtype": "bf16"}, 24),
         # bigger micro-batches: VERDICT r2's first hypothesis for the
         # 0.28->0.40 MFU gap (more rows per dispatch amortize bandwidth)
         ("b24", {}, 24),
