@@ -102,8 +102,11 @@ def _attn_with_cache(cfg, p_attn, h, k_cache, v_cache, pos, kv_len, rope=None,
     if cfg.position_embedding == "alibi":
         alibi = _alibi_slice(cfg, q_len, kv_len, pos)
 
-    out = L.dot_product_attention(q, k_full, v_full, mask=mask,
-                                  scale=cfg.attn_scale, alibi_bias=alibi)
+    out = L.dot_product_attention(
+        q, k_full, v_full, mask=mask, scale=cfg.attn_scale, alibi_bias=alibi,
+        # bf16 logits cut prefill TTFT's [b,h,s,s] HBM traffic too; decode
+        # steps ([b,h,1,kv]) are unaffected either way
+        logits_dtype=cfg.attn_logits_jnp_dtype)
     # -1, not d: head-pruned models have attention width n_heads*head_dim < d
     out = L.linear_apply(p_attn["o"], out.reshape(b, q_len, -1))
     return out, k_cache, v_cache

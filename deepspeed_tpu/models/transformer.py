@@ -166,6 +166,12 @@ class TransformerConfig:
                 f"{self.attention_logits_dtype!r}")
 
     @property
+    def attn_logits_jnp_dtype(self):
+        """None (exact fp32) or the low-precision logits dtype — the single
+        switch read by both the training block and the decode path."""
+        return jnp.bfloat16 if self.attention_logits_dtype == "bf16" else None
+
+    @property
     def head_dim(self):
         return self.head_dim_override or self.d_model // self.n_heads
 
@@ -372,8 +378,7 @@ def block_apply(cfg, p, x, mask=None, rope=None, alibi=None, deterministic=True,
                 q, k, v, mask=dense_mask, scale=cfg.attn_scale,
                 dropout_rate=0.0 if deterministic else cfg.attn_dropout,
                 dropout_rng=drop_rng, alibi_bias=alibi,
-                logits_dtype=jnp.bfloat16
-                if cfg.attention_logits_dtype == "bf16" else None,
+                logits_dtype=cfg.attn_logits_jnp_dtype,
             )
         out = checkpoint_name(out, "attn_out")
         return o_proj(out)
