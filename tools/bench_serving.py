@@ -32,7 +32,7 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def build_engine(family, size, mode, max_tokens):
+def build_engine(family, size, mode, max_tokens, **model_kw):
     """Returns (engine, n_params) — n_params counted BEFORE quantization
     (int4 packs two weights per element; the packed tree undercounts)."""
     import jax
@@ -42,7 +42,7 @@ def build_engine(family, size, mode, max_tokens):
     from deepspeed_tpu.models.registry import get_model
 
     # max_seq_len must cover prompt + generation for the KV cache
-    model = get_model(family, size, max_seq_len=max_tokens)
+    model = get_model(family, size, max_seq_len=max_tokens, **model_kw)
     shapes = split_params_axes(jax.eval_shape(model.init, jax.random.PRNGKey(0)))[0]
     n_params = sum(int(np.prod(x.shape)) for x in jax.tree_util.tree_leaves(shapes))
     config = {
@@ -103,33 +103,50 @@ def main():
     max_tokens = ((max(prompts) + args.new_tokens + 1 + 63) // 64) * 64
 
     rng = np.random.RandomState(0)
+    variants = [(size, mode, {}, mode)
+                for size in args.sizes.split(",")
+                for mode in args.modes.split(",")]
+    # prefill_flash crossover (VERDICT r3 #3/#4): on TPU, one extra pass of
+    # the first size in bf16 with the flash prefill forced OFF — the TTFT
+    # delta per prompt bucket IS the crossover table for the serving path.
+    # Skipped for alibi families (bloom): decoding.py never takes the flash
+    # prefill there, so on/off would compare dense vs dense at real chip cost.
+    if platform == "tpu" and "bf16" in args.modes.split(","):
+        from deepspeed_tpu.models.registry import get_model as _gm
+
+        size0 = args.sizes.split(",")[0]
+        cfg0 = _gm(args.family, size0, max_seq_len=64).config
+        if cfg0.position_embedding != "alibi":
+            variants.append((size0, "bf16", {"prefill_flash": False},
+                             "bf16-prefill_flash=off"))
+
     rows = []
-    for size in args.sizes.split(","):
-        for mode in args.modes.split(","):
-            engine, n_params = build_engine(args.family, size, mode, max_tokens)
-            try:
-                for p in prompts:
-                    ttft50, ttft95, dec = bench_one(
-                        engine, p, args.new_tokens, args.batch, args.repeats, rng)
-                    row = {
-                        "model": f"{args.family}-{size}", "mode": mode,
-                        "prompt_len": p, "batch": args.batch,
-                        "new_tokens": args.new_tokens,
-                        "ttft_p50_ms": round(ttft50, 2),
-                        "ttft_p95_ms": round(ttft95, 2),
-                        "decode_tok_s": round(dec, 1),
-                        "n_params_m": round(n_params / 1e6, 1),
-                        "platform": platform,
-                    }
-                    rows.append(row)
-                    print(json.dumps(row), flush=True)
-            finally:
-                # free the engine even on a mid-bench crash (one chip: a later
-                # phase in the same process budgets HBM assuming an empty
-                # device). del alone leaves engine<->jit-closure cycles holding
-                # every device buffer; destroy() is what actually frees HBM.
-                engine.destroy()
-                del engine
+    for size, mode, model_kw, label in variants:
+        engine, n_params = build_engine(args.family, size, mode, max_tokens,
+                                        **model_kw)
+        try:
+            for p in prompts:
+                ttft50, ttft95, dec = bench_one(
+                    engine, p, args.new_tokens, args.batch, args.repeats, rng)
+                row = {
+                    "model": f"{args.family}-{size}", "mode": label,
+                    "prompt_len": p, "batch": args.batch,
+                    "new_tokens": args.new_tokens,
+                    "ttft_p50_ms": round(ttft50, 2),
+                    "ttft_p95_ms": round(ttft95, 2),
+                    "decode_tok_s": round(dec, 1),
+                    "n_params_m": round(n_params / 1e6, 1),
+                    "platform": platform,
+                }
+                rows.append(row)
+                print(json.dumps(row), flush=True)
+        finally:
+            # free the engine even on a mid-bench crash (one chip: a later
+            # phase in the same process budgets HBM assuming an empty
+            # device). del alone leaves engine<->jit-closure cycles holding
+            # every device buffer; destroy() is what actually frees HBM.
+            engine.destroy()
+            del engine
 
     print(f"\n| model | mode | prompt | ttft p50 (ms) | ttft p95 (ms) | decode tok/s |")
     print("|---|---|---|---|---|---|")
