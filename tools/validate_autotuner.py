@@ -50,7 +50,48 @@ def rank_correlation(a, b):
     return float(np.corrcoef(ra, rb)[0, 1])
 
 
+def _rescue_sweep():
+    """2026-08-01 chip-session rescue: the sweep's 14.5 GB HBM budget
+    mis-skipped every b12 row (projected 15.0-16.1 GB — yet base-b12 is the
+    exact config bench.py measured at ~26k tok/s in rounds 1-3, so the
+    memory_analysis projection over-counts vs the true post-buffer-assignment
+    peak), while every b>=24 row was rejected by the TPU compiler itself
+    (RESOURCE_EXHAUSTED surfacing as remote_compile HTTP 500 — TPU buffer
+    assignment is static, so an over-HBM program fails cleanly at compile,
+    never at run). This module is imported lazily at the sweep's tail, so
+    patching the budget here and re-running the b12 subset rides the SAME
+    tunnel claim as the wider session.
+    """
+    if os.environ.get("BENCH_SWEEP_RESCUE", "1") != "1":
+        return
+    prev = {k: os.environ.get(k) for k in ("BENCH_SWEEP", "BENCH_AUTOTUNE")}
+    try:
+        import sweep_bench
+
+        sweep_bench.HBM_BUDGET = float(
+            os.environ.get("BENCH_HBM_BUDGET", "19.0e9"))
+        # b12 + b16: every row whose projection is under the 19 GB
+        # calibration line (b16 at 18.9 GB PASSED TPU compile — static
+        # buffer assignment means a successful compile fits HBM)
+        os.environ["BENCH_SWEEP"] = "b12,b16"
+        os.environ["BENCH_AUTOTUNE"] = "0"  # validation runs right after us
+        print("\n===== sweep rescue (budget 19 GB, b12+b16 rows) =====",
+              flush=True)
+        sweep_bench.main()
+    except Exception:
+        import traceback
+
+        traceback.print_exc()
+    finally:
+        for k, v in prev.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
 def main():
+    _rescue_sweep()
     from _common import maybe_force_cpu
 
     maybe_force_cpu()
