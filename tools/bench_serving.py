@@ -122,12 +122,25 @@ def main():
 
     rows = []
     for size, mode, model_kw, label in variants:
-        engine, n_params = build_engine(args.family, size, mode, max_tokens,
-                                        **model_kw)
+        # fence the whole variant: one failing mode (e.g. a quant path that
+        # has never TPU-compiled) must not cost the other rows of the claim
+        try:
+            engine, n_params = build_engine(args.family, size, mode,
+                                            max_tokens, **model_kw)
+        except Exception as e:
+            print(f"{args.family}-{size}/{label} BUILD FAILED: "
+                  f"{type(e).__name__}: {str(e)[:200]}", flush=True)
+            continue
         try:
             for p in prompts:
-                ttft50, ttft95, dec = bench_one(
-                    engine, p, args.new_tokens, args.batch, args.repeats, rng)
+                try:
+                    ttft50, ttft95, dec = bench_one(
+                        engine, p, args.new_tokens, args.batch, args.repeats,
+                        rng)
+                except Exception as e:
+                    print(f"{args.family}-{size}/{label} p={p} FAILED: "
+                          f"{type(e).__name__}: {str(e)[:200]}", flush=True)
+                    continue
                 row = {
                     "model": f"{args.family}-{size}", "mode": label,
                     "prompt_len": p, "batch": args.batch,
