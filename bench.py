@@ -276,6 +276,14 @@ def main():
         print(json.dumps(_error_record(f"TPU backend probe failed (rc={rc}): {err.strip()}")))
         return 0
 
+    # Claim-handoff settle: the axon tunnel serves one claim, and a new TPU
+    # process starting <~10 s after the previous one exits can wedge it for
+    # hours (observed 2026-07-31; a ~60 s gap worked). The probe child just
+    # released a claim — give the tunnel time to notice before the
+    # measurement child knocks.
+    if os.environ.get("BENCH_FORCE_CPU") != "1":
+        time.sleep(_env_int("BENCH_HANDOFF_DELAY", 45))
+
     rc, out, err = _run_subprocess(
         [sys.executable, os.path.abspath(__file__), "--child"], RUN_TIMEOUT_S
     )
