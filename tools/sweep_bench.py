@@ -25,13 +25,23 @@ import numpy as np
 HBM_BUDGET = float(os.environ.get("BENCH_HBM_BUDGET", "19.0e9"))
 
 
-def compile_step(engine, batch):
+def compile_step(engine, batch, timeout_s=None):
     """AOT-compile the exact fused train-step program (one compile total) and
     return (compiled, projected peak HBM bytes) WITHOUT executing anything —
-    over-budget variants must be skipped by analysis, not by an OOM crash."""
+    over-budget variants must be skipped by analysis, not by an OOM crash.
+
+    The compile runs in a worker thread with a timeout (default
+    BENCH_COMPILE_TIMEOUT=600 s): a hung remote_compile RPC (observed
+    2026-08-01 — remat-dots-b12's compile never returned) must cost one
+    variant, not the whole claim. On timeout the worker thread is leaked;
+    compiles don't hold the execution claim, so a late answer is harmless."""
+    import concurrent.futures
+
     import jax
     import jax.numpy as jnp
 
+    if timeout_s is None:
+        timeout_s = float(os.environ.get("BENCH_COMPILE_TIMEOUT", "600"))
     assert engine.gradient_accumulation_steps_ == 1 \
         and engine._can_fuse_train_step(), \
         "sweep drives the gas==1 fused step; this variant would run a " \
@@ -39,10 +49,19 @@ def compile_step(engine, batch):
     if engine._train_step_fn is None:
         engine._build_train_step()
     sharded = engine._shard_batch(batch)
-    compiled = engine._train_step_fn.lower(
+    lowered = engine._train_step_fn.lower(
         engine.params, engine.optimizer_state, sharded, engine._scale,
         engine._good_steps, engine._rng, jnp.asarray(1e-4, jnp.float32),
-        jnp.asarray(1.0, jnp.float32)).compile()
+        jnp.asarray(1.0, jnp.float32))
+    pool = concurrent.futures.ThreadPoolExecutor(max_workers=1)
+    try:
+        compiled = pool.submit(lowered.compile).result(timeout=timeout_s)
+    except concurrent.futures.TimeoutError:
+        raise TimeoutError(
+            f"compile did not return within {timeout_s:.0f}s "
+            "(hung remote_compile RPC?) — variant abandoned")
+    finally:
+        pool.shutdown(wait=False)
     mem = compiled.memory_analysis()
     # donated params/opt-state alias input->output; without subtracting the
     # alias bytes the projection double-counts ~5 GB and mis-skips exactly
