@@ -168,7 +168,17 @@ def main():
                             "flash_block_kv": 1024, "flash_block_q_bwd": 512,
                             "flash_block_kv_bwd": 1024}, 12),
         ("b8", {}, 8),
+        # noscan won the 2026-08-01 session outright (27,639 tok/s vs ~26k
+        # scanned — unrolled layers let XLA optimize across layer bounds);
+        # combinations with the other winners were missing from that run
         ("noscan-b12", {"scan_layers": False}, 12),
+        ("noscan-bf16-logits-b12", {"scan_layers": False,
+                                    "attention_logits_dtype": "bf16"}, 12),
+        ("noscan-b16", {"scan_layers": False}, 16),
+        ("noscan-bf16-logits-b16", {"scan_layers": False,
+                                    "attention_logits_dtype": "bf16"}, 16),
+        ("noscan-flash-b12", {"scan_layers": False,
+                              "attention_impl": "flash"}, 12),
         ("densece-b12", {"fused_ce": False}, 12),
         ("remat-dots-b12", {"remat_policy": "dots_with_no_batch_dims"}, 12),
         ("noclip-b12", {}, 12),  # gradient_clipping removed below
