@@ -180,7 +180,10 @@ def main():
         ("noscan-flash-b12", {"scan_layers": False,
                               "attention_impl": "flash"}, 12),
         ("densece-b12", {"fused_ce": False}, 12),
-        ("remat-dots-b12", {"remat_policy": "dots_with_no_batch_dims"}, 12),
+        # remat-dots-b12 (dots_with_no_batch_dims) REMOVED: its remote
+        # compile hung for >25 min on 2026-08-01 (every other variant
+        # compiled in <=90 s) and its information value is low — "minimal"
+        # has won every prior measurement
         ("noclip-b12", {}, 12),  # gradient_clipping removed below
         # CE vocab-chunk count: fewer chunks = bigger head GEMMs per pass
         ("ce4-b12", {"fused_ce_chunks": 4}, 12),
