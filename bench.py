@@ -114,6 +114,17 @@ def _maybe_force_cpu():
         import jax
 
         jax.config.update("jax_platforms", "cpu")
+    # persistent compile cache (shared with tools/): an identical program
+    # compiled by an earlier sweep/session is reused — less claim time spent
+    # in remote_compile. Harmless no-op if the plugin can't serialize.
+    try:
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), ".jax_cache"))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
+    except Exception:
+        pass
 
 
 def probe():
