@@ -83,7 +83,8 @@ class TransformerConfig:
     remat: bool = False
     remat_policy: str = "nothing_saveable"  # nothing_saveable | dots_with_no_batch_dims
     compute_dtype: typing.Any = jnp.bfloat16
-    attention_impl: str = "xla"  # xla | flash (pallas) | block_sparse (pallas)
+    attention_impl: str = "xla"  # xla | flash (pallas) | jax_flash (official
+    # jax.experimental TPU kernel) | block_sparse (pallas)
     # "bf16": materialize XLA-attention logits/probs in bf16 (fp32
     # normalization sum) — halves the profiled [b,h,s,s] attention HBM
     # traffic; opt-in, measured by the bench sweep ("fp32" = exact default).
@@ -358,16 +359,22 @@ def block_apply(cfg, p, x, mask=None, rope=None, alibi=None, deterministic=True,
             out = _block_sparse_attn(cfg, s)(q, k, v)
             out = checkpoint_name(out, "attn_out")
             return o_proj(out)
-        flash_ok = cfg.attention_impl == "flash" and kernel_ok
+        flash_ok = cfg.attention_impl in ("flash", "jax_flash") and kernel_ok
         if flash_ok:
-            from ..ops.flash_attention import flash_attention
+            if cfg.attention_impl == "jax_flash":
+                from ..ops.flash_attention import jax_flash_attention
 
-            out = flash_attention(q, k, v, causal=cfg.causal,
-                                  scale=cfg.attn_scale,
-                                  block_q=cfg.flash_block_q,
-                                  block_kv=cfg.flash_block_kv,
-                                  block_q_bwd=cfg.flash_block_q_bwd,
-                                  block_kv_bwd=cfg.flash_block_kv_bwd)
+                out = jax_flash_attention(q, k, v, causal=cfg.causal,
+                                          scale=cfg.attn_scale)
+            else:
+                from ..ops.flash_attention import flash_attention
+
+                out = flash_attention(q, k, v, causal=cfg.causal,
+                                      scale=cfg.attn_scale,
+                                      block_q=cfg.flash_block_q,
+                                      block_kv=cfg.flash_block_kv,
+                                      block_q_bwd=cfg.flash_block_q_bwd,
+                                      block_kv_bwd=cfg.flash_block_kv_bwd)
         else:
             dense_mask = mask if mask is not None else (
                 L.causal_mask(s, s) if cfg.causal else None)
@@ -597,7 +604,7 @@ def stack_apply(cfg, stacked_params, x, mask=None, rope=None, alibi=None,
     # on global layers is what keeps them kernel-eligible there).
     unrolled = not cfg.scan_layers or (
         local_pattern is not None
-        and cfg.attention_impl in ("flash", "block_sparse"))
+        and cfg.attention_impl in ("flash", "jax_flash", "block_sparse"))
     if unrolled:
         for i in range(cfg.n_layers):
             p_i = gather_constraint(

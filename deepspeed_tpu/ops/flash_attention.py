@@ -46,7 +46,7 @@ def flash_attention(q, k, v, causal=True, scale=None, block_size=512,
     XLA keeps the working set in registers/VMEM. ``block_*`` override the
     Pallas kernel's tile sizes (tuning knobs; ignored by the XLA fallback).
     """
-    if jax.default_backend() == "tpu" and q.shape[1] % 128 == 0 and k.shape[1] % 128 == 0:
+    if _tpu_kernel_eligible(q, k):
         from .pallas.flash_attention import pallas_flash_attention
 
         return pallas_flash_attention(q, k, v, causal=causal, scale=scale,
@@ -56,6 +56,44 @@ def flash_attention(q, k, v, causal=True, scale=None, block_size=512,
                                       block_kv_bwd=block_kv_bwd)
     return _chunked_attention(q, k, v, causal=causal, scale=scale,
                               block_size=block_size)
+
+
+def _tpu_kernel_eligible(q, k):
+    """One gate for every Pallas dispatcher (in-repo and official kernels):
+    TPU backend + 128-aligned sequence lengths. Shared so the impls can't
+    drift — a rule change here applies to both."""
+    return (jax.default_backend() == "tpu"
+            and q.shape[1] % 128 == 0 and k.shape[1] % 128 == 0)
+
+
+def jax_flash_attention(q, k, v, causal=True, scale=None):
+    """The official JAX TPU flash kernel behind our [b, s, h, d] signature.
+
+    ``jax.experimental.pallas.ops.tpu.flash_attention`` is the
+    production-tuned Mosaic kernel (fwd + custom-vjp bwd, [b, h, s, d]
+    layout). Exposed as ``attention_impl="jax_flash"`` so the bench can
+    compare it head-to-head with the in-repo kernel and XLA attention —
+    whichever wins becomes the recommended default. Off-TPU (CPU tests)
+    this falls back to the same chunked-XLA path as ``flash_attention``,
+    so parity tests exercise identical semantics.
+
+    Known integration asymmetry: under ``remat`` the in-repo kernel saves
+    its lse residual by checkpoint name ("minimal" policy), so its backward
+    skips the forward recompute; the official kernel's residuals are
+    internal to its custom vjp and get recomputed. Sweep rows measure that
+    real user-facing cost; ``tools/bench_attention.py`` (no remat) is the
+    raw kernel-vs-kernel comparison.
+    """
+    if _tpu_kernel_eligible(q, k):
+        from jax.experimental.pallas.ops.tpu.flash_attention import (
+            flash_attention as _jax_flash)
+
+        sm_scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+        out = _jax_flash(
+            q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+            v.transpose(0, 2, 1, 3), causal=causal, sm_scale=sm_scale)
+        return out.transpose(0, 2, 1, 3)
+    return _chunked_attention(q, k, v, causal=causal, scale=scale)
 
 
 def _chunked_attention(q, k, v, causal=True, scale=None, block_size=512):

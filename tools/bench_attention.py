@@ -40,6 +40,11 @@ def main():
     def flash(q, k, v):
         return flash_attention(q, k, v, causal=True)
 
+    def jaxflash(q, k, v):
+        from deepspeed_tpu.ops.flash_attention import jax_flash_attention
+
+        return jax_flash_attention(q, k, v, causal=True)
+
     # v5e HBM is 16 GB; an on-device OOM can wedge the axon tunnel for hours
     # (PERF.md "Environment caveat") — over-memory variants must be skipped by
     # ANALYSIS, not by crashing (same contract as sweep_bench.compile_step)
@@ -81,7 +86,7 @@ def main():
         flops = 2 * (s * s / 2) * d * 2 * b * h
         if not fwd_only:
             flops *= 4.5
-        impls = [("xla", xla_attn), ("flash", flash)]
+        impls = [("xla", xla_attn), ("flash", flash), ("jaxfl", jaxflash)]
         # BENCH_BLOCKS="128x256,256x512,512x512:256x512": sweep flash kernel
         # block sizes (block_q x block_kv, optional ":bq_bwd x bkv_bwd") —
         # the tuning knob VERDICT r2 flagged. TPU-only: the CPU fallback path

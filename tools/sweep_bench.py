@@ -179,6 +179,10 @@ def main():
                                     "attention_logits_dtype": "bf16"}, 16),
         ("noscan-flash-b12", {"scan_layers": False,
                               "attention_impl": "flash"}, 12),
+        # the official jax.experimental TPU flash kernel, vs ours and vs XLA
+        ("jaxflash-b12", {"attention_impl": "jax_flash"}, 12),
+        ("noscan-jaxflash-b12", {"scan_layers": False,
+                                 "attention_impl": "jax_flash"}, 12),
         ("densece-b12", {"fused_ce": False}, 12),
         # remat-dots-b12 (dots_with_no_batch_dims) REMOVED: its remote
         # compile hung for >25 min on 2026-08-01 (every other variant
