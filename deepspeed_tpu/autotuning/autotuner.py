@@ -228,8 +228,14 @@ class Autotuner:
 
     def _estimate(self, compiled):
         mem = compiled.memory_analysis()
+        # subtract donation-aliased bytes: without this the projection
+        # double-counts donated buffers and the prune discards exactly the
+        # large-micro candidates the tuner exists to find (calibrated on-chip
+        # 2026-08-01: projected 18.9 GB passed the real TPU compile on a
+        # 16 GB part — see tools/sweep_bench.py HBM_BUDGET)
         peak = (mem.temp_size_in_bytes + mem.argument_size_in_bytes +
-                mem.output_size_in_bytes)
+                mem.output_size_in_bytes
+                - getattr(mem, "alias_size_in_bytes", 0))
         cost = compiled.cost_analysis() or {}
         flops = cost.get("flops", 0.0)
         bytes_ = cost.get("bytes accessed", 0.0)
@@ -283,10 +289,13 @@ class Autotuner:
             res = TuneResult(config=cfg, env=env)
             results.append(res)
             prev = ledger.get(res.key())
-            if prev and prev["status"] not in ("pending", "compile-failed"):
-                # resume: skip re-exploring. compile-failed entries are NOT
-                # replayed — the failure may have been a since-fixed bug, and
-                # retrying a failed lowering is cheap
+            if prev and prev["status"] not in (
+                    "pending", "compile-failed", "measure-failed"):
+                # resume: skip re-exploring. compile-failed and
+                # measure-failed entries ARE replayed — the failure may have
+                # been a since-fixed bug or a transient abort (the emulated
+                # platform's spurious collective aborts), and retrying is
+                # cheap relative to permanently blacklisting a candidate
                 res.restore(prev)
                 n_resumed += 1
                 continue
@@ -315,7 +324,12 @@ class Autotuner:
             # differ where it matters (peak memory, per-step time)
             res.peak_bytes = fwd_peak + self._opt_state_bytes(n_params, cfg)
             res.est_time = fwd_est + self._offload_penalty(n_params, cfg)
-            if res.peak_bytes > self.device_memory:
+            # 1.15 margin over device memory: even after the alias
+            # subtraction the analysis over-counts vs true buffer assignment
+            # (on-chip calibration 2026-08-01). On TPU a genuinely-over
+            # candidate still fails its measure-time compile cleanly (static
+            # buffer assignment) and is recorded as measure-failed.
+            if res.peak_bytes > self.device_memory * 1.15:
                 res.status = "pruned-oom"
                 self._append_ledger(res)
                 continue
@@ -359,11 +373,31 @@ class Autotuner:
             self._append_ledger(res)   # updated row; last write wins on resume
             engine.destroy()
 
+        def measure_safe(res):
+            """True iff the candidate measured. A candidate that slipped the
+            (margin-loosened) prune and fails its measure-time compile must
+            cost one row, not the whole tune."""
+            try:
+                measure(res)
+                return True
+            except Exception as e:
+                res.status = "measure-failed"
+                logger.debug(f"autotune measure failed: {res.config}: {e}")
+                self._append_ledger(res)
+                return False
+
         live = [r for r in results if r.status in ("estimated", "measured")]
         live.sort(key=global_time)
-        for res in live[:measured_topk]:
-            if res.status != "measured":   # resumed rows don't re-measure
-                measure(res)
+        # walk the ranking until measured_topk candidates actually measured —
+        # a measure failure must not burn one of the k slots, or a few
+        # over-margin candidates at the top could leave the cost model fitting
+        # on one point (or none)
+        n_measured = 0
+        for res in live:
+            if n_measured >= measured_topk:
+                break
+            if res.status == "measured" or measure_safe(res):
+                n_measured += 1
 
         # -- model-based exploration (reference tuner/model_based_tuner.py +
         # tuner/cost_model.py: fit a cost model over observed runs, use it to
@@ -375,9 +409,9 @@ class Autotuner:
         # calibrate ONLY on the deterministic top-k set: folding exploration-
         # measured rows back in would shift the median on every resumed run,
         # promoting new candidates each time (non-idempotent resume)
-        measured_now = [r for r in live[:measured_topk]
+        measured_now = [r for r in live
                         if r.status == "measured"
-                        and r.measured_tokens_per_s > 0]
+                        and r.measured_tokens_per_s > 0][:measured_topk]
         if self.model_based and measured_now:
             tokens_g = {id(r): (r.config["train_batch_size"]
                                 * batch["input_ids"].shape[1])
@@ -391,11 +425,15 @@ class Autotuner:
                     f"promotes {len(promoted)} candidate(s) past the measured "
                     f"best; measuring up to {self.explore_topk}", ranks=[0])
             for res in promoted[:self.explore_topk]:
-                measure(res)
+                measure_safe(res)
 
         measured = [r for r in results if r.status == "measured"]
+        # never fall back to a candidate whose measure just failed (its
+        # status mutated out of "estimated"): emitting it as best_config
+        # would hand the user a config that already OOMed once
+        viable = [r for r in live if r.status in ("estimated", "measured")]
         best = max(measured, key=lambda r: r.measured_tokens_per_s) \
-            if measured else (live[0] if live else None)
+            if measured else (viable[0] if viable else None)
         if best is None:
             raise RuntimeError("autotune: no viable candidate")
         log_dist(f"autotune: best {best.row()}", ranks=[0])

