@@ -176,6 +176,32 @@ def run_benchmark():
         flash_blocks = {"flash_block_q": bq, "flash_block_kv": bkv,
                         "flash_block_q_bwd": bqb, "flash_block_kv_bwd": bkvb}
 
+    # sweep-chosen defaults (tools/sweep_bench.py writes the measured winner
+    # to bench_defaults.json); explicit env vars still override
+    tuned = {}
+    tuned_batch = None
+    defaults_path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "bench_defaults.json")
+    if os.path.isfile(defaults_path):
+        try:
+            with open(defaults_path) as f:
+                rec = json.load(f)
+            tuned = dict(rec.get("model_overrides", {}))
+            tuned_batch = rec.get("batch")
+            print(f"# bench_defaults.json: {rec.get('variant')} "
+                  f"({rec.get('tokens_per_s')} tok/s measured)",
+                  file=sys.stderr)
+        except (ValueError, OSError) as e:
+            print(f"# bench_defaults.json ignored: {e}", file=sys.stderr)
+
+    def opt(env_name, key, default, parse=str):
+        """Priority: explicit env var > sweep-tuned default > built-in."""
+        if os.environ.get(env_name):
+            return parse(os.environ[env_name])
+        if key in tuned:
+            return tuned[key]
+        return parse(default)
+
     cfg = TransformerConfig(
         vocab_size=50304,  # padded to a multiple of 128 for MXU-friendly head matmul
         max_seq_len=1024,
@@ -184,17 +210,25 @@ def run_benchmark():
         d_model=1024,
         d_ff=4096,
         compute_dtype=jnp.bfloat16,
-        attention_impl=os.environ.get("BENCH_ATTN", "xla"),
-        attention_logits_dtype=os.environ.get("BENCH_ATTN_LOGITS", "fp32"),
+        attention_impl=opt("BENCH_ATTN", "attention_impl", "xla"),
+        attention_logits_dtype=opt(
+            "BENCH_ATTN_LOGITS", "attention_logits_dtype", "fp32"),
         remat=os.environ.get("BENCH_NOREMAT", "") != "1",
-        remat_policy=os.environ.get("BENCH_REMAT", "minimal"),
-        scan_layers=os.environ.get("BENCH_SCAN", "1") == "1",
-        fused_ce=os.environ.get("BENCH_FUSED_CE", "1") == "1",
-        **flash_blocks,
+        remat_policy=opt("BENCH_REMAT", "remat_policy", "minimal"),
+        scan_layers=bool(opt("BENCH_SCAN", "scan_layers", "1",
+                             lambda v: v == "1")),
+        fused_ce=bool(opt("BENCH_FUSED_CE", "fused_ce", "1",
+                          lambda v: v == "1")),
+        **{k: v for k, v in tuned.items()
+           if k in ("fused_ce_impl", "fused_ce_chunks", "flash_block_q",
+                    "flash_block_kv", "flash_block_q_bwd",
+                    "flash_block_kv_bwd") and k not in flash_blocks},
+        **flash_blocks,  # explicit BENCH_FLASH_BLOCKS beats tuned tiles
     )
     model = CausalLM(cfg)
 
-    batch_size = int(os.environ.get("BENCH_BATCH", "12")) * n_chips
+    batch_size = int(os.environ.get("BENCH_BATCH", "")
+                     or tuned_batch or 12) * n_chips
     seq_len = int(os.environ.get("BENCH_SEQ", "1024"))
     config = {
         "train_batch_size": batch_size,
