@@ -179,6 +179,7 @@ def run_benchmark():
     # sweep-chosen defaults (tools/sweep_bench.py writes the measured winner
     # to bench_defaults.json); explicit env vars still override
     tuned = {}
+    tuned_cfg = {}
     tuned_batch = None
     defaults_path = os.path.join(
         os.path.dirname(os.path.abspath(__file__)), "bench_defaults.json")
@@ -187,6 +188,7 @@ def run_benchmark():
             with open(defaults_path) as f:
                 rec = json.load(f)
             tuned = dict(rec.get("model_overrides", {}))
+            tuned_cfg = dict(rec.get("config_overrides", {}))
             tuned_batch = rec.get("batch")
             print(f"# bench_defaults.json: {rec.get('variant')} "
                   f"({rec.get('tokens_per_s')} tok/s measured)",
@@ -201,6 +203,23 @@ def run_benchmark():
         if key in tuned:
             return tuned[key]
         return parse(default)
+
+    # tuned keys handled by an opt()/env path above must not pass through
+    # twice; everything else flows generically so a future sweep variant's
+    # winning override is fully applied (dropped keys would silently bench a
+    # config that was never the measured winner)
+    OPT_HANDLED = {"attention_impl", "attention_logits_dtype", "remat_policy",
+                   "scan_layers", "fused_ce"}
+    import dataclasses as _dc
+
+    cfg_fields = {f.name for f in _dc.fields(TransformerConfig)}
+    passthrough = {k: v for k, v in tuned.items()
+                   if k not in OPT_HANDLED and k not in flash_blocks
+                   and k in cfg_fields}
+    dropped = set(tuned) - OPT_HANDLED - set(flash_blocks) - set(passthrough)
+    if dropped:
+        print(f"# bench_defaults.json keys not applicable, ignored: "
+              f"{sorted(dropped)}", file=sys.stderr)
 
     cfg = TransformerConfig(
         vocab_size=50304,  # padded to a multiple of 128 for MXU-friendly head matmul
@@ -219,10 +238,7 @@ def run_benchmark():
                              lambda v: v == "1")),
         fused_ce=bool(opt("BENCH_FUSED_CE", "fused_ce", "1",
                           lambda v: v == "1")),
-        **{k: v for k, v in tuned.items()
-           if k in ("fused_ce_impl", "fused_ce_chunks", "flash_block_q",
-                    "flash_block_kv", "flash_block_q_bwd",
-                    "flash_block_kv_bwd") and k not in flash_blocks},
+        **passthrough,
         **flash_blocks,  # explicit BENCH_FLASH_BLOCKS beats tuned tiles
     )
     model = CausalLM(cfg)
@@ -237,6 +253,7 @@ def run_benchmark():
         "zero_optimization": {"stage": 1 if n_chips > 1 else 0},
         "gradient_clipping": 1.0,
         "steps_per_print": 1000000,
+        **tuned_cfg,  # sweep-measured engine-config deltas (e.g. noclip)
     }
     engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=config)
 
