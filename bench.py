@@ -210,13 +210,18 @@ def run_benchmark():
     # config that was never the measured winner)
     OPT_HANDLED = {"attention_impl", "attention_logits_dtype", "remat_policy",
                    "scan_layers", "fused_ce"}
+    # every kwarg the TransformerConfig(...) call below passes explicitly —
+    # a tuned key colliding with one of these would raise "multiple values
+    # for keyword argument" and crash the headline bench
+    EXPLICIT = {"vocab_size", "max_seq_len", "n_layers", "n_heads",
+                "d_model", "d_ff", "compute_dtype", "remat"} | OPT_HANDLED
     import dataclasses as _dc
 
     cfg_fields = {f.name for f in _dc.fields(TransformerConfig)}
     passthrough = {k: v for k, v in tuned.items()
-                   if k not in OPT_HANDLED and k not in flash_blocks
+                   if k not in EXPLICIT and k not in flash_blocks
                    and k in cfg_fields}
-    dropped = set(tuned) - OPT_HANDLED - set(flash_blocks) - set(passthrough)
+    dropped = set(tuned) - EXPLICIT - set(flash_blocks) - set(passthrough)
     if dropped:
         print(f"# bench_defaults.json keys not applicable, ignored: "
               f"{sorted(dropped)}", file=sys.stderr)

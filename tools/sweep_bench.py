@@ -205,9 +205,12 @@ def main():
     engine = model = None
     for name, m_over, b in variants:
         try:
-            cfg = dict(base_cfg, train_batch_size=b)
-            if name.startswith("noclip"):
-                cfg["gradient_clipping"] = 0.0
+            # ONE computation of the engine-config delta, shared by the run
+            # and the persisted winner record — substring match so compound
+            # variants ("noscan-noclip-b12") can't run with clipping while
+            # their name claims otherwise
+            cfg_over = {"gradient_clipping": 0.0} if "noclip" in name else {}
+            cfg = dict(base_cfg, train_batch_size=b, **cfg_over)
             model = CausalLM(TransformerConfig(**{**base_model, **m_over}))
             engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=cfg)
             batch = {"input_ids": rng.randint(
@@ -225,9 +228,7 @@ def main():
                     # engine-config deltas travel too (noclip lives in cfg,
                     # not the model) — otherwise the persisted "winner" is
                     # unreproducible by bench.py
-                    cfg_over = {"gradient_clipping": 0.0} \
-                        if name.startswith("noclip") else {}
-                    best_spec = (dict(m_over), b, cfg_over)
+                    best_spec = (dict(m_over), b, dict(cfg_over))
         except Exception as e:
             print(f"{name:<16} FAILED: {type(e).__name__}: {str(e)[:300]}",
                   flush=True)
