@@ -18,6 +18,16 @@ import traceback
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
+# BENCH_SESSION_DEADLINE (unix epoch seconds): stop knocking / starting new
+# phases past this time. Exists so a late tunnel recovery can't put this
+# session in a claim fight with the driver's own end-of-round bench.py run —
+# the 2026-08-01 outage showed a recovery can land at any hour.
+DEADLINE = float(os.environ.get("BENCH_SESSION_DEADLINE", "0") or 0)
+
+
+def past_deadline():
+    return DEADLINE > 0 and time.time() > DEADLINE
+
 
 def run_phase(name, fn):
     print(f"\n===== phase: {name} =====", flush=True)
@@ -77,6 +87,11 @@ def _connect():
 
     attempt = 0
     while True:
+        if past_deadline():
+            print("session deadline passed before a connect landed — "
+                  "exiting so the claim is free for the driver's bench run",
+                  flush=True)
+            sys.exit(0)
         attempt += 1
         t0 = time.time()
         try:
@@ -111,6 +126,10 @@ def main():
              "serving": _serving}
     for p in phases:
         p = p.strip()
+        if past_deadline():
+            print(f"session deadline passed — skipping remaining phases "
+                  f"(next: {p})", flush=True)
+            break
         if p in table:
             run_phase(p, table[p])
         else:
