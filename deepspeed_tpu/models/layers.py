@@ -293,11 +293,16 @@ def dot_product_attention(q, k, v, mask=None, scale=None, dropout_rate=0.0,
     else:
         # stable low-precision softmax: bf16 exp (keeps the [q,kv] tensor
         # narrow in HBM); the row max is exact in any dtype (order-stable,
-        # no accumulation) — only the normalization SUM needs fp32
+        # no accumulation) — only the normalization SUM needs fp32. The
+        # normalization multiplies by the fp32-accumulated reciprocal ROUNDED
+        # to ldt, so no full-size fp32 [b,h,q,kv] intermediate exists even
+        # inside fusions (pinned by test_bf16_attention_logits_hlo_buffer_
+        # dtype); the reciprocal's rounding error (~2^-8 relative) is below
+        # the bf16 output rounding already accepted on every element
         m = jnp.max(logits, axis=-1, keepdims=True)
         e = jnp.exp(logits - m)
         denom = jnp.sum(e.astype(jnp.float32), axis=-1, keepdims=True)
-        probs = (e.astype(jnp.float32) / denom).astype(ldt)
+        probs = e * (1.0 / denom).astype(ldt)
     probs = checkpoint_name(probs, "attn_probs")
     if dropout_rate > 0.0 and dropout_rng is not None:
         keep = jax.random.bernoulli(dropout_rng, 1.0 - dropout_rate, probs.shape)

@@ -308,3 +308,49 @@ def test_local_attention_jax_flash_takes_unrolled_path():
         jax.make_jaxpr(lambda p: model.loss(p, batch))(params)
     assert spy.call_count > 0, \
         "jax_flash never dispatched — scanned path swallowed the kernel"
+
+
+def test_bf16_attention_logits_hlo_buffer_dtype():
+    """The HBM-halving claim is structural: with attention_logits_dtype=bf16
+    the compiled program's [b, h, q, kv] score tensors must be bf16 buffers,
+    not fp32 (the numerics test alone can't tell — an implementation that
+    upcast everything would still be 'close')."""
+    import jax
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.models import CausalLM, TransformerConfig
+    from deepspeed_tpu.models.layers import split_params_axes
+
+    b, h, s = 2, 4, 128
+
+    def stablehlo_for(ld):
+        # PRE-backend text: the CPU backend upcasts bf16 dots to f32
+        # internally (no native bf16 ALU), so only the platform-independent
+        # program proves what the TPU backend will be asked to materialize
+        model = CausalLM(TransformerConfig(
+            vocab_size=128, max_seq_len=s, n_layers=1, n_heads=h, d_model=64,
+            d_ff=128, compute_dtype=jnp.bfloat16, attention_logits_dtype=ld,
+            scan_layers=False, remat=False))
+        params, _ = split_params_axes(model.init(jax.random.PRNGKey(0)))
+        ids = jnp.zeros((b, s), jnp.int32)
+        return jax.jit(model.loss).lower(
+            params, {"input_ids": ids}).as_text()
+
+    score = f"tensor<{b}x{h}x{s}x{s}x"
+    sh_bf16 = stablehlo_for("bf16")
+    sh_fp32 = stablehlo_for("fp32")
+    n_f32_in_fp32_mode = sh_fp32.count(score + "f32>")
+    n_f32_in_bf16_mode = sh_bf16.count(score + "f32>")
+    assert n_f32_in_fp32_mode >= 4, \
+        "fp32 baseline lost its f32 score tensors — test premise broken"
+    assert score + "bf16>" in sh_bf16, \
+        "bf16 logits mode emitted no bf16 [b,h,q,kv] tensor"
+    # ONE full-size f32 use is inherent: the convert feeding the
+    # fp32-accumulated normalization sum, which XLA fuses into the reduce
+    # (that is how accumulate-in-fp32 is expressed in StableHLO — it never
+    # materializes). Anything beyond it means the logits/probs themselves
+    # went back to fp32.
+    assert n_f32_in_bf16_mode <= 2, (
+        f"bf16 logits mode emits {n_f32_in_bf16_mode} full fp32 [b,h,q,kv] "
+        f"tensors (expected <=2: the reduce's convert operand); the "
+        f"score/probs tensors leaked back to fp32")
