@@ -99,6 +99,26 @@ def mistral_config(size="7b", **overrides):
     return TransformerConfig(**base)
 
 
+def qwen2_config(size="7b", **overrides):
+    """LLaMA-shaped with GQA and attention bias on q/k/v only (o and the MLP
+    stay unbiased) — mirrors module_inject/hf.py's qwen2 mapping so a
+    from-scratch model and an imported checkpoint share one architecture."""
+    presets = {
+        "tiny": dict(n_layers=2, d_model=128, n_heads=4, n_kv_heads=2,
+                     d_ff=352, max_seq_len=256, vocab_size=1024),
+        "7b": dict(n_layers=28, d_model=3584, n_heads=28, n_kv_heads=4,
+                   d_ff=18944, max_seq_len=32768, vocab_size=152064),
+    }
+    base = dict(
+        vocab_size=151936, activation="swiglu", norm="rmsnorm",
+        position_embedding="rope", rope_base=1000000.0, tie_embeddings=False,
+        use_bias=True, mlp_bias=False, prenorm=True, layernorm_eps=1e-6,
+    )
+    base.update(presets[size])
+    base.update(overrides)
+    return TransformerConfig(**base)
+
+
 def gptj_config(size="6b", **overrides):
     """Parallel attn+mlp, shared LN, partial rotary, biased untied head."""
     presets = {
@@ -227,6 +247,7 @@ MODEL_CONFIGS = {
     "bloom": bloom_config,
     "llama": llama_config,
     "mistral": mistral_config,
+    "qwen2": qwen2_config,
     "gptj": gptj_config,
     "gpt_neox": neox_config,
     "gpt_neo": gpt_neo_config,

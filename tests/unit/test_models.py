@@ -185,6 +185,8 @@ def test_registry_new_family_presets_forward():
         ("falcon", lambda c: c.n_kv_heads == 1 and c.parallel_attn_mlp),
         ("gpt_neo", lambda c: c.local_attention_window == 64
          and c.attn_scale == 1.0),
+        ("qwen2", lambda c: c.n_kv_heads == 2 and c.use_bias
+         and not c.mlp_bias and c.activation == "swiglu"),
     ]:
         m = get_model(fam, "tiny", compute_dtype=jnp.float32)
         assert check(m.config), fam
