@@ -62,7 +62,10 @@ def _rescue_sweep():
     patching the budget here and re-running the b12 subset rides the SAME
     tunnel claim as the wider session.
     """
-    if os.environ.get("BENCH_SWEEP_RESCUE", "1") != "1":
+    # Default OFF since the 2026-08-01 sweep-list recalibration: the main
+    # sweep now covers every rescue row, so a fresh session would only
+    # duplicate work. BENCH_SWEEP_RESCUE=1 re-arms it.
+    if os.environ.get("BENCH_SWEEP_RESCUE", "0") != "1":
         return
     prev = {k: os.environ.get(k) for k in ("BENCH_SWEEP", "BENCH_AUTOTUNE")}
     try:
