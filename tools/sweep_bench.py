@@ -179,6 +179,13 @@ def main():
                                     "attention_logits_dtype": "bf16"}, 16),
         ("noscan-flash-b12", {"scan_layers": False,
                               "attention_impl": "flash"}, 12),
+        # noscan x lean-remat opens b24 without the scan boundary; with bf16
+        # logits on top this is the full compound of every measured/landed win
+        ("noscan-b24-nomlp", {"scan_layers": False,
+                              "remat_policy": "minimal_nomlp"}, 24),
+        ("noscan-bf16-b24-nomlp", {"scan_layers": False,
+                                   "attention_logits_dtype": "bf16",
+                                   "remat_policy": "minimal_nomlp"}, 24),
         # the official jax.experimental TPU flash kernel, vs ours and vs XLA
         ("jaxflash-b12", {"attention_impl": "jax_flash"}, 12),
         ("noscan-jaxflash-b12", {"scan_layers": False,
