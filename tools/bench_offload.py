@@ -29,20 +29,31 @@ def run(config_extra, model, batch, steps=6):
     }
     config.update(config_extra)
     engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=config)
-    engine.train_batch(batch=batch)  # compile + warm
-    leaf = jax.tree_util.tree_leaves(engine.params)[0]
-    np.asarray(jax.device_get(leaf.ravel()[0]))
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        engine.train_batch(batch=batch)
-    leaf = jax.tree_util.tree_leaves(engine.params)[0]
-    np.asarray(jax.device_get(leaf.ravel()[0]))
-    dt = (time.perf_counter() - t0) / steps
-    tokens = batch["input_ids"].size
-    return tokens / dt
+    try:
+        engine.train_batch(batch=batch)  # compile + warm
+        leaf = jax.tree_util.tree_leaves(engine.params)[0]
+        np.asarray(jax.device_get(leaf.ravel()[0]))
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            engine.train_batch(batch=batch)
+        leaf = jax.tree_util.tree_leaves(engine.params)[0]
+        np.asarray(jax.device_get(leaf.ravel()[0]))
+        dt = (time.perf_counter() - t0) / steps
+        tokens = batch["input_ids"].size
+        return tokens / dt
+    finally:
+        # free HBM before the next engine: del alone leaves engine<->jit
+        # closure gc cycles pinning every device buffer, and ~5 GB of pinned
+        # optimizer state would fail the second engine's compile on a 16 GB
+        # chip
+        engine.destroy()
 
 
 def main():
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from _common import maybe_force_cpu
+
+    maybe_force_cpu()
     import jax.numpy as jnp
 
     sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -62,6 +62,12 @@ def _attn():
     bench_attention.main()
 
 
+def _offload():
+    import bench_offload
+
+    bench_offload.main()
+
+
 def _serving():
     import bench_serving
 
@@ -118,11 +124,12 @@ def _connect():
 
 def main():
     phases = os.environ.get(
-        "BENCH_PHASES", "sweep,profile,attn,serving").split(",")
+        "BENCH_PHASES", "sweep,profile,attn,serving,offload").split(",")
     _connect()
     # imports stay inside the phase fences: a broken unselected module must
     # not cost the whole claim
     table = {"sweep": _sweep, "profile": _profile, "attn": _attn,
+             "offload": _offload,
              "serving": _serving}
     for p in phases:
         p = p.strip()
