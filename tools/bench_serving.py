@@ -166,6 +166,27 @@ def main():
     for r in rows:
         print(f"| {r['model']} | {r['mode']} | {r['prompt_len']} "
               f"| {r['ttft_p50_ms']} | {r['ttft_p95_ms']} | {r['decode_tok_s']} |")
+
+    # Offload-tax chaining (2026-08-01): the chip session running when the
+    # offload phase landed imports this module lazily at serving time, so
+    # chaining here lets THAT claim still measure the never-measured
+    # ZeRO-Offload tax. bloom is the session's final bench_serving call;
+    # fresh sessions run the real "offload" phase and set
+    # BENCH_CHAIN_OFFLOAD=0 to avoid duplicating it.
+    if (os.environ.get("BENCH_CHAIN_OFFLOAD", "1") == "1"
+            and platform == "tpu" and args.family == "bloom"):
+        try:
+            import bench_offload
+
+            print("\n===== offload tax (chained from serving) =====",
+                  flush=True)
+            bench_offload.main()
+        except Exception as e:
+            import traceback
+
+            traceback.print_exc()
+            print(f"chained offload bench FAILED: {type(e).__name__}: "
+                  f"{str(e)[:200]}", flush=True)
     return 0
 
 

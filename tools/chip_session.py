@@ -125,6 +125,9 @@ def _connect():
 def main():
     phases = os.environ.get(
         "BENCH_PHASES", "sweep,profile,attn,serving,offload").split(",")
+    if "offload" in phases:
+        # the real phase supersedes bench_serving's offload-tax chaining
+        os.environ.setdefault("BENCH_CHAIN_OFFLOAD", "0")
     _connect()
     # imports stay inside the phase fences: a broken unselected module must
     # not cost the whole claim
