@@ -310,9 +310,21 @@ class Autotuner:
                     fwd_peak, fwd_est, n_params = est_cache[est_key]
                 else:
                     engine = self._build_engine(cfg)
-                    compiled, _, _ = self._lower_step(engine, batch)
-                    fwd_peak, fwd_est = self._estimate(compiled)
-                    n_params = engine.num_parameters
+                    try:
+                        compiled, _, _ = self._lower_step(engine, batch)
+                        fwd_peak, fwd_est = self._estimate(compiled)
+                        n_params = engine.num_parameters
+                    finally:
+                        # free the candidate's device state NOW: params +
+                        # fp32 master + adam m/v are ~9x n_params bytes per
+                        # engine, and the engine<->jit-closure gc cycles pin
+                        # them until a full collection. Leaving 4+ estimation
+                        # engines live exhausted the 16 GB chip before the
+                        # measure phase even started (observed 2026-08-01:
+                        # every measure -> RESOURCE_EXHAUSTED -> "no viable
+                        # candidate", and the leak outlived tune() and killed
+                        # every later phase of the claim session).
+                        engine.destroy()
                     est_cache[est_key] = (fwd_peak, fwd_est, n_params)
             except Exception as e:  # compile/shape failures prune the candidate
                 res.status = "compile-failed"
@@ -339,8 +351,6 @@ class Autotuner:
             log_dist(f"autotune: resumed {n_resumed}/{len(cands)} candidates "
                      f"from {self._ledger_path()}", ranks=[0])
 
-        engine = None  # drop the last estimation-phase engine before measuring
-
         def global_time(r):
             # time per GLOBAL batch: the lowering is one micro step, so a
             # small-micro/high-gas candidate must pay its accumulation factor
@@ -356,22 +366,26 @@ class Autotuner:
             gc.collect()
             jax.clear_caches()
             engine = self._build_engine(res.config)
-            tokens = (engine.micro_batch_size * engine.dp_world_size
-                      * batch["input_ids"].shape[1]
-                      * engine.gradient_accumulation_steps_)
-            sub = {k: v[: engine.micro_batch_size * engine.dp_world_size]
-                   for k, v in batch.items()}
-            engine.train_batch(batch=sub)  # compile+warm
-            jax.block_until_ready(engine.params)
-            t0 = time.perf_counter()
-            for _ in range(measure_steps):
-                engine.train_batch(batch=sub)
-            jax.block_until_ready(engine.params)
-            dt = (time.perf_counter() - t0) / measure_steps
-            res.measured_tokens_per_s = tokens / dt
-            res.status = "measured"
-            self._append_ledger(res)   # updated row; last write wins on resume
-            engine.destroy()
+            try:
+                tokens = (engine.micro_batch_size * engine.dp_world_size
+                          * batch["input_ids"].shape[1]
+                          * engine.gradient_accumulation_steps_)
+                sub = {k: v[: engine.micro_batch_size * engine.dp_world_size]
+                       for k, v in batch.items()}
+                engine.train_batch(batch=sub)  # compile+warm
+                jax.block_until_ready(engine.params)
+                t0 = time.perf_counter()
+                for _ in range(measure_steps):
+                    engine.train_batch(batch=sub)
+                jax.block_until_ready(engine.params)
+                dt = (time.perf_counter() - t0) / measure_steps
+                res.measured_tokens_per_s = tokens / dt
+                res.status = "measured"
+                self._append_ledger(res)  # updated row; last write wins on resume
+            finally:
+                # destroy on the failure path too: a measure-failed candidate
+                # must not pin its buffers for every candidate after it
+                engine.destroy()
 
         def measure_safe(res):
             """True iff the candidate measured. A candidate that slipped the

@@ -42,6 +42,23 @@ def run_phase(name, fn):
         traceback.print_exc()
         print(f"===== {name} FAILED: {type(e).__name__}: {str(e)[:200]} =====",
               flush=True)
+    finally:
+        # Reclaim HBM a crashed phase left behind: engine<->jit-closure gc
+        # cycles pin device buffers until a FULL collection, and one leaky
+        # phase must not starve the rest of the claim (observed 2026-08-01:
+        # the autotuner chain crashed mid-tune and every later phase died
+        # RESOURCE_EXHAUSTED — the serving north star got zero rows from a
+        # live tunnel).
+        import gc
+
+        gc.collect()
+        try:
+            import jax
+
+            jax.clear_caches()
+        except Exception:
+            pass
+        gc.collect()
 
 
 def _sweep():
@@ -123,8 +140,11 @@ def _connect():
 
 
 def main():
-    phases = os.environ.get(
-        "BENCH_PHASES", "sweep,profile,attn,serving,offload").split(",")
+    # serving runs FIRST: it is the north-star metric that has never produced
+    # a number (three sessions of later-phase crashes/outages ate it), and its
+    # small models cost the least claim time of any phase
+    phases = [p.strip() for p in os.environ.get(
+        "BENCH_PHASES", "serving,sweep,profile,attn,offload").split(",")]
     if "offload" in phases:
         # the real phase supersedes bench_serving's offload-tax chaining
         os.environ.setdefault("BENCH_CHAIN_OFFLOAD", "0")
@@ -135,7 +155,6 @@ def main():
              "offload": _offload,
              "serving": _serving}
     for p in phases:
-        p = p.strip()
         if past_deadline():
             print(f"session deadline passed — skipping remaining phases "
                   f"(next: {p})", flush=True)
