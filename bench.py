@@ -237,7 +237,12 @@ def run_benchmark():
         attention_impl=opt("BENCH_ATTN", "attention_impl", "xla"),
         attention_logits_dtype=opt(
             "BENCH_ATTN_LOGITS", "attention_logits_dtype", "fp32"),
-        remat=os.environ.get("BENCH_NOREMAT", "") != "1",
+        # env > tuned > default-on (remat is EXPLICIT so the tuned key can't
+        # flow through passthrough; consuming it here keeps a noremat sweep
+        # winner actually running without remat)
+        remat=((os.environ["BENCH_NOREMAT"] != "1")
+               if os.environ.get("BENCH_NOREMAT")
+               else bool(tuned.get("remat", True))),
         remat_policy=opt("BENCH_REMAT", "remat_policy", "minimal"),
         scan_layers=bool(opt("BENCH_SCAN", "scan_layers", "1",
                              lambda v: v == "1")),
@@ -251,6 +256,16 @@ def run_benchmark():
     batch_size = int(os.environ.get("BENCH_BATCH", "")
                      or tuned_batch or 12) * n_chips
     seq_len = int(os.environ.get("BENCH_SEQ", "1024"))
+    if os.environ.get("BENCH_DRY") == "1":
+        # resolved-config dry run: prints exactly what a real run would
+        # build (bench_defaults adoption, env precedence, tile passthrough)
+        # without compiling anything — the cheap check that the persisted
+        # sweep winner actually reaches the TransformerConfig
+        print(json.dumps({
+            "dry": True, "batch": batch_size, "seq": seq_len,
+            "config": {f.name: repr(getattr(cfg, f.name))
+                       for f in _dc.fields(cfg)}}))
+        return 0
     config = {
         "train_batch_size": batch_size,
         "optimizer": {"type": "adamw", "params": {"lr": 1e-4, "weight_decay": 0.01}},
@@ -329,6 +344,10 @@ def main():
     if "--probe" in sys.argv:
         return probe()
     if "--child" in sys.argv:
+        return run_benchmark()
+    if os.environ.get("BENCH_DRY") == "1":
+        # config-resolution check only: never touch the tunnel
+        os.environ["BENCH_FORCE_CPU"] = "1"
         return run_benchmark()
 
     # Parent: no jax import here, ever.

@@ -5,8 +5,12 @@ where wedges happen (observed 2026-07-31: a 10 s gap between two TPU
 processes wedged the tunnel for >30 min; a ~60 s gap worked). This runner
 holds a single claim for the whole measurement plan:
 
-    python tools/chip_session.py     # sweep + profile + attention + serving
+    python tools/chip_session.py     # serving + attn + profile + offload + sweep
     BENCH_PHASES="sweep,attn" python tools/chip_session.py
+
+(The default order puts serving first — cheapest models, north-star metric —
+and the sweep LAST because its large-batch compile attempts can crash the
+remote compile helper and leak device memory server-side.)
 
 Each phase is fenced with try/except so one failure doesn't cost the rest.
 """
@@ -29,6 +33,35 @@ def past_deadline():
     return DEADLINE > 0 and time.time() > DEADLINE
 
 
+def _reclaim_and_report(name):
+    """Reclaim HBM a phase left behind and print device-memory telemetry.
+
+    engine<->jit-closure gc cycles pin device buffers until a FULL
+    collection, and one leaky phase must not starve the rest of the claim
+    (observed 2026-08-01: the autotuner chain crashed mid-tune and every
+    later phase died RESOURCE_EXHAUSTED — the serving north star got zero
+    rows from a live tunnel). The telemetry distinguishes a client-side leak
+    (live client arrays) from server-side loss (bytes_in_use high with
+    nothing live — the crashed-compile-helper signature)."""
+    import gc
+
+    gc.collect()
+    try:
+        import jax
+
+        jax.clear_caches()
+        gc.collect()
+        live = sum(a.nbytes for a in jax.live_arrays())
+        stats = jax.local_devices()[0].memory_stats() or {}
+        print(f"[hbm after {name}] client live {live / 1e9:.2f} GB; "
+              f"device bytes_in_use "
+              f"{stats.get('bytes_in_use', -1) / 1e9:.2f} GB / limit "
+              f"{stats.get('bytes_limit', -1) / 1e9:.2f} GB", flush=True)
+    except Exception as e:
+        print(f"[hbm after {name}] stats unavailable: "
+              f"{type(e).__name__}: {str(e)[:100]}", flush=True)
+
+
 def run_phase(name, fn):
     print(f"\n===== phase: {name} =====", flush=True)
     t0 = time.time()
@@ -36,29 +69,15 @@ def run_phase(name, fn):
         fn()
         print(f"===== {name} done in {time.time() - t0:.0f}s =====", flush=True)
     except (KeyboardInterrupt, SystemExit):
-        # Ctrl-C means "release the chip NOW", not "try the next phase"
+        # Ctrl-C means "release the chip NOW" — no cleanup RPCs on this path
+        # (memory_stats/clear_caches against a wedged tunnel can block for
+        # hours, which is exactly what Ctrl-C exists to escape)
         raise
     except Exception as e:
         traceback.print_exc()
         print(f"===== {name} FAILED: {type(e).__name__}: {str(e)[:200]} =====",
               flush=True)
-    finally:
-        # Reclaim HBM a crashed phase left behind: engine<->jit-closure gc
-        # cycles pin device buffers until a FULL collection, and one leaky
-        # phase must not starve the rest of the claim (observed 2026-08-01:
-        # the autotuner chain crashed mid-tune and every later phase died
-        # RESOURCE_EXHAUSTED — the serving north star got zero rows from a
-        # live tunnel).
-        import gc
-
-        gc.collect()
-        try:
-            import jax
-
-            jax.clear_caches()
-        except Exception:
-            pass
-        gc.collect()
+    _reclaim_and_report(name)
 
 
 def _sweep():
@@ -140,11 +159,14 @@ def _connect():
 
 
 def main():
-    # serving runs FIRST: it is the north-star metric that has never produced
-    # a number (three sessions of later-phase crashes/outages ate it), and its
-    # small models cost the least claim time of any phase
+    # Order = blast-radius control: serving first (north-star metric, cheapest
+    # models), then attn/profile/offload (small, crash-free), and the sweep
+    # LAST — its large-batch compile attempts can crash the remote compile
+    # helper, which leaks device memory server-side and starves every phase
+    # after it (observed twice 2026-08-01: post-sweep phases all died
+    # RESOURCE_EXHAUSTED with zero client-side buffers live)
     phases = [p.strip() for p in os.environ.get(
-        "BENCH_PHASES", "serving,sweep,profile,attn,offload").split(",")]
+        "BENCH_PHASES", "serving,attn,profile,offload,sweep").split(",")]
     if "offload" in phases:
         # the real phase supersedes bench_serving's offload-tax chaining
         os.environ.setdefault("BENCH_CHAIN_OFFLOAD", "0")
