@@ -23,6 +23,43 @@ def setup_compile_cache():
         pass  # older jax without these config names
 
 
+def compile_with_timeout(lowered, timeout_s=None):
+    """``lowered.compile()`` under a worker-thread timeout.
+
+    A hung remote_compile RPC (observed 2026-08-01, twice: a sweep variant
+    and an attention-bench tile compile — each silent for >15-45 min while
+    every healthy compile took <=90 s) must cost one variant, not the whole
+    claim. The worker is a DAEMON thread: on timeout it is abandoned, and
+    daemon threads are neither joined by concurrent.futures' atexit hook nor
+    block interpreter shutdown — a leaked ThreadPoolExecutor worker would
+    hang the process at exit, holding the claim forever. Compiles don't hold
+    the execution claim, so a late answer is harmless.
+    """
+    import queue
+    import threading
+
+    if timeout_s is None:
+        timeout_s = float(os.environ.get("BENCH_COMPILE_TIMEOUT", "600"))
+    out = queue.Queue()
+
+    def work():
+        try:
+            out.put(("ok", lowered.compile()))
+        except BaseException as e:  # surface compile errors to the caller
+            out.put(("err", e))
+
+    threading.Thread(target=work, daemon=True).start()
+    try:
+        kind, val = out.get(timeout=timeout_s)
+    except queue.Empty:
+        raise TimeoutError(
+            f"compile did not return within {timeout_s:.0f}s "
+            "(hung remote_compile RPC?) — variant abandoned")
+    if kind == "err":
+        raise val
+    return val
+
+
 def maybe_force_cpu():
     """BENCH_FORCE_CPU=1: pin jax to the host CPU backend (smoke/debug runs).
 

@@ -57,7 +57,9 @@ def main():
             f = jax.jit(jax.grad(
                 lambda q, k, v: fn(q, k, v).astype(jnp.float32).sum(),
                 argnums=(0, 1, 2)))
-        compiled = f.lower(q, k, v).compile()
+        from _common import compile_with_timeout
+
+        compiled = compile_with_timeout(f.lower(q, k, v))
         mem = compiled.memory_analysis()
         if mem is not None:
             need = (mem.temp_size_in_bytes + mem.argument_size_in_bytes +

@@ -30,18 +30,14 @@ def compile_step(engine, batch, timeout_s=None):
     return (compiled, projected peak HBM bytes) WITHOUT executing anything —
     over-budget variants must be skipped by analysis, not by an OOM crash.
 
-    The compile runs in a worker thread with a timeout (default
+    The compile runs under ``_common.compile_with_timeout`` (default
     BENCH_COMPILE_TIMEOUT=600 s): a hung remote_compile RPC (observed
     2026-08-01 — remat-dots-b12's compile never returned) must cost one
-    variant, not the whole claim. On timeout the worker thread is leaked;
-    compiles don't hold the execution claim, so a late answer is harmless."""
-    import concurrent.futures
-
-    import jax
+    variant, not the whole claim."""
     import jax.numpy as jnp
 
-    if timeout_s is None:
-        timeout_s = float(os.environ.get("BENCH_COMPILE_TIMEOUT", "600"))
+    from _common import compile_with_timeout
+
     assert engine.gradient_accumulation_steps_ == 1 \
         and engine._can_fuse_train_step(), \
         "sweep drives the gas==1 fused step; this variant would run a " \
@@ -53,15 +49,7 @@ def compile_step(engine, batch, timeout_s=None):
         engine.params, engine.optimizer_state, sharded, engine._scale,
         engine._good_steps, engine._rng, jnp.asarray(1e-4, jnp.float32),
         jnp.asarray(1.0, jnp.float32))
-    pool = concurrent.futures.ThreadPoolExecutor(max_workers=1)
-    try:
-        compiled = pool.submit(lowered.compile).result(timeout=timeout_s)
-    except concurrent.futures.TimeoutError:
-        raise TimeoutError(
-            f"compile did not return within {timeout_s:.0f}s "
-            "(hung remote_compile RPC?) — variant abandoned")
-    finally:
-        pool.shutdown(wait=False)
+    compiled = compile_with_timeout(lowered, timeout_s)
     mem = compiled.memory_analysis()
     # donated params/opt-state alias input->output; without subtracting the
     # alias bytes the projection double-counts ~5 GB and mis-skips exactly
