@@ -126,8 +126,16 @@ class InferenceEngine:
         kernel becomes {kernel_q int8, kernel_scale} — the model reads weights
         from HBM at 8 bits and dequantizes inside the fused matmul
         (``models/layers.py linear_apply``)."""
+        from ..models.layers import set_quantized_matmul_enabled
         from ..ops.quantizer import quantize_per_channel
 
+        # the Pallas dequant-matmul has no sharding rule: under tp > 1 the
+        # SPMD partitioner would replicate the model-axis-sharded quantized
+        # weight per device, erasing the HBM win — keep the XLA dequant path
+        # (which partitions correctly) for tensor-parallel serving
+        tp = self._config.tensor_parallel.tp_size \
+            if self._config.tensor_parallel.enabled else 1
+        set_quantized_matmul_enabled(tp <= 1)
         bits = self._config.quant.bits
         group_size = self._config.quant.group_size
         counts = {"packed": 0, "int8": 0}

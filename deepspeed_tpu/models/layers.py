@@ -143,20 +143,67 @@ def linear_apply_rowparallel(p, x, axis):
     return y
 
 
+# Pallas dequant-matmul dispatch switch. The inference engine turns the
+# kernel OFF for tensor-parallel serving: an opaque pallas_call has no
+# sharding rule, so under tp > 1 the SPMD partitioner would replicate the
+# model-axis-sharded quantized weight on every device — erasing exactly the
+# per-device HBM win quantization exists for (the XLA dequant+dot path
+# partitions correctly). Set via set_quantized_matmul_enabled before trace.
+_QMM_MODE = "on"  # "on" | "off" | "interpret" (interpret = CPU-testable)
+
+
+def set_quantized_matmul_enabled(flag):
+    global _QMM_MODE
+    _QMM_MODE = "on" if flag else "off"
+
+
+def _quantized_matmul_or_none(p, x, bits):
+    """Fused Pallas dequant-matmul when eligible — the packed weight is what
+    streams from HBM; unpack, group-scale, and the MXU dot happen per-tile
+    in VMEM. Measured necessity: XLA does NOT fuse the int4 nibble unpack
+    into the matmul (2026-08-01 serving bench: int4 decode 3-4x slower than
+    bf16), so dequantizing outside the kernel round-trips the full-size
+    weight through HBM every decode step."""
+    import os
+
+    mode = os.environ.get("DS_TPU_QMM", _QMM_MODE)
+    interpret = mode == "interpret"
+    if mode == "off" or mode == "0" \
+            or (not interpret and jax.default_backend() != "tpu"):
+        return None
+    key = "kernel_q4" if bits == 4 else "kernel_q"
+    q = p[key]
+    if q.ndim != 2:
+        return None
+    xm = x.reshape(-1, x.shape[-1])
+    if xm.shape[0] > 2048:
+        return None  # prefill-sized token counts: VMEM accumulator too large
+    from ..ops.pallas.quantized_matmul import quantized_matmul
+
+    y = quantized_matmul(xm, q, p["kernel_scale"], bits=bits,
+                         interpret=interpret)
+    if y is None:
+        return None
+    return y.reshape(x.shape[:-1] + (y.shape[-1],))
+
+
 def linear_apply(p, x, compute_dtype=None):
-    if "kernel_q4" in p:
-        # int4 weight-only serving: nibble-packed uint8 streams from HBM at
-        # 4 bits/weight; unpack + dequant fuse into the matmul
+    if "kernel_q4" in p or "kernel_q" in p:
+        bits = 4 if "kernel_q4" in p else 8
+        if compute_dtype is not None:
+            x = x.astype(compute_dtype)
+        y = _quantized_matmul_or_none(p, x, bits=bits)
+        if y is not None:
+            if "bias" in p:
+                y = y + p["bias"].astype(y.dtype)
+            return y
+        # XLA fallback (CPU / tp>1 / non-tileable shapes): unpack + dequant
+        # and let XLA fuse what it can into the matmul; the weight still
+        # streams from HBM at its quantized width when fusion succeeds
         from ..ops.quantizer import dequantize_per_channel, unpack_int4
 
-        kernel = dequantize_per_channel(unpack_int4(p["kernel_q4"]),
-                                        p["kernel_scale"], x.dtype)
-    elif "kernel_q" in p:
-        # int8 weight-only serving: dequant fuses into the matmul, the weight
-        # streams from HBM at 8 bits (ops/quantizer.py quantize_per_channel)
-        from ..ops.quantizer import dequantize_per_channel
-
-        kernel = dequantize_per_channel(p["kernel_q"], p["kernel_scale"], x.dtype)
+        qk = unpack_int4(p["kernel_q4"]) if bits == 4 else p["kernel_q"]
+        kernel = dequantize_per_channel(qk, p["kernel_scale"], x.dtype)
     else:
         kernel = p["kernel"]
         if compute_dtype is not None:
