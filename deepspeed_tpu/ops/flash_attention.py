@@ -49,9 +49,22 @@ def flash_attention(q, k, v, causal=True, scale=None, block_size=512,
     if _tpu_kernel_eligible(q, k):
         from .pallas.flash_attention import pallas_flash_attention
 
+        s_q, s_kv = q.shape[1], k.shape[1]
+        if block_q is None and block_kv is None and s_kv <= 1024:
+            # Measured default (2026-08-01 on-chip sweep, PERF.md): at
+            # s_kv <= 1024 a SINGLE kv block per grid step drops the
+            # online-softmax rescale loop entirely — fwd 512x{s_kv} +
+            # bwd 512x{s_kv} tiles beat the generic 256x512/256x256 by
+            # +22% end-to-end training throughput at the bench shape.
+            # Longer sequences keep the generic tiles until the 2k-8k tile
+            # sweep (bench_attention) lands.
+            block_q = min(512, s_q)
+            block_kv = s_kv
+            block_q_bwd = block_q_bwd or min(512, s_q)
+            block_kv_bwd = block_kv_bwd or s_kv
         return pallas_flash_attention(q, k, v, causal=causal, scale=scale,
-                                      block_q=min(block_q or 256, q.shape[1]),
-                                      block_kv=min(block_kv or 512, k.shape[1]),
+                                      block_q=min(block_q or 256, s_q),
+                                      block_kv=min(block_kv or 512, s_kv),
                                       block_q_bwd=block_q_bwd,
                                       block_kv_bwd=block_kv_bwd)
     return _chunked_attention(q, k, v, causal=causal, scale=scale,
