@@ -60,6 +60,28 @@ def compile_with_timeout(lowered, timeout_s=None):
     return val
 
 
+# HBM peak bandwidth (GB/s) per chip by TPU generation — the decode-throughput
+# roofline denominator (weight-only decode at batch 1 reads every live weight
+# byte once per token, so achieved GB/s = weight_bytes x steps/s).
+PEAK_HBM_GBS = {
+    "v4": 1228.0,
+    "v5e": 819.0,
+    "v5lite": 819.0,
+    "v5p": 2765.0,
+    "v6e": 1640.0,
+}
+
+
+def peak_hbm_gbs(device_kind):
+    """Best-effort peak HBM GB/s from ``jax.devices()[0].device_kind``."""
+    kind = (device_kind or "").lower().replace(" ", "")
+    for key, peak in PEAK_HBM_GBS.items():
+        if key in kind:
+            return peak
+    env = os.environ.get("PALLAS_AXON_TPU_GEN", "").lower()
+    return PEAK_HBM_GBS.get(env, 819.0)
+
+
 def maybe_force_cpu():
     """BENCH_FORCE_CPU=1: pin jax to the host CPU backend (smoke/debug runs).
 

@@ -33,8 +33,10 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def build_engine(family, size, mode, max_tokens, **model_kw):
-    """Returns (engine, n_params) — n_params counted BEFORE quantization
-    (int4 packs two weights per element; the packed tree undercounts)."""
+    """Returns (engine, n_params, weight_bytes) — n_params counted BEFORE
+    quantization (int4 packs two weights per element; the packed tree
+    undercounts), weight_bytes counted AFTER (the decode HBM-roofline
+    numerator)."""
     import jax
 
     import deepspeed_tpu
@@ -54,7 +56,12 @@ def build_engine(family, size, mode, max_tokens, **model_kw):
         config["quant"] = {"enabled": True, "bits": 8 if mode == "int8" else 4}
     elif mode != "bf16":
         raise ValueError(f"unknown mode {mode}")
-    return deepspeed_tpu.init_inference(model=model, config=config), n_params
+    engine = deepspeed_tpu.init_inference(model=model, config=config)
+    # resident weight bytes AFTER quantization (packed int4 counts real bytes,
+    # groupwise scales included) — the decode roofline numerator: a batch-1
+    # decode step reads every one of these bytes from HBM once
+    weight_bytes = sum(x.nbytes for x in jax.tree_util.tree_leaves(engine.params))
+    return engine, n_params, weight_bytes
 
 
 def bench_one(engine, prompt_len, new_tokens, batch, repeats, rng):
@@ -92,12 +99,13 @@ def main():
     ap.add_argument("--repeats", type=int, default=10)
     args = ap.parse_args()
 
-    from _common import maybe_force_cpu
+    from _common import maybe_force_cpu, peak_hbm_gbs
 
     maybe_force_cpu()
     import jax
 
     platform = jax.devices()[0].platform
+    peak_bw = peak_hbm_gbs(jax.devices()[0].device_kind)
     prompts = [int(p) for p in args.prompts.split(",")]
     # +1: the decode-compile warmup generates 1 + new_tokens tokens
     max_tokens = ((max(prompts) + args.new_tokens + 1 + 63) // 64) * 64
@@ -125,8 +133,8 @@ def main():
         # fence the whole variant: one failing mode (e.g. a quant path that
         # has never TPU-compiled) must not cost the other rows of the claim
         try:
-            engine, n_params = build_engine(args.family, size, mode,
-                                            max_tokens, **model_kw)
+            engine, n_params, weight_bytes = build_engine(
+                args.family, size, mode, max_tokens, **model_kw)
         except Exception as e:
             print(f"{args.family}-{size}/{label} BUILD FAILED: "
                   f"{type(e).__name__}: {str(e)[:200]}", flush=True)
@@ -141,6 +149,13 @@ def main():
                     print(f"{args.family}-{size}/{label} p={p} FAILED: "
                           f"{type(e).__name__}: {str(e)[:200]}", flush=True)
                     continue
+                # decode-bandwidth roofline (VERDICT r4 #3): weight-only
+                # decode at small batch reads every resident weight byte per
+                # step, so achieved GB/s = weight_bytes x (decode steps/s).
+                # %-of-peak is the transferable signal on a rig whose TTFT is
+                # ~95% fixed dispatch overhead.
+                decode_steps_s = dec / args.batch
+                gbs = weight_bytes * decode_steps_s / 1e9
                 row = {
                     "model": f"{args.family}-{size}", "mode": label,
                     "prompt_len": p, "batch": args.batch,
@@ -148,6 +163,9 @@ def main():
                     "ttft_p50_ms": round(ttft50, 2),
                     "ttft_p95_ms": round(ttft95, 2),
                     "decode_tok_s": round(dec, 1),
+                    "weight_gb": round(weight_bytes / 1e9, 3),
+                    "achieved_gbs": round(gbs, 1),
+                    "hbm_util": round(gbs / peak_bw, 3),
                     "n_params_m": round(n_params / 1e6, 1),
                     "platform": platform,
                 }
@@ -161,11 +179,13 @@ def main():
             engine.destroy()
             del engine
 
-    print(f"\n| model | mode | prompt | ttft p50 (ms) | ttft p95 (ms) | decode tok/s |")
-    print("|---|---|---|---|---|---|")
+    print(f"\n| model | mode | prompt | ttft p50 (ms) | ttft p95 (ms) "
+          f"| decode tok/s | GB/s | %HBM peak ({peak_bw:.0f}) |")
+    print("|---|---|---|---|---|---|---|---|")
     for r in rows:
         print(f"| {r['model']} | {r['mode']} | {r['prompt_len']} "
-              f"| {r['ttft_p50_ms']} | {r['ttft_p95_ms']} | {r['decode_tok_s']} |")
+              f"| {r['ttft_p50_ms']} | {r['ttft_p95_ms']} | {r['decode_tok_s']} "
+              f"| {r['achieved_gbs']} | {100 * r['hbm_util']:.0f}% |")
 
     # Offload-tax chaining (2026-08-01): the chip session running when the
     # offload phase landed imports this module lazily at serving time, so

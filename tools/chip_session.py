@@ -1,26 +1,39 @@
-"""One-claim benchmark session: every perf tool in ONE process.
+"""One-claim benchmark session: bank the official number FIRST, then measure.
 
-The axon tunnel serves one claim, and the claim handoff between processes is
-where wedges happen (observed 2026-07-31: a 10 s gap between two TPU
-processes wedged the tunnel for >30 min; a ~60 s gap worked). This runner
-holds a single claim for the whole measurement plan:
+Round-5 protocol (VERDICT r4 "Next round" #1): three rounds in a row the
+driver's end-of-round ``bench.py`` banked 0.0 while the builder's own sessions
+measured past the north star. The fix is structural:
 
-    python tools/chip_session.py     # serving + attn + profile + offload + sweep
-    BENCH_PHASES="sweep,attn" python tools/chip_session.py
+1. **Bank first.** The orchestrator (this process — it NEVER imports jax)
+   loops the EXACT driver command (``python bench.py``) until its JSON line
+   carries value > 0, then mirrors the result to ``BANKED_BENCH_r05.json`` and
+   PERF.md. Only after the headline is banked does any risky work start.
+2. **Measure second.** A child process (``--measure``) claims the tunnel and
+   runs the phase plan (serving -> moe -> attn -> profile -> offload ->
+   validate -> sweep; the sweep stays LAST and now carries an in-session
+   compile-crash circuit breaker, see sweep_bench.py).
+3. **Health handoff.** After the child exits, the orchestrator waits a claim
+   handoff gap and re-runs ``python bench.py`` end to end: proof the tunnel is
+   alive AND the driver's own cold path reproduces the number after the
+   session's load. The second result is banked too (last-good wins).
 
-(The default order puts serving first — cheapest models, north-star metric —
-and the sweep LAST because its large-batch compile attempts can crash the
-remote compile helper and leak device memory server-side.)
+    python tools/chip_session.py                 # full protocol
+    BENCH_PHASES="serving,sweep" python tools/chip_session.py
+    python tools/chip_session.py --measure       # phases only (internal)
 
-Each phase is fenced with try/except so one failure doesn't cost the rest.
+Each phase is fenced so one failure doesn't cost the rest.
 """
 
+import json
 import os
+import signal
+import subprocess
 import sys
 import time
 import traceback
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 # BENCH_SESSION_DEADLINE (unix epoch seconds): stop knocking / starting new
 # phases past this time. Exists so a late tunnel recovery can't put this
@@ -28,10 +41,180 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 # the 2026-08-01 outage showed a recovery can land at any hour.
 DEADLINE = float(os.environ.get("BENCH_SESSION_DEADLINE", "0") or 0)
 
+# Claim-handoff settle (see bench.py): a new TPU process starting <~10 s
+# after the previous one exits can wedge the tunnel for hours.
+HANDOFF_S = float(os.environ.get("BENCH_HANDOFF_DELAY", "60"))
+
 
 def past_deadline():
     return DEADLINE > 0 and time.time() > DEADLINE
 
+
+# ---------------------------------------------------------------------------
+# Orchestrator side (no jax in this process, ever)
+# ---------------------------------------------------------------------------
+
+def _run(args, timeout_s):
+    """argv in its own session with SIGTERM-grace-SIGKILL semantics."""
+    proc = subprocess.Popen(
+        args, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        start_new_session=True)
+    try:
+        out, _ = proc.communicate(timeout=timeout_s)
+        return proc.returncode, out or ""
+    except subprocess.TimeoutExpired as te:
+        out = te.stdout
+        if isinstance(out, bytes):
+            out = out.decode("utf-8", "replace")
+        out = out or ""
+        for sig, grace in ((signal.SIGTERM, 20), (signal.SIGKILL, 10)):
+            try:
+                os.killpg(proc.pid, sig)
+            except (ProcessLookupError, PermissionError):
+                pass
+            try:
+                out2, _ = proc.communicate(timeout=grace)
+                out = out2 or out
+                break
+            except subprocess.TimeoutExpired:
+                pass
+            except Exception:
+                break
+        return None, out
+
+
+def _parse_bench_line(out):
+    for line in reversed(out.strip().splitlines()):
+        try:
+            cand = json.loads(line)
+        except (ValueError, json.JSONDecodeError):
+            continue
+        if isinstance(cand, dict) and "metric" in cand:
+            return cand
+    return None
+
+
+def _bank(record, stage):
+    """Persist a nonzero driver-path result where the round can't lose it."""
+    path = os.path.join(REPO, "BANKED_BENCH_r05.json")
+    entry = {"stage": stage, "banked_utc": time.strftime(
+        "%Y-%m-%d %H:%M:%S", time.gmtime()), **record}
+    hist = []
+    if os.path.isfile(path):
+        try:
+            with open(path) as f:
+                hist = json.load(f).get("history", [])
+        except (ValueError, OSError):
+            pass
+    hist.append(entry)
+    with open(path, "w") as f:
+        json.dump({"latest": entry, "history": hist}, f, indent=1)
+    # mirror to PERF.md's live log so the evidence is in the narrative doc too
+    try:
+        with open(os.path.join(REPO, "PERF.md"), "a") as f:
+            f.write(f"\n- {entry['banked_utc']} UTC [{stage}] driver-path "
+                    f"`python bench.py`: **{record.get('value')} "
+                    f"{record.get('unit')}** vs_baseline="
+                    f"{record.get('vs_baseline')} "
+                    f"extra={json.dumps(record.get('extra', {}))}\n")
+    except OSError:
+        pass
+    print(f"[bank:{stage}] {json.dumps(entry)}", flush=True)
+
+
+def bank_headline(stage, max_attempts=10**9, interval_s=120.0):
+    """Run the EXACT driver command until it banks a nonzero value.
+
+    Each attempt is `python bench.py` — probe, handoff settle, measurement
+    child, one JSON line — so a success here is literally the driver's own
+    path succeeding. Returns the record or None (deadline/attempts exhausted).
+    """
+    attempt = 0
+    while attempt < max_attempts and not past_deadline():
+        attempt += 1
+        t0 = time.time()
+        # never hold the claim past the deadline: the deadline exists so the
+        # driver's end-of-round bench.py can't land in a claim fight with us
+        budget = 2400.0
+        if DEADLINE:
+            budget = min(budget, max(120.0, DEADLINE - time.time()))
+        rc, out = _run([sys.executable, "-u",
+                        os.path.join(REPO, "bench.py")], timeout_s=budget)
+        rec = _parse_bench_line(out)
+        dt = time.time() - t0
+        if rec and rec.get("value", 0) > 0:
+            _bank(rec, stage)
+            return rec
+        err = (rec or {}).get("error", f"rc={rc}, no JSON")
+        print(f"[bank:{stage}] attempt {attempt}: no number ({dt:.0f}s): "
+              f"{str(err)[:160]}; retrying in {interval_s:.0f}s", flush=True)
+        time.sleep(interval_s)
+    return None
+
+
+def orchestrate():
+    print(f"chip_session orchestrator: deadline="
+          f"{time.strftime('%H:%M:%S', time.localtime(DEADLINE)) if DEADLINE else 'none'}",
+          flush=True)
+    # 1. bank the official number via the driver's own path
+    rec = bank_headline("pre-session")
+    if rec is None:
+        print("orchestrator: deadline passed before a bank landed — exiting",
+              flush=True)
+        return 1
+    if past_deadline():
+        print("orchestrator: banked, but deadline passed — skipping phases "
+              "(the claim stays free for the driver)", flush=True)
+        return 0
+
+    # 2. measurement session in a child (its crash can't take this process)
+    time.sleep(HANDOFF_S)
+    budget = DEADLINE - time.time() - 900 if DEADLINE else 6 * 3600
+    if budget > 120:
+        print(f"orchestrator: starting measure child "
+              f"(budget {budget/60:.0f} min)", flush=True)
+        # child INHERITS stdout/stderr: a multi-hour session must stream its
+        # phase logs live (they are the round's primary evidence — buffering
+        # them in this process would lose everything if it dies first)
+        proc = subprocess.Popen(
+            [sys.executable, "-u", os.path.abspath(__file__), "--measure"],
+            start_new_session=True)
+        try:
+            rc = proc.wait(timeout=budget)
+        except subprocess.TimeoutExpired:
+            rc = None
+            for sig, grace in ((signal.SIGTERM, 30), (signal.SIGKILL, 10)):
+                try:
+                    os.killpg(proc.pid, sig)
+                except (ProcessLookupError, PermissionError):
+                    pass
+                try:
+                    proc.wait(timeout=grace)
+                    break
+                except subprocess.TimeoutExpired:
+                    pass
+        print(f"orchestrator: measure child done (rc={rc})", flush=True)
+    else:
+        print("orchestrator: not enough budget for phases — skipping",
+              flush=True)
+
+    # 3. health handoff: prove the tunnel survived the session by running the
+    # driver's command once more (also warms the compile cache for the real
+    # end-of-round run; last good result wins the bank)
+    time.sleep(HANDOFF_S)
+    rec2 = bank_headline("post-session", max_attempts=3, interval_s=90.0)
+    if rec2 is None:
+        print("orchestrator: POST-SESSION HEALTH CHECK FAILED — tunnel may "
+              "be wedged for the driver; pre-session bank stands", flush=True)
+        return 0
+    print("orchestrator: post-session health check PASSED — tunnel live, "
+          "headline reproduced on the driver path", flush=True)
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# Measurement child (claims the tunnel, runs the phase plan)
+# ---------------------------------------------------------------------------
 
 def _reclaim_and_report(name):
     """Reclaim HBM a phase left behind and print device-memory telemetry.
@@ -104,6 +287,18 @@ def _offload():
     bench_offload.main()
 
 
+def _moe():
+    import bench_moe
+
+    bench_moe.main()
+
+
+def _validate():
+    import validate_autotuner
+
+    validate_autotuner.main()
+
+
 def _serving():
     import bench_serving
 
@@ -158,23 +353,31 @@ def _connect():
                 time.sleep(30)
 
 
-def main():
+def measure():
+    # scrub our own flag from argv: several phase tools (bench_serving,
+    # bench_attention, ...) argparse sys.argv, and an unrecognized
+    # '--measure' would SystemExit phase 1 and kill the whole plan
+    sys.argv = [sys.argv[0]]
     # Order = blast-radius control: serving first (north-star metric, cheapest
-    # models), then attn/profile/offload (small, crash-free), and the sweep
-    # LAST — its large-batch compile attempts can crash the remote compile
-    # helper, which leaks device memory server-side and starves every phase
-    # after it (observed twice 2026-08-01: post-sweep phases all died
-    # RESOURCE_EXHAUSTED with zero client-side buffers live)
+    # models — and now the fused dequant-matmul proof), then moe/attn/profile/
+    # offload/validate (small), and the sweep LAST — its large-batch compile
+    # attempts can crash the remote compile helper, which leaks device memory
+    # server-side and starves every phase after it (observed twice
+    # 2026-08-01); the sweep's own circuit breaker now bounds that damage.
     phases = [p.strip() for p in os.environ.get(
-        "BENCH_PHASES", "serving,attn,profile,offload,sweep").split(",")]
+        "BENCH_PHASES",
+        "serving,moe,attn,profile,offload,validate,sweep").split(",")]
     if "offload" in phases:
         # the real phase supersedes bench_serving's offload-tax chaining
         os.environ.setdefault("BENCH_CHAIN_OFFLOAD", "0")
+    if "validate" in phases:
+        # ditto for sweep_bench's chained autotuner validation
+        os.environ.setdefault("BENCH_AUTOTUNE", "0")
     _connect()
     # imports stay inside the phase fences: a broken unselected module must
     # not cost the whole claim
     table = {"sweep": _sweep, "profile": _profile, "attn": _attn,
-             "offload": _offload,
+             "offload": _offload, "moe": _moe, "validate": _validate,
              "serving": _serving}
     for p in phases:
         if past_deadline():
@@ -185,7 +388,15 @@ def main():
             run_phase(p, table[p])
         else:
             print(f"unknown phase: {p}", flush=True)
+    # leave the device as empty as we can for the handoff
+    _reclaim_and_report("session-end")
+
+
+def main():
+    if "--measure" in sys.argv:
+        return measure() or 0
+    return orchestrate()
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

@@ -311,12 +311,28 @@ def main():
         except OSError:
             pass
 
+    # In-session circuit breaker (VERDICT r4 weak #1): each remote-compile
+    # HTTP-500 crash leaks device memory SERVER-side and the leak is
+    # cumulative — the 2026-08-01 session submitted 12+ crashing compiles and
+    # starved every later phase AND the driver's end-of-round bench. After
+    # BENCH_CRASH_BUDGET crashes in THIS process, stop submitting new
+    # compiles entirely; measured rows so far still decide the defaults.
+    crash_budget = int(os.environ.get("BENCH_CRASH_BUDGET", "2"))
+    session_crashes = 0
+
     rng = np.random.RandomState(0)
     print(f"{'variant':<16} {'tok/s':>10} {'MFU':>7}")
     best = (None, 0.0)
     best_spec = None
     engine = model = None
+    breaker_tripped = False
     for name, m_over, b in variants:
+        if session_crashes >= crash_budget:
+            print(f"CIRCUIT BREAKER: {session_crashes} remote-compile crashes "
+                  f"this session (server-side leak is cumulative) — "
+                  f"abandoning remaining variants from '{name}' on", flush=True)
+            breaker_tripped = True
+            break
         if crash_counts.get(name, 0) >= 2 and not retry_failed:
             print(f"{name:<16} SKIPPED: compile crashed the helper in "
                   f"{crash_counts[name]} prior sessions (BENCH_RETRY_FAILED=1 "
@@ -351,6 +367,7 @@ def main():
             msg = f"{type(e).__name__}: {str(e)[:300]}"
             if "remote_compile" in msg:
                 record_crash(name)
+                session_crashes += 1
             print(f"{name:<16} FAILED: {msg}", flush=True)
         finally:
             # free HBM before the next variant: del alone leaves
@@ -381,8 +398,13 @@ def main():
     # autotuner roofline validation rides the same claim (VERDICT r3 #9: the
     # est_time ranking has never been checked on chip). Chained here rather
     # than as a chip_session phase so an already-running session — which
-    # imports this module lazily at phase time — still picks it up.
-    if os.environ.get("BENCH_AUTOTUNE", "1") == "1":
+    # imports this module lazily at phase time — still picks it up. Skipped
+    # when the breaker tripped: the validator's engines would compile into a
+    # leaked-HBM device and die RESOURCE_EXHAUSTED, poisoning its ledger.
+    if breaker_tripped:
+        print("breaker tripped — skipping chained autotuner validation",
+              flush=True)
+    elif os.environ.get("BENCH_AUTOTUNE", "1") == "1":
         try:
             import validate_autotuner
 
