@@ -115,6 +115,12 @@ class TransformerConfig:
     # partitioned_param_coordinator.py:230) instead of trusting XLA's schedule.
     zero3_per_layer_gather: bool = False
     zero3_gather_specs: typing.Any = None  # per-block spec tree (no layers dim)
+    # Same discipline for the top-level params (wte / lm_head / ln_f / wpe):
+    # {param_name: spec tree} with the data axis stripped. Without this, a
+    # ZeRO-3 embedding sharded on its d_model axis (vocab % dp != 0 fallback)
+    # propagates INTO the logits matmul and the partitioner partial-sums
+    # full-batch logits instead of gathering the weight.
+    zero3_toplevel_gather_specs: typing.Any = None
     # Sequence parallelism: shard the sequence dim over the ``seq`` mesh axis with
     # ring attention (set by the engine; see parallel/ring_attention.py)
     sequence_parallel: bool = False
@@ -270,6 +276,23 @@ def block_init(rng, cfg):
     }
 
 
+def _cast_block_params(cfg, p):
+    """fp32 masters -> compute dtype for the matmul weights. Norm params stay
+    fp32 (layernorm computes in fp32 internally anyway); int8 (weight-only-
+    quantized) leaves must NOT be cast — their dequant scale lives next to
+    them and linear_apply fuses it into the matmul; MoE params cast inside
+    moe_mlp_apply (router stays fp32 for stable gating). Idempotent."""
+    cast = lambda a: a.astype(cfg.compute_dtype) \
+        if jnp.issubdtype(a.dtype, jnp.floating) else a
+    return {
+        "ln_1": p["ln_1"],
+        "ln_2": p["ln_2"],
+        "attn": jax.tree_util.tree_map(cast, p["attn"]),
+        "mlp": p["mlp"] if cfg.n_experts > 0 else jax.tree_util.tree_map(
+            cast, p["mlp"]),
+    }
+
+
 def block_apply(cfg, p, x, mask=None, rope=None, alibi=None, deterministic=True,
                 dropout_rng=None, kv_mask=None, seq_manual=False,
                 tp_manual=False):
@@ -279,18 +302,7 @@ def block_apply(cfg, p, x, mask=None, rope=None, alibi=None, deterministic=True,
     Params arrive as fp32 masters and are cast to the compute dtype here (norm
     params stay fp32 — layernorm computes in fp32 internally anyway)."""
     x = x.astype(cfg.compute_dtype)
-    # int8 (weight-only-quantized) leaves must NOT be cast here — their dequant
-    # scale lives next to them and linear_apply fuses it into the matmul
-    cast = lambda a: a.astype(cfg.compute_dtype) \
-        if jnp.issubdtype(a.dtype, jnp.floating) else a
-    p = {
-        "ln_1": p["ln_1"],
-        "ln_2": p["ln_2"],
-        "attn": jax.tree_util.tree_map(cast, p["attn"]),
-        # MoE params cast inside moe_mlp_apply (router stays fp32 for stable gating)
-        "mlp": p["mlp"] if cfg.n_experts > 0 else jax.tree_util.tree_map(
-            cast, p["mlp"]),
-    }
+    p = _cast_block_params(cfg, p)
     b, s, d = x.shape
 
     from jax.ad_checkpoint import checkpoint_name
@@ -564,7 +576,34 @@ def stack_apply(cfg, stacked_params, x, mask=None, rope=None, alibi=None,
         local_mask = gmask & band
         local_pattern = local_attention_flags(cfg)
 
+    def _constrain(p, specs):
+        from jax.sharding import NamedSharding
+
+        return jax.tree_util.tree_map(
+            lambda a, s: jax.lax.with_sharding_constraint(
+                a, NamedSharding(cfg.mesh, s)),
+            p, specs)
+
     def body(p, h, rng, m):
+        # ZeRO-3 per_layer schedule, all INSIDE the remat region (the bwd
+        # re-gathers instead of saving 40 layers of gathered weights as scan
+        # residuals — measured 50 GB/chip on the OPT-13B/256 projection when
+        # the gather sat outside jax.checkpoint): pin the fp32 masters to
+        # their sharded layout, cast, then constrain to the gathered layout —
+        # the reshard is forced onto the bf16 side of the cast (half the
+        # wire; without the sharded pin the partitioner hoists the gather to
+        # fp32 — measured 2x on the same projection).
+        if cfg.zero3_per_layer_gather and cfg.zero3_gather_specs is not None:
+            # Known 2x: the partitioner gathers the fp32 master and converts
+            # after (it reshards an elementwise op's input to match the
+            # constrained output, so cast-then-gather cannot be expressed
+            # with constraint chains; jax.sharding.reshard pins the edge but
+            # breaks Shardy propagation for the surrounding scan — measured
+            # full-batch activation gathers). bf16 gathers need Shardy
+            # explicit-sharding mode; until then per-layer gather wire is
+            # fp32-sized. Overlap headroom absorbs it (scale_projection:
+            # 6.5x at OPT-13B/v4-256).
+            p = _constrain(_cast_block_params(cfg, p), cfg.zero3_gather_specs)
         return block_apply(
             cfg, p, h, mask=m, rope=rope, alibi=alibi,
             deterministic=deterministic, dropout_rng=rng, kv_mask=kv_mask,
@@ -572,16 +611,6 @@ def stack_apply(cfg, stacked_params, x, mask=None, rope=None, alibi=None,
 
     if cfg.remat:
         body = jax.checkpoint(body, policy=_remat_policy(cfg), static_argnums=())
-
-    def gather_constraint(p):
-        if cfg.zero3_per_layer_gather and cfg.zero3_gather_specs is not None:
-            from jax.sharding import NamedSharding
-
-            return jax.tree_util.tree_map(
-                lambda a, s: jax.lax.with_sharding_constraint(
-                    a, NamedSharding(cfg.mesh, s)),
-                p, cfg.zero3_gather_specs)
-        return p
 
     def pld_select(i, h_new, h_prev, aux_i, rng_i):
         """Progressive layer drop (reference ``progressive_layer_drop.py``):
@@ -607,8 +636,7 @@ def stack_apply(cfg, stacked_params, x, mask=None, rope=None, alibi=None,
         and cfg.attention_impl in ("flash", "jax_flash", "block_sparse"))
     if unrolled:
         for i in range(cfg.n_layers):
-            p_i = gather_constraint(
-                jax.tree_util.tree_map(lambda a: a[i], stacked_params))
+            p_i = jax.tree_util.tree_map(lambda a: a[i], stacked_params)
             rng_i = jax.random.fold_in(dropout_rng, i) if dropout_rng is not None else None
             m_i = local_mask if (local_pattern is not None and local_pattern[i]) \
                 else mask
@@ -618,7 +646,6 @@ def stack_apply(cfg, stacked_params, x, mask=None, rope=None, alibi=None,
         return x, aux
 
     def scan_step(h, i, aux, p, m_i):
-        p = gather_constraint(p)
         rng_i = jax.random.fold_in(dropout_rng, i) if dropout_rng is not None else None
         h_new, aux_i = body(p, h, rng_i, m_i)
         h, aux_i = pld_select(i, h_new, h, aux_i, rng_i)
@@ -704,6 +731,25 @@ class CausalLM:
     def __init__(self, config: TransformerConfig):
         self.config = config
 
+    def _gather_toplevel(self, params):
+        """ZeRO-3 per_layer mode: constrain top-level params to their gathered
+        (data-unsharded) layout before use — gather-weights-compute-release,
+        mirroring the per-block constraint inside the layer scan."""
+        cfg = self.config
+        specs = getattr(cfg, "zero3_toplevel_gather_specs", None)
+        if not (getattr(cfg, "zero3_per_layer_gather", False) and specs):
+            return params
+        from jax.sharding import NamedSharding
+
+        out = dict(params)
+        for k, sub in specs.items():
+            if k in out:
+                out[k] = jax.tree_util.tree_map(
+                    lambda a, s: jax.lax.with_sharding_constraint(
+                        a, NamedSharding(cfg.mesh, s)),
+                    out[k], sub)
+        return out
+
     # -- init ---------------------------------------------------------------------
     def init(self, rng):
         cfg = self.config
@@ -745,6 +791,7 @@ class CausalLM:
                  pld_theta=None):
         """Embedding + blocks + final norm -> ([batch, seq, d_model], aux)."""
         cfg = self.config
+        params = self._gather_toplevel(params)
         b, s = input_ids.shape
         if positions is None:
             positions = jnp.broadcast_to(jnp.arange(s)[None, :], (b, s))
@@ -790,6 +837,7 @@ class CausalLM:
 
     def head(self, params, x):
         """Hidden states -> logits [batch, seq, vocab] (compute dtype)."""
+        params = self._gather_toplevel(params)
         if self.config.tie_embeddings:
             return L.embedding_attend(params["wte"], x)
         return L.linear_apply(params["lm_head"], x)
@@ -800,6 +848,7 @@ class CausalLM:
         needs only the head leaves (wte / lm_head), so pipeline stages can pass
         a head-only subtree."""
         cfg = self.config
+        params = self._gather_toplevel(params)
         if cfg.fused_ce:
             from ..ops.cross_entropy import fused_cross_entropy
 

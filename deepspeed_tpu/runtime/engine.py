@@ -60,6 +60,44 @@ from .dataloader import DeepSpeedDataLoader
 
 DTYPES = {"float16": jnp.float16, "bfloat16": jnp.bfloat16, "float32": jnp.float32}
 
+# Compile-only construction switch (see abstract_init below).
+_ABSTRACT_INIT = False
+
+
+class abstract_init:
+    """Context manager: engines constructed inside build with ABSTRACT params.
+
+    ``self.params`` / ``self.optimizer_state`` become ``jax.ShapeDtypeStruct``
+    trees carrying the real shardings instead of device buffers, so the engine
+    can ``lower()``/``compile()`` its train step — AOT memory analysis, HLO
+    inspection, collective-volume accounting — without a single byte of model
+    state existing anywhere. This is the planning role the reference autotuner
+    fills with model-info estimation (``autotuning/autotuner.py``
+    ``_get_model_info``), made exact: the numbers come from the real compiled
+    program, not a formula. ``tools/scale_projection.py`` uses it to plan
+    OPT-13B ZeRO-3 on a 256-chip mesh from a CPU host (materializing the fp32
+    master would need ~156 GB of host RAM).
+
+    Execution APIs (``train_batch`` etc.) are unusable on such an engine.
+    """
+
+    def __enter__(self):
+        global _ABSTRACT_INIT
+        self._prev = _ABSTRACT_INIT
+        _ABSTRACT_INIT = True
+        return self
+
+    def __exit__(self, *exc):
+        global _ABSTRACT_INIT
+        _ABSTRACT_INIT = self._prev
+        return False
+
+
+def _abstract_tree(shape_tree, shardings):
+    return jax.tree_util.tree_map(
+        lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
+        shape_tree, shardings)
+
 
 class DeepSpeedEngine:
     def __init__(self, model, optimizer=None, model_parameters=None, training_data=None,
@@ -241,6 +279,23 @@ class DeepSpeedEngine:
                 is_leaf=lambda x: isinstance(x, P))
             self.module.config.zero3_per_layer_gather = True
             self.module.config.zero3_gather_specs = gather_specs
+            # Top-level params (embedding / head / final norm) need the same
+            # gather-before-use constraint: without it XLA propagates their
+            # raw ZeRO-3 sharding INTO the consuming matmul, and when the
+            # sharded dim is the contraction dim (e.g. vocab % dp != 0 makes
+            # logical_to_physical fall back to the d_model axis at dp=256)
+            # the partitioner partial-sums full-batch logits with giant
+            # all-reduces instead of gathering the 100 MB weight (observed:
+            # 8.6 TB/chip temps on the OPT-13B/256 projection). ZeRO-3
+            # discipline is gather-weights-compute-release; masters stay
+            # sharded either way.
+            if hasattr(self.module.config, "zero3_toplevel_gather_specs"):
+                self.module.config.zero3_toplevel_gather_specs = {
+                    k: jax.tree_util.tree_map(
+                        lambda s: P(*(None if a == DATA_AXIS else a
+                                      for a in tuple(s))),
+                        v, is_leaf=lambda x: isinstance(x, P))
+                    for k, v in self.param_specs.items() if k != "blocks"}
             log_dist("ZeRO-3 gather mode: per_layer (explicit schedule)",
                      ranks=[0])
 
@@ -368,8 +423,17 @@ class DeepSpeedEngine:
         if values is None:
             # init directly into the sharded layout: the zero.Init equivalent.
             init_fn = lambda rng: split_params_axes(self.module.init(rng))[0]
-            with self.mesh:
-                self.params = jax.jit(init_fn, out_shardings=self.param_shardings)(self._rng)
+            if _ABSTRACT_INIT:
+                self.params = _abstract_tree(
+                    jax.eval_shape(init_fn, self._rng), self.param_shardings)
+            else:
+                with self.mesh:
+                    self.params = jax.jit(init_fn, out_shardings=self.param_shardings)(self._rng)
+        elif _ABSTRACT_INIT:
+            self.params = _abstract_tree(
+                jax.tree_util.tree_map(
+                    lambda v: jax.ShapeDtypeStruct(v.shape, v.dtype), values),
+                self.param_shardings)
         else:
             self.params = jax.tree_util.tree_map(jax.device_put, values, self.param_shardings)
 
@@ -397,6 +461,10 @@ class DeepSpeedEngine:
         offload_cfg = self._config.zero_optimization.offload_optimizer
         self._offloaded = None
         if offload_cfg.device.value != "none":
+            if _ABSTRACT_INIT:
+                raise ConfigError(
+                    "abstract_init does not support optimizer offload (host "
+                    "masters are materialized at construction)")
             from .offload import OffloadedOptimizer
 
             self._offloaded = OffloadedOptimizer(
@@ -448,10 +516,17 @@ class DeepSpeedEngine:
         else:
             opt_state_specs = self._opt_state_specs(state_shape)
         self._opt_shardings = named(self.mesh, opt_state_specs)
-        with self.mesh:
-            self.optimizer_state = jax.jit(
-                self.optimizer.init, out_shardings=self._opt_shardings
-            )(self.params)
+        if _ABSTRACT_INIT:
+            self.optimizer_state = _abstract_tree(state_shape, self._opt_shardings)
+        else:
+            with self.mesh:
+                self.optimizer_state = jax.jit(
+                    self.optimizer.init, out_shardings=self._opt_shardings
+                )(self.params)
+        if self._onebit_active and _ABSTRACT_INIT:
+            raise ConfigError(
+                "abstract_init does not support 1-bit optimizers (their "
+                "error-feedback buffers are materialized at construction)")
         if self._onebit_active:
             dp = self.mesh.shape[DATA_AXIS]
             L = self.num_parameters

@@ -45,6 +45,10 @@ DEADLINE = float(os.environ.get("BENCH_SESSION_DEADLINE", "0") or 0)
 # after the previous one exits can wedge the tunnel for hours.
 HANDOFF_S = float(os.environ.get("BENCH_HANDOFF_DELAY", "60"))
 
+# Exit code a child uses to report "deadline passed" — distinct from 0 so the
+# orchestrator can't mistake a deadline expiry for a successful connect.
+DEADLINE_RC = 3
+
 
 def past_deadline():
     return DEADLINE > 0 and time.time() > DEADLINE
@@ -53,6 +57,28 @@ def past_deadline():
 # ---------------------------------------------------------------------------
 # Orchestrator side (no jax in this process, ever)
 # ---------------------------------------------------------------------------
+
+def _kill_session(proc, collect_output=False):
+    """SIGTERM-grace-SIGKILL a child's whole session; returns late output."""
+    out = ""
+    for sig, grace in ((signal.SIGTERM, 20), (signal.SIGKILL, 10)):
+        try:
+            os.killpg(proc.pid, sig)
+        except (ProcessLookupError, PermissionError):
+            pass
+        try:
+            if collect_output:
+                out2, _ = proc.communicate(timeout=grace)
+                out = out2 or out
+            else:
+                proc.wait(timeout=grace)
+            break
+        except subprocess.TimeoutExpired:
+            pass
+        except Exception:
+            break
+    return out
+
 
 def _run(args, timeout_s):
     """argv in its own session with SIGTERM-grace-SIGKILL semantics."""
@@ -67,19 +93,7 @@ def _run(args, timeout_s):
         if isinstance(out, bytes):
             out = out.decode("utf-8", "replace")
         out = out or ""
-        for sig, grace in ((signal.SIGTERM, 20), (signal.SIGKILL, 10)):
-            try:
-                os.killpg(proc.pid, sig)
-            except (ProcessLookupError, PermissionError):
-                pass
-            try:
-                out2, _ = proc.communicate(timeout=grace)
-                out = out2 or out
-                break
-            except subprocess.TimeoutExpired:
-                pass
-            except Exception:
-                break
+        out = _kill_session(proc, collect_output=True) or out
         return None, out
 
 
@@ -152,10 +166,53 @@ def bank_headline(stage, max_attempts=10**9, interval_s=120.0):
     return None
 
 
+def wait_for_backend():
+    """Patient knock: ONE child blocked in backend init until the TPU answers.
+
+    This is the documented remedy for a down/wedged tunnel (PERF.md
+    "Environment caveat"): a kill-retry probe loop adds killed-mid-init TPU
+    processes to the wedge, while a single process parked in ``jax.devices()``
+    genuinely re-attempts (~25 min per failed init) and connects the moment
+    the claim frees. The child is ``--wait`` mode: _connect() then exit 0,
+    releasing the claim for the banker that follows.
+    """
+    while not past_deadline():
+        budget = (DEADLINE - time.time()) if DEADLINE else 12 * 3600
+        if budget < 60:
+            return False
+        proc = subprocess.Popen(
+            [sys.executable, "-u", os.path.abspath(__file__), "--wait"],
+            start_new_session=True)
+        try:
+            rc = proc.wait(timeout=budget)
+            if rc == 0:
+                return True
+            if rc == DEADLINE_RC:
+                # the child saw past_deadline() itself — NOT a connect
+                return False
+            print(f"orchestrator: wait child exited rc={rc}; restarting it",
+                  flush=True)
+            time.sleep(60)
+        except subprocess.TimeoutExpired:
+            # deadline: one TERM (the child prints and dies; a blocked init
+            # has no claim to release), no KILL unless it lingers
+            _kill_session(proc)
+            return False
+    return False
+
+
 def orchestrate():
     print(f"chip_session orchestrator: deadline="
           f"{time.strftime('%H:%M:%S', time.localtime(DEADLINE)) if DEADLINE else 'none'}",
           flush=True)
+    # 0. park one patient process in backend init until the tunnel answers
+    if not wait_for_backend():
+        print("orchestrator: deadline passed while waiting for the backend",
+              flush=True)
+        return 1
+    print("orchestrator: backend answered — banking via the driver path",
+          flush=True)
+    time.sleep(HANDOFF_S)
     # 1. bank the official number via the driver's own path
     rec = bank_headline("pre-session")
     if rec is None:
@@ -328,7 +385,7 @@ def _connect():
             print("session deadline passed before a connect landed — "
                   "exiting so the claim is free for the driver's bench run",
                   flush=True)
-            sys.exit(0)
+            sys.exit(DEADLINE_RC)
         attempt += 1
         t0 = time.time()
         try:
@@ -395,6 +452,10 @@ def measure():
 def main():
     if "--measure" in sys.argv:
         return measure() or 0
+    if "--wait" in sys.argv:
+        sys.argv = [sys.argv[0]]
+        _connect()   # blocks until the backend answers (or deadline exits)
+        return 0     # release the claim for the banker
     return orchestrate()
 
 
