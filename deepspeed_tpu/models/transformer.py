@@ -585,14 +585,10 @@ def stack_apply(cfg, stacked_params, x, mask=None, rope=None, alibi=None,
             p, specs)
 
     def body(p, h, rng, m):
-        # ZeRO-3 per_layer schedule, all INSIDE the remat region (the bwd
+        # ZeRO-3 per_layer gather, INSIDE the remat region: the bwd
         # re-gathers instead of saving 40 layers of gathered weights as scan
-        # residuals — measured 50 GB/chip on the OPT-13B/256 projection when
-        # the gather sat outside jax.checkpoint): pin the fp32 masters to
-        # their sharded layout, cast, then constrain to the gathered layout —
-        # the reshard is forced onto the bf16 side of the cast (half the
-        # wire; without the sharded pin the partitioner hoists the gather to
-        # fp32 — measured 2x on the same projection).
+        # residuals (measured +50 GB/chip on the OPT-13B/256 projection when
+        # the gather sat outside jax.checkpoint).
         if cfg.zero3_per_layer_gather and cfg.zero3_gather_specs is not None:
             # Known 2x: the partitioner gathers the fp32 master and converts
             # after (it reshards an elementwise op's input to match the
@@ -928,12 +924,14 @@ class MaskedLM(CausalLM):
         return L.layernorm_apply(params["mlm_ln"], h, eps=cfg.layernorm_eps)
 
     def head(self, params, x):
+        params = self._gather_toplevel(params)
         h = self._mlm_transform(params, x)
         logits = L.embedding_attend(params["wte"], h)
         return logits + params["mlm_bias"]["bias"].astype(logits.dtype)
 
     def head_ce(self, params, x, labels):
         cfg = self.config
+        params = self._gather_toplevel(params)
         h = self._mlm_transform(params, x)
         if cfg.fused_ce:
             from ..ops.cross_entropy import fused_cross_entropy

@@ -14,4 +14,8 @@ from .hf import (  # noqa: F401
     load_hf_checkpoint,
     hf_model_from_pretrained,
 )
+from .megatron import (  # noqa: F401
+    load_megatron_checkpoint,
+    megatron_model_from_checkpoint,
+)
 from .policy import apply_injection_policy  # noqa: F401

@@ -177,8 +177,11 @@ def wait_for_backend():
     releasing the claim for the banker that follows.
     """
     while not past_deadline():
-        budget = (DEADLINE - time.time()) if DEADLINE else 12 * 3600
-        if budget < 60:
+        # no deadline -> wait forever (the knocker child retries internally;
+        # a silent cap here would abort with a bogus "deadline passed" after
+        # a long outage — recoveries can land at any hour, PERF.md)
+        budget = (DEADLINE - time.time()) if DEADLINE else None
+        if budget is not None and budget < 60:
             return False
         proc = subprocess.Popen(
             [sys.executable, "-u", os.path.abspath(__file__), "--wait"],
