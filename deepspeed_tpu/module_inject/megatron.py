@@ -74,7 +74,8 @@ def _load_rank(f):
     lm = model.get("language_model", model)
     emb = lm.get("embedding", {})
     trans = lm.get("transformer", lm.get("encoder", {}))
-    return {"embedding": emb, "transformer": trans, "version": version}
+    return {"embedding": emb, "transformer": trans, "version": version,
+            "args": sd.get("args")}
 
 
 def _np(t):
@@ -130,10 +131,7 @@ def load_megatron_checkpoint(path, config=None, dtype=np.float32,
 
     # model shape: explicit config > checkpoint args > inference from tensors
     if config is None:
-        import torch
-
-        sd0 = torch.load(files[0], map_location="cpu", weights_only=False)
-        args = sd0.get("args")
+        args = ranks[0]["args"]
         d_model = _np(t0["final_layernorm.weight"]).shape[0]
         if args is not None:
             cfg_kw = dict(
@@ -200,17 +198,12 @@ def load_megatron_checkpoint(path, config=None, dtype=np.float32,
             },
         })
 
-    emb0 = ranks[0]["embedding"]
+    def rank_emb(r, sub):
+        node = r["embedding"][sub]
+        return _np(node["weight"] if isinstance(node, dict) else node)
 
-    def emb_get(sub, key="weight"):
-        node = emb0[sub]
-        return node[key] if isinstance(node, dict) else node
-
-    wte = np.concatenate(
-        [_np(r["embedding"][
-            "word_embeddings"]["weight"]
-            if isinstance(r["embedding"]["word_embeddings"], dict)
-            else r["embedding"]["word_embeddings"]) for r in ranks], axis=0)
+    wte = np.concatenate([rank_emb(r, "word_embeddings") for r in ranks],
+                         axis=0)
     if wte.shape[0] < config.vocab_size:
         raise ValueError(
             f"merged vocab {wte.shape[0]} < config.vocab_size "
@@ -223,7 +216,7 @@ def load_megatron_checkpoint(path, config=None, dtype=np.float32,
         lambda *xs: np.stack(xs).astype(dtype), *blocks)
     values = {
         "wte": {"weight": np.asarray(wte, dtype)},
-        "wpe": {"weight": np.asarray(_np(emb_get("position_embeddings")),
+        "wpe": {"weight": np.asarray(rank_emb(ranks[0], "position_embeddings"),
                                      dtype)},
         "blocks": stacked,
         "ln_f": {"scale": np.asarray(rank0("final_layernorm.weight"), dtype),

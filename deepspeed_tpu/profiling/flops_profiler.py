@@ -137,12 +137,70 @@ def get_model_profile(model, batch, *, loss=False, n_iters=5, print_profile=True
 #
 # The reference collects these with forward hooks on every nn.Module. Under XLA
 # the whole model is ONE fused program, so per-module walltime is not separately
-# observable; instead: params are grouped EXACTLY from the param tree, per-module
-# flops come from the analytic decomposition of the transformer forward, and
-# measured end-to-end latency is attributed proportionally to flops share (stated
-# in the report). The module rows sum to the whole-program totals by construction
-# — pinned by tests/unit/test_aux.py.
+# observable from inside it; instead the profile MEASURES prefix programs
+# (embedding -> backbone -> full forward) and attributes each stage its
+# difference — real wall time, so memory-bound stages (embedding gather, the
+# vocab-sized head matmul) no longer inherit GEMM-shaped estimates. Within the
+# blocks stage, attn/mlp/ln split the MEASURED blocks time by flops share
+# (marked basis="apportioned" — the reference's hook granularity without
+# per-op tracing). Params are grouped exactly from the param tree; module rows
+# sum to the whole-program totals by construction — pinned by
+# tests/unit/test_aux.py.
 # ---------------------------------------------------------------------------------
+
+
+def _measure_stage_latencies(model, params, ids, n_iters, full_ms):
+    """Measured wall time for the embedding and backbone prefix programs.
+
+    Returns ``(embed_ms, backbone_ms, full_ms)`` — cumulative, monotone
+    (clamped against timer noise). Each prefix is its own jitted program with
+    the same shapes, so stage time = difference of adjacent prefixes. The
+    full forward is NOT re-measured — ``full_ms`` comes from the
+    whole-program measurement the caller already made (re-jitting
+    ``model.apply`` here would add a redundant full-size compile).
+    """
+    import time as _time
+
+    import jax.numpy as jnp
+
+    cfg = model.config
+    ids = jnp.asarray(ids)
+
+    def embed_fn(p):
+        from ..models import layers as L
+        from ..models.transformer import _norm_apply
+
+        x = L.embedding_apply(p["wte"], ids, cfg.compute_dtype)
+        s = ids.shape[1]
+        if getattr(cfg, "position_embedding", "") == "learned":
+            x = x + jnp.take(p["wpe"]["weight"].astype(cfg.compute_dtype),
+                             jnp.arange(s), axis=0)[None]
+        if getattr(cfg, "type_vocab_size", 0) and "wtt" in p:
+            # segment-0 default, matching MaskedLM.apply's injected zeros
+            x = x + jnp.take(p["wtt"]["weight"].astype(cfg.compute_dtype),
+                             jnp.zeros((s,), jnp.int32), axis=0)[None]
+        if getattr(cfg, "embed_layernorm", False) and "ln_emb" in p:
+            x = _norm_apply(cfg, p["ln_emb"], x)
+        return x
+
+    def backbone_fn(p):
+        kw = {}
+        if getattr(cfg, "type_vocab_size", 0):
+            kw["token_type_ids"] = jnp.zeros_like(ids)
+        return model.backbone(p, ids, **kw)[0]
+
+    out = []
+    for fn in (embed_fn, backbone_fn):
+        jfn = jax.jit(fn)
+        jax.block_until_ready(jfn(params))  # compile + warm
+        t0 = _time.perf_counter()
+        for _ in range(n_iters):
+            r = jfn(params)
+        jax.block_until_ready(r)
+        out.append((_time.perf_counter() - t0) / n_iters * 1e3)
+    embed_ms, backbone_ms = out
+    backbone_ms = max(backbone_ms, embed_ms)
+    return embed_ms, backbone_ms, max(full_ms, backbone_ms)
 def _module_param_counts(params):
     """Group exact param counts by module path: top-level entries, with the
     stacked ``blocks`` subtree split by submodule (attn/mlp/ln_*)."""
@@ -230,16 +288,65 @@ def get_module_profile(model, batch, *, n_iters=5, print_profile=True):
     flops = _module_flops(model.config, b, s)
     names = sorted(set(param_counts) | set(flops))
     total_flops = sum(flops.values())
+
+    # measured stage times: embedding, blocks (backbone - embed; ln_f rides
+    # here, its flops share is noise), head (full - backbone)
+    try:
+        embed_ms, backbone_ms, full_ms = _measure_stage_latencies(
+            model, params, ids, n_iters, full_ms=latency_ms)
+        # stages sum to full_ms; rescale to the canonical whole-program
+        # latency so module rows keep summing EXACTLY to the totals row even
+        # when timer noise made the clamped full_ms differ from latency_ms
+        scale = latency_ms / full_ms if full_ms else 1.0
+        stage_ms = {"embed": embed_ms * scale,
+                    "blocks": (backbone_ms - embed_ms) * scale,
+                    "head": (full_ms - backbone_ms) * scale}
+        measured = True
+    except Exception as e:  # non-transformer model: flops-share fallback
+        logger.warning(f"stage measurement unavailable ({e}); "
+                       "falling back to flops-share latency attribution")
+        stage_ms = None
+        measured = False
+
+    def stage_of(name):
+        if name in ("wte", "wpe", "wtt", "ln_emb"):
+            return "embed"
+        if name.startswith("blocks") or name == "ln_f":
+            return "blocks"
+        return "head"  # lm_head / mlm_* / pooler
+
+    blocks_flops = sum(f for n, f in flops.items() if stage_of(n) == "blocks")
     modules = {}
     for name in names:
         f = flops.get(name, 0.0)
         share = f / total_flops if total_flops else 0.0
+        if stage_ms is None:
+            lat, basis = latency_ms * share, "apportioned"
+        elif stage_of(name) == "blocks":
+            # split the MEASURED blocks stage by flops share
+            bshare = f / blocks_flops if blocks_flops else 0.0
+            lat, basis = stage_ms["blocks"] * bshare, "apportioned"
+        else:
+            # embed/head stages: measured; split within the stage by params
+            # (gather-bound rows, e.g. wte/wpe) or by flops when the stage
+            # has no params of its own (tied lm_head owns the head matmul's
+            # flops but zero params — param-weighting would drop the stage)
+            stage = stage_of(name)
+            peers = [n for n in names if stage_of(n) == stage]
+            weights = {n: float(param_counts.get(n, 0)) for n in peers}
+            if not any(weights.values()):
+                weights = {n: flops.get(n, 0.0) for n in peers}
+            if not any(weights.values()):
+                weights = {n: 1.0 for n in peers}
+            lat = stage_ms[stage] * weights[name] / sum(weights.values())
+            basis = "measured-stage"
         modules[name] = {
             "params": param_counts.get(name, 0),
             "flops": f,
             "macs": f / 2,
-            "latency_ms": latency_ms * share,  # flops-proportional attribution
+            "latency_ms": lat,
             "flops_pct": 100.0 * share,
+            "basis": basis,
         }
     total = {
         "params": sum(param_counts.values()),
@@ -248,15 +355,21 @@ def get_module_profile(model, batch, *, n_iters=5, print_profile=True):
         "latency_ms": latency_ms,
         "xla_flops": stats["flops"],  # the compiler's own count, for reference
     }
+    if measured:
+        total["stage_latency_ms"] = {k: round(v, 3)
+                                     for k, v in stage_ms.items()}
     if print_profile:
-        top = sorted(modules.items(), key=lambda kv: -kv[1]["flops"])
-        lines = [f"{'module':<14} {'params':>10} {'flops':>10} {'lat ms':>8} {'%':>6}"]
+        top = sorted(modules.items(), key=lambda kv: -kv[1]["latency_ms"])
+        lines = [f"{'module':<14} {'params':>10} {'flops':>10} {'lat ms':>8} "
+                 f"{'%':>6}  basis"]
         for name, m in top:
             lines.append(f"{name:<14} {_fmt(m['params']):>10} {_fmt(m['flops']):>10} "
-                         f"{m['latency_ms']:>8.2f} {m['flops_pct']:>5.1f}%")
+                         f"{m['latency_ms']:>8.2f} {m['flops_pct']:>5.1f}%  "
+                         f"{m['basis']}")
+        how = ("stages measured via prefix programs"
+               if measured else "latency attributed by flops share")
         lines.append(f"{'TOTAL':<14} {_fmt(total['params']):>10} "
                      f"{_fmt(total['flops']):>10} {latency_ms:>8.2f} {'100.0%':>6} "
-                     f"(latency attributed by flops share; xla counted "
-                     f"{_fmt(total['xla_flops'])}flops)")
+                     f"({how}; xla counted {_fmt(total['xla_flops'])}flops)")
         logger.info("\n".join(lines))
     return {"modules": modules, "total": total}
