@@ -159,12 +159,21 @@ def _measure_stage_latencies(model, params, ids, n_iters, full_ms):
     whole-program measurement the caller already made (re-jitting
     ``model.apply`` here would add a redundant full-size compile).
     """
-    import time as _time
-
     import jax.numpy as jnp
 
     cfg = model.config
     ids = jnp.asarray(ids)
+
+    # token-type injection must mirror what the PROFILED full program does:
+    # MaskedLM.apply injects zero segments when type_vocab_size > 0,
+    # CausalLM.apply has no token_type path at all — adding the wtt gather to
+    # a prefix the full program lacks would overshoot backbone_ms and clamp
+    # the head stage to zero
+    import inspect
+
+    inject_tt = (getattr(cfg, "type_vocab_size", 0)
+                 and "token_type_ids" in inspect.signature(
+                     model.apply).parameters)
 
     def embed_fn(p):
         from ..models import layers as L
@@ -175,7 +184,7 @@ def _measure_stage_latencies(model, params, ids, n_iters, full_ms):
         if getattr(cfg, "position_embedding", "") == "learned":
             x = x + jnp.take(p["wpe"]["weight"].astype(cfg.compute_dtype),
                              jnp.arange(s), axis=0)[None]
-        if getattr(cfg, "type_vocab_size", 0) and "wtt" in p:
+        if inject_tt and "wtt" in p:
             # segment-0 default, matching MaskedLM.apply's injected zeros
             x = x + jnp.take(p["wtt"]["weight"].astype(cfg.compute_dtype),
                              jnp.zeros((s,), jnp.int32), axis=0)[None]
@@ -184,23 +193,21 @@ def _measure_stage_latencies(model, params, ids, n_iters, full_ms):
         return x
 
     def backbone_fn(p):
-        kw = {}
-        if getattr(cfg, "type_vocab_size", 0):
-            kw["token_type_ids"] = jnp.zeros_like(ids)
+        kw = {"token_type_ids": jnp.zeros_like(ids)} if inject_tt else {}
         return model.backbone(p, ids, **kw)[0]
 
     out = []
     for fn in (embed_fn, backbone_fn):
-        jfn = jax.jit(fn)
-        jax.block_until_ready(jfn(params))  # compile + warm
-        t0 = _time.perf_counter()
-        for _ in range(n_iters):
-            r = jfn(params)
-        jax.block_until_ready(r)
-        out.append((_time.perf_counter() - t0) / n_iters * 1e3)
+        # AOT path (FlopsProfiler), matching how the full program was timed —
+        # jit python-dispatch overhead on the prefixes would bias the stage
+        # differences on small models
+        stats = FlopsProfiler(fn).measure(params, n_iters=n_iters)
+        out.append(stats["latency_s"] * 1e3)
     embed_ms, backbone_ms = out
     backbone_ms = max(backbone_ms, embed_ms)
     return embed_ms, backbone_ms, max(full_ms, backbone_ms)
+
+
 def _module_param_counts(params):
     """Group exact param counts by module path: top-level entries, with the
     stacked ``blocks`` subtree split by submodule (attn/mlp/ln_*)."""
@@ -327,15 +334,17 @@ def get_module_profile(model, batch, *, n_iters=5, print_profile=True):
             bshare = f / blocks_flops if blocks_flops else 0.0
             lat, basis = stage_ms["blocks"] * bshare, "apportioned"
         else:
-            # embed/head stages: measured; split within the stage by params
-            # (gather-bound rows, e.g. wte/wpe) or by flops when the stage
-            # has no params of its own (tied lm_head owns the head matmul's
-            # flops but zero params — param-weighting would drop the stage)
+            # embed/head stages: measured; split within the stage by flops
+            # first (the tied lm_head owns the head matmul's flops but zero
+            # params — param-first weighting would zero the dominant row
+            # whenever any peer has params, e.g. MaskedLM's mlm_transform),
+            # falling back to params for all-gather stages (wte/wpe: no
+            # flops), then to an even split
             stage = stage_of(name)
             peers = [n for n in names if stage_of(n) == stage]
-            weights = {n: float(param_counts.get(n, 0)) for n in peers}
+            weights = {n: flops.get(n, 0.0) for n in peers}
             if not any(weights.values()):
-                weights = {n: flops.get(n, 0.0) for n in peers}
+                weights = {n: float(param_counts.get(n, 0)) for n in peers}
             if not any(weights.values()):
                 weights = {n: 1.0 for n in peers}
             lat = stage_ms[stage] * weights[name] / sum(weights.values())
