@@ -223,11 +223,13 @@ def _module_param_counts(params):
     return counts
 
 
-def _module_flops(cfg, batch_size, seq_len):
+def _module_flops(cfg, batch_size, seq_len, param_names=()):
     """Analytic forward flops per module (2*in*out per matmul output element).
 
     Embedding lookups are gathers (0 MACs, as the reference counts them); the
     LM-head matmul is attributed to ``lm_head`` even when tied to ``wte``.
+    ``param_names`` (from the real tree) switches on rows for model variants
+    the config alone can't see (MaskedLM's mlm head).
     """
     T = batch_size * seq_len
     d = cfg.d_model
@@ -275,6 +277,13 @@ def _module_flops(cfg, batch_size, seq_len):
         flops["wpe"] = 0.0
     if getattr(cfg, "final_layernorm", True):
         flops["ln_f"] = float(norm)
+    if "mlm_transform" in param_names:
+        # MaskedLM head: dense d->d transform + gelu + LN + output bias add —
+        # without these rows the measured head stage would be attributed
+        # entirely to lm_head (the only head peer with flops)
+        flops["mlm_transform"] = float(2 * T * d * d)
+        flops["mlm_ln"] = float(norm)
+        flops["mlm_bias"] = float(T * cfg.vocab_size)
     return flops
 
 
@@ -292,7 +301,7 @@ def get_module_profile(model, batch, *, n_iters=5, print_profile=True):
     latency_ms = stats["latency_s"] * 1e3
 
     param_counts = _module_param_counts(params)
-    flops = _module_flops(model.config, b, s)
+    flops = _module_flops(model.config, b, s, param_names=set(param_counts))
     names = sorted(set(param_counts) | set(flops))
     total_flops = sum(flops.values())
 

@@ -88,6 +88,55 @@ def bench_one(engine, prompt_len, new_tokens, batch, repeats, rng):
     return ttft_p50 * 1e3, ttft_p95 * 1e3, decode_tok_s
 
 
+def project_bloom_7b1(measured_hbm_util, peak_bw_gbs, prompt=512,
+                      mfu_prior=0.4157, dispatch_ms=8.0):
+    """Analytic BLOOM-7B1 TP=8 v5e-8 TTFT from this rig's measured signals.
+
+    Components (BLOOM-7B1: 7.07B params, 30 layers, d_model 14336/4... the
+    public card: hidden 4096, 30 layers, 32 heads):
+    - prefill compute: 2*P*prompt flops over 8 chips at the measured
+      single-chip MFU prior (flash prefill, bf16);
+    - prefill TP collectives: 2 all-reduces/layer of the [1, prompt, d]
+      activation over ICI (ring, 2x(N-1)/N wire) at v5e's ~180 GB/s
+      per-chip ICI (4 links x 45 GB/s);
+    - first decode token: per-chip weight bytes / (measured HBM util x peak)
+      + per-layer all-reduce latency floor (~20 us each);
+    - dispatch floor: a serving-host estimate (NOT this rig's ~70 ms tunnel
+      overhead — stated as an assumption).
+    """
+    P = 7.07e9
+    n_layers, d_model, n_chips = 30, 4096, 8
+    peak_flops = 197e12
+    ici_bw = 180e9
+
+    prefill_flops = 2.0 * P * prompt
+    t_prefill = prefill_flops / (n_chips * peak_flops * mfu_prior)
+    ar_bytes = prompt * d_model * 2  # bf16 activation
+    wire = 2 * ar_bytes * (n_chips - 1) / n_chips
+    t_coll = n_layers * 2 * wire / ici_bw
+    w_per_chip = P * 2 / n_chips
+    t_decode1 = (w_per_chip / (measured_hbm_util * peak_bw_gbs * 1e9)
+                 + n_layers * 2 * 20e-6)
+    ttft_ms = (t_prefill + t_coll + t_decode1) * 1e3 + dispatch_ms
+    print(json.dumps({
+        "projection": "bloom-7b1-v5e-8-ttft",
+        "prompt_len": prompt,
+        "ttft_ms": round(ttft_ms, 1),
+        "components_ms": {
+            "prefill_compute": round(t_prefill * 1e3, 2),
+            "prefill_collectives": round(t_coll * 1e3, 2),
+            "first_decode_token": round(t_decode1 * 1e3, 2),
+            "dispatch_floor_assumed": dispatch_ms,
+        },
+        "inputs": {
+            "measured_hbm_util": round(measured_hbm_util, 3),
+            "mfu_prior": mfu_prior,
+            "ici_bw_gbs": ici_bw / 1e9,
+        },
+        "baseline_bar_ms": 55.0,
+    }), flush=True)
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--family", default="gpt2")
@@ -186,6 +235,19 @@ def main():
         print(f"| {r['model']} | {r['mode']} | {r['prompt_len']} "
               f"| {r['ttft_p50_ms']} | {r['ttft_p95_ms']} | {r['decode_tok_s']} "
               f"| {r['achieved_gbs']} | {100 * r['hbm_util']:.0f}% |")
+
+    # BLOOM-7B1 v5e-8 TTFT projection (VERDICT r4 #3): the BASELINE.md bar
+    # (~55 ms p50, init_inference TP=8) cannot be measured on a 1-chip rig
+    # whose TTFT is ~95% fixed dispatch overhead — restate it from what IS
+    # measurable here: decode HBM utilization (bloom bf16 rows above) + an
+    # ICI collective model + the measured single-chip MFU prior.
+    # gated on a real TPU (same rule as the offload block below): a CPU smoke
+    # or a non-v5e rig would feed the v5e-specific model garbage utilization
+    if args.family == "bloom" and platform == "tpu":
+        bloom_bf16 = [r for r in rows if r["mode"] == "bf16"]
+        if bloom_bf16:
+            hbm_util = max(r["hbm_util"] for r in bloom_bf16)
+            project_bloom_7b1(hbm_util, peak_bw)
 
     # Offload-tax chaining (2026-08-01): the chip session running when the
     # offload phase landed imports this module lazily at serving time, so

@@ -128,6 +128,25 @@ def main():
         # carry pins per-layer buffers; unrolled lets XLA free them), and
         # maxq (whole-seq q tile) scored 0.3981 scanned — so the next-order
         # compounds are noscan x maxq and b16 x huge x noremat:
+        # r5 compounds on the 0.4157 winner (noscan-flash-huge-noremat-b12):
+        # b14 probes the unexplored gap between b12 (won) and b16 (never
+        # compiled under noremat); ce4 doubles the CE head-GEMM width (the
+        # measured ce4-b12 win composed with the winner)
+        ("noscan-flash-huge-noremat-b14", {"scan_layers": False,
+                                           "attention_impl": "flash",
+                                           "flash_block_q": 512,
+                                           "flash_block_kv": 1024,
+                                           "flash_block_q_bwd": 512,
+                                           "flash_block_kv_bwd": 1024,
+                                           "remat": False}, 14),
+        ("noscan-flash-huge-noremat-ce4-b12", {"scan_layers": False,
+                                               "attention_impl": "flash",
+                                               "flash_block_q": 512,
+                                               "flash_block_kv": 1024,
+                                               "flash_block_q_bwd": 512,
+                                               "flash_block_kv_bwd": 1024,
+                                               "remat": False,
+                                               "fused_ce_chunks": 4}, 12),
         ("noscan-flash-maxq-b12", {"scan_layers": False,
                                    "attention_impl": "flash",
                                    "flash_block_q": 1024,
