@@ -320,7 +320,23 @@ class Autotuner:
                 if est_key in est_cache:
                     fwd_peak, fwd_est, n_params = est_cache[est_key]
                 else:
-                    engine = self._build_engine(cfg)
+                    # COMPILE-ONLY estimation engine (abstract_init): params/
+                    # opt-state are ShapeDtypeStructs, so estimation holds
+                    # ZERO device bytes. This retires the r4 failure mode
+                    # for good — estimation engines each pinned ~9x n_params
+                    # bytes via engine<->jit-closure gc cycles and exhausted
+                    # the 16 GB chip before the measure phase (2026-08-01:
+                    # every measure -> RESOURCE_EXHAUSTED -> "no viable
+                    # candidate"). Built from the offload-STRIPPED config:
+                    # the fwd_bwd program is identical (offload only changes
+                    # the host-side step, accounted analytically below) and
+                    # abstract engines don't support host masters anyway.
+                    from ..runtime.engine import abstract_init
+
+                    est_cfg = dict(cfg)
+                    est_cfg["zero_optimization"] = zero_cfg
+                    with abstract_init():
+                        engine = self._build_engine(est_cfg)
                     try:
                         compiled, _, _ = self._lower_step(engine, batch)
                         n_params = engine.num_parameters
@@ -330,15 +346,6 @@ class Autotuner:
                         fwd_peak, fwd_est = self._estimate(
                             compiled, n_params, tokens_micro)
                     finally:
-                        # free the candidate's device state NOW: params +
-                        # fp32 master + adam m/v are ~9x n_params bytes per
-                        # engine, and the engine<->jit-closure gc cycles pin
-                        # them until a full collection. Leaving 4+ estimation
-                        # engines live exhausted the 16 GB chip before the
-                        # measure phase even started (observed 2026-08-01:
-                        # every measure -> RESOURCE_EXHAUSTED -> "no viable
-                        # candidate", and the leak outlived tune() and killed
-                        # every later phase of the claim session).
                         engine.destroy()
                     est_cache[est_key] = (fwd_peak, fwd_est, n_params)
             except Exception as e:  # compile/shape failures prune the candidate

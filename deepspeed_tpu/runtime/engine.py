@@ -279,22 +279,35 @@ class DeepSpeedEngine:
                 is_leaf=lambda x: isinstance(x, P))
             self.module.config.zero3_per_layer_gather = True
             self.module.config.zero3_gather_specs = gather_specs
-            # Top-level params (embedding / head / final norm) need the same
-            # gather-before-use constraint: without it XLA propagates their
-            # raw ZeRO-3 sharding INTO the consuming matmul, and when the
-            # sharded dim is the contraction dim (e.g. vocab % dp != 0 makes
-            # logical_to_physical fall back to the d_model axis at dp=256)
-            # the partitioner partial-sums full-batch logits with giant
+            # Top-level params (embedding / head / final norm) need a
+            # gather-before-use constraint WHEN their ZeRO-3 shard landed on
+            # the d_model ("embed") axis: that axis is the contraction dim of
+            # the consuming matmul, and propagating it in makes the
+            # partitioner partial-sum full-batch logits with giant
             # all-reduces instead of gathering the 100 MB weight (observed:
-            # 8.6 TB/chip temps on the OPT-13B/256 projection). ZeRO-3
-            # discipline is gather-weights-compute-release; masters stay
-            # sharded either way.
+            # 8.6 TB/chip temps on the OPT-13B/256 projection, where
+            # vocab % 256 != 0 forced logical_to_physical onto d_model).
+            # A vocab-axis shard is LEFT ALONE — vocab-parallel CE is the
+            # better program (each device computes its logits slice with a
+            # full contraction; measured cheaper at dp=8 than gathering).
+            # ZeRO-3 discipline either way: masters stay sharded.
             if hasattr(self.module.config, "zero3_toplevel_gather_specs"):
+                def _strip_embed_axis(axes, spec):
+                    # strip the data shard from every axis EXCEPT vocab: a
+                    # vocab shard means vocab-parallel CE (keep); any other
+                    # placement (embed, unnamed, seq_table) sits on a
+                    # contraction/gather dim of the consumer and must be
+                    # gathered before use
+                    return P(*(None if (s == DATA_AXIS and a != "vocab")
+                               else s
+                               for a, s in zip(axes, tuple(spec))))
+
+                is_axes = lambda x: isinstance(x, tuple) and all(
+                    isinstance(a, (str, type(None))) for a in x)
                 self.module.config.zero3_toplevel_gather_specs = {
                     k: jax.tree_util.tree_map(
-                        lambda s: P(*(None if a == DATA_AXIS else a
-                                      for a in tuple(s))),
-                        v, is_leaf=lambda x: isinstance(x, P))
+                        _strip_embed_axis, self._axes[k], v,
+                        is_leaf=is_axes)
                     for k, v in self.param_specs.items() if k != "blocks"}
             log_dist("ZeRO-3 gather mode: per_layer (explicit schedule)",
                      ranks=[0])

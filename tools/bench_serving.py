@@ -241,9 +241,12 @@ def main():
     # whose TTFT is ~95% fixed dispatch overhead — restate it from what IS
     # measurable here: decode HBM utilization (bloom bf16 rows above) + an
     # ICI collective model + the measured single-chip MFU prior.
-    # gated on a real TPU (same rule as the offload block below): a CPU smoke
-    # or a non-v5e rig would feed the v5e-specific model garbage utilization
-    if args.family == "bloom" and platform == "tpu":
+    # gated on a real v5e TPU: a CPU smoke or a non-v5e rig would feed the
+    # v5e-specific model (197 TFLOPs, 180 GB/s ICI, 819 GB/s HBM) another
+    # chip's utilization
+    kind = jax.devices()[0].device_kind.lower().replace(" ", "")
+    if (args.family == "bloom" and platform == "tpu"
+            and ("v5e" in kind or "v5lite" in kind)):
         bloom_bf16 = [r for r in rows if r["mode"] == "bf16"]
         if bloom_bf16:
             hbm_util = max(r["hbm_util"] for r in bloom_bf16)
