@@ -1,0 +1,72 @@
+"""Pin tools/scale_projection.py's HLO collective accounting.
+
+The parser feeds the v4-256 projection artifact (PERF.md); its two subtle
+rules — while-body ops multiplied by the loop trip count, and async
+``-start`` ops reading the OUTPUT element of their result tuple — were both
+sources of silent 40-256x accounting errors when first written, so they are
+pinned here against a hand-built HLO snippet.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "tools"))
+
+from scale_projection import parse_collectives  # noqa: E402
+
+HLO = """
+HloModule test
+
+%wide.body.1 (arg: f32[8]) -> f32[8] {
+  %p = f32[8] parameter(0)
+  %ag = f32[1024,1024] all-gather(f32[128,1024] %x), dimensions={0}
+  ROOT %r = f32[8] add(%p, %p)
+}
+
+%cond.1 (arg: f32[8]) -> pred[] {
+  %p = f32[8] parameter(0)
+  ROOT %c = pred[] constant(true)
+}
+
+ENTRY %main (a: f32[128,1024]) -> f32[1024,1024] {
+  %a = f32[128,1024] parameter(0)
+  %w = f32[8] while(f32[8] %init), condition=%cond.1, body=%wide.body.1
+  %ags = (f32[128,1024], f32[1024,1024]) all-gather-start(f32[128,1024] %a), dimensions={0}
+  %agd = f32[1024,1024] all-gather-done((f32[128,1024], f32[1024,1024]) %ags)
+  %ar = f32[512,64] all-reduce(f32[512,64] %b), to_apply=%sum
+  ROOT %out = f32[1024,1024] copy(%agd)
+}
+"""
+
+
+def test_body_ops_multiplied_by_trip_count():
+    stats = parse_collectives(HLO, n_devices=8, loop_trip_count=24)
+    ag = stats["all-gather"]
+    # 2 gather ops total: one in the while body (x24), one async in main (x1)
+    assert ag["count"] == 2
+    full = 1024 * 1024 * 4
+    frac = 7 / 8
+    expect = full * frac * 24 + full * frac
+    assert abs(ag["wire_bytes"] - expect) / expect < 1e-9
+    assert ag["by_computation"]["wide.body.1"] == 1
+    assert "wide.body.1" in stats["_loop_body_computations"]
+
+
+def test_async_start_reads_output_tuple_element():
+    stats = parse_collectives(HLO, n_devices=8, loop_trip_count=1)
+    ag = stats["all-gather"]
+    # both ops contribute the FULL gathered result (1024x1024), not the
+    # 128x1024 operand — the async start op's first tuple element is the
+    # operand and must not be the one counted
+    per_op = 1024 * 1024 * 4 * (7 / 8)
+    assert abs(ag["wire_bytes"] - 2 * per_op) < 1.0
+
+
+def test_all_reduce_wire_is_two_passes():
+    stats = parse_collectives(HLO, n_devices=8, loop_trip_count=1)
+    ar = stats["all-reduce"]
+    assert ar["count"] == 1
+    expect = 2 * 512 * 64 * 4 * (7 / 8)  # RS + AG passes of a ring
+    assert abs(ar["wire_bytes"] - expect) < 1.0
