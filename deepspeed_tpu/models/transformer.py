@@ -593,12 +593,13 @@ def stack_apply(cfg, stacked_params, x, mask=None, rope=None, alibi=None,
             # Known 2x: the partitioner gathers the fp32 master and converts
             # after (it reshards an elementwise op's input to match the
             # constrained output, so cast-then-gather cannot be expressed
-            # with constraint chains; jax.sharding.reshard pins the edge but
-            # breaks Shardy propagation for the surrounding scan — measured
-            # full-batch activation gathers). bf16 gathers need Shardy
-            # explicit-sharding mode; until then per-layer gather wire is
-            # fp32-sized. Overlap headroom absorbs it (scale_projection:
-            # 6.5x at OPT-13B/v4-256).
+            # with constraint chains; jax.sharding.reshard AND an
+            # optimization_barrier between cast and constraint were both
+            # tried — each breaks Shardy propagation for the surrounding
+            # scan, measured as full-batch activation gathers). bf16 gathers
+            # need Shardy explicit-sharding mode; until then per-layer
+            # gather wire is fp32-sized. Overlap headroom absorbs it
+            # (scale_projection: 3.3x at OPT-13B/v4-256 micro=1).
             p = _constrain(_cast_block_params(cfg, p), cfg.zero3_gather_specs)
         return block_apply(
             cfg, p, h, mask=m, rope=rope, alibi=alibi,
