@@ -34,6 +34,8 @@ PEAK_TFLOPS = {
 
 METRIC = "gpt2_350m_train_tokens_per_sec_per_chip"
 UNIT = "tokens/s/chip"
+DEFAULTS_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "bench_defaults.json")
 
 def _env_int(name, default):
     try:
@@ -56,7 +58,7 @@ def _error_record(msg):
     }
 
 
-def _run_subprocess(args, timeout_s):
+def _run_subprocess(args, timeout_s, env=None):
     """Run argv in its own session; on timeout terminate the process group.
 
     SIGTERM first with a grace period (a killed-mid-session TPU process wedges
@@ -72,6 +74,7 @@ def _run_subprocess(args, timeout_s):
         stderr=subprocess.PIPE,
         text=True,
         start_new_session=True,
+        env=env,
     )
     def _text(x):
         if isinstance(x, bytes):
@@ -177,13 +180,19 @@ def run_benchmark():
                         "flash_block_q_bwd": bqb, "flash_block_kv_bwd": bkvb}
 
     # sweep-chosen defaults (tools/sweep_bench.py writes the measured winner
-    # to bench_defaults.json); explicit env vars still override
+    # to bench_defaults.json); explicit env vars still override.
+    # BENCH_SAFE=1 ignores the tuned winner entirely — the parent's fallback
+    # when the winner config failed to produce a number (e.g. the unrolled
+    # noremat program failing a cold-cache compile): a base-config ~26k tok/s
+    # result beats a 0.0 record.
     tuned = {}
     tuned_cfg = {}
     tuned_batch = None
-    defaults_path = os.path.join(
-        os.path.dirname(os.path.abspath(__file__)), "bench_defaults.json")
-    if os.path.isfile(defaults_path):
+    defaults_path = DEFAULTS_PATH
+    if os.environ.get("BENCH_SAFE") == "1":
+        defaults_path = ""
+        print("# BENCH_SAFE=1: ignoring bench_defaults.json", file=sys.stderr)
+    if defaults_path and os.path.isfile(defaults_path):
         try:
             with open(defaults_path) as f:
                 rec = json.load(f)
@@ -370,22 +379,46 @@ def main():
     if os.environ.get("BENCH_FORCE_CPU") != "1":
         time.sleep(_env_int("BENCH_HANDOFF_DELAY", 45))
 
-    rc, out, err = _run_subprocess(
-        [sys.executable, os.path.abspath(__file__), "--child"], RUN_TIMEOUT_S
-    )
+    def run_child(extra_env=None):
+        rc, out, err = _run_subprocess(
+            [sys.executable, os.path.abspath(__file__), "--child"],
+            RUN_TIMEOUT_S,
+            env={**os.environ, **extra_env} if extra_env else None)
+        # Find the child's result line (last stdout line parsing with
+        # "metric"). Scanned even on timeout: a child that measured, printed
+        # its result, then wedged in backend teardown still produced a real
+        # number — keep it.
+        for line in reversed(out.strip().splitlines()):
+            try:
+                cand = json.loads(line)
+            except (json.JSONDecodeError, ValueError):
+                continue
+            if isinstance(cand, dict) and "metric" in cand:
+                return rc, cand, err
+        return rc, None, err
 
-    # Find the child's result line (last stdout line that parses with "metric").
-    # Scanned even on timeout: a child that measured, printed its result, then
-    # wedged in backend teardown still produced a real number — keep it.
-    record = None
-    for line in reversed(out.strip().splitlines()):
-        try:
-            cand = json.loads(line)
-        except (json.JSONDecodeError, ValueError):
-            continue
-        if isinstance(cand, dict) and "metric" in cand:
-            record = cand
-            break
+    rc, record, err = run_child()
+    used_defaults = (os.environ.get("BENCH_SAFE") != "1"
+                     and os.path.isfile(DEFAULTS_PATH))
+    # Safe-config fallback (VERDICT r4 weak #5): only when the tuned child
+    # EXITED without a number (a compile crash of the aggressive
+    # unrolled/noremat winner) — a ~26k tok/s base number beats a 0.0
+    # record. NOT on timeout (rc None): that is a tunnel wedge, a retry
+    # against it is futile and would double the worst-case wall time past
+    # an outer driver budget, which is worse than a prompt 0.0 record.
+    if record is None and rc is not None and used_defaults:
+        first_err = err.strip()[-1500:]
+        print(f"# tuned-config child exited rc={rc} with no result; "
+              f"retrying with BENCH_SAFE=1. First run stderr tail:\n"
+              f"{first_err}", file=sys.stderr)
+        time.sleep(_env_int("BENCH_HANDOFF_DELAY", 45))
+        rc, record, err = run_child({"BENCH_SAFE": "1"})
+        if record is not None:
+            record.setdefault("extra", {})["safe_fallback"] = True
+        else:
+            # keep BOTH failures' evidence in the final record
+            err = (f"[tuned] {first_err} [safe] {err.strip()[-700:]}")
+
     if record is None:
         if rc is None:
             print(json.dumps(_error_record(f"benchmark timed out after {RUN_TIMEOUT_S}s")))

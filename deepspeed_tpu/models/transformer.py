@@ -171,6 +171,15 @@ class TransformerConfig:
             raise ValueError(
                 f"attention_logits_dtype must be 'fp32' or 'bf16', got "
                 f"{self.attention_logits_dtype!r}")
+        # same hazard for the kernel choice: the dispatch falls through to
+        # the dense XLA path for anything it doesn't recognize, so a typo'd
+        # impl would silently benchmark the wrong kernel (caught live by the
+        # bench.py safe-fallback test, 2026-08-01)
+        if self.attention_impl not in ("xla", "flash", "jax_flash",
+                                       "block_sparse"):
+            raise ValueError(
+                f"attention_impl must be one of xla|flash|jax_flash|"
+                f"block_sparse, got {self.attention_impl!r}")
 
     @property
     def attn_logits_jnp_dtype(self):
