@@ -38,7 +38,9 @@ def _config(stage=3, **over):
 
 
 def test_abstract_engine_holds_no_buffers(devices8):
-    before = {id(a) for a in jax.live_arrays()}
+    # strong refs: id() reuse after a GC'd array could mask a regression
+    before_refs = list(jax.live_arrays())
+    before = {id(a) for a in before_refs}
     with abstract_init():
         engine, _, _, _ = deepspeed_tpu.initialize(
             model=CausalLM(_cfg()), config=_config())

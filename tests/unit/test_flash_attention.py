@@ -225,3 +225,35 @@ def test_jax_flash_model_trains():
     assert abs(l_xla - l_jf) < 1e-3
     assert all(np.isfinite(np.asarray(x)).all()
                for x in jax.tree_util.tree_leaves(g))
+
+
+def test_single_kv_block_path_matches_general():
+    """The specialized no-scratch kernel (n_kvb == 1 — the measured-winner
+    tile configuration) must match the general online-softmax kernel
+    bitwise-closely, fwd AND bwd, causal and not, including the lse
+    residual used under remat."""
+    from deepspeed_tpu.ops.pallas.flash_attention import pallas_flash_attention
+
+    rng = np.random.RandomState(3)
+    b, s, h, d = 2, 256, 4, 64
+    q = jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.float32)
+
+    for causal in (True, False):
+        def loss_single(q, k, v):
+            return pallas_flash_attention(
+                q, k, v, causal=causal, block_q=128, block_kv=s,
+                block_q_bwd=128, block_kv_bwd=128, interpret=True).sum()
+
+        def loss_general(q, k, v):
+            return pallas_flash_attention(
+                q, k, v, causal=causal, block_q=128, block_kv=128,
+                block_q_bwd=128, block_kv_bwd=128, interpret=True).sum()
+
+        o1, g1 = jax.value_and_grad(loss_single, argnums=(0, 1, 2))(q, k, v)
+        o2, g2 = jax.value_and_grad(loss_general, argnums=(0, 1, 2))(q, k, v)
+        np.testing.assert_allclose(float(o1), float(o2), rtol=2e-5)
+        for a, b_ in zip(g1, g2):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                       rtol=2e-4, atol=2e-5)
