@@ -342,6 +342,61 @@ def _dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref,
         dq_ref[0] = dq_scr[...].astype(dq_ref.dtype)
 
 
+def _dq_kernel_single(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref, *,
+                      scale, causal, block_q, block_kv, q_offset):
+    """dQ with one kv block = whole sequence: single pass, no accumulation
+    scratch (same rationale as _fwd_kernel_single — this is the measured
+    winner's bwd tile shape)."""
+    j = pl.program_id(1)
+    q = q_ref[0]
+    k = k_ref[0]
+    v = v_ref[0]
+    do = do_ref[0]
+    o = o_ref[0].astype(jnp.float32)
+    delta = jnp.sum(o * do.astype(jnp.float32), axis=-1, keepdims=True)
+    s = scale * jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+    if causal:
+        row = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_kv), 0)
+        col = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_kv), 1)
+        s = jnp.where(col <= j * block_q + row + q_offset, s, NEG_INF)
+    p = jnp.exp(s - lse_ref[0][:, :1])
+    dp = jax.lax.dot_general(
+        do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+    ds = p * (dp - delta) * scale
+    dq_ref[0] = jnp.dot(ds.astype(k.dtype), k,
+                        preferred_element_type=jnp.float32).astype(dq_ref.dtype)
+
+
+def _dkv_kernel_single(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dk_ref,
+                       dv_ref, *, scale, causal, block_q, block_kv, q_offset):
+    """dK/dV with one q block = the whole query range (the maxq bwd tile):
+    single pass, no accumulation scratch."""
+    jkv = pl.program_id(1)
+    q = q_ref[0]
+    k = k_ref[0]
+    v = v_ref[0]
+    o = o_ref[0].astype(jnp.float32)
+    do = do_ref[0]
+    delta = jnp.sum(o * do.astype(jnp.float32), axis=-1, keepdims=True)
+    s = scale * jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+    if causal:
+        row = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_kv), 0)
+        col = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_kv), 1)
+        s = jnp.where(jkv * block_kv + col <= row + q_offset, s, NEG_INF)
+    p = jnp.exp(s - lse_ref[0][:, :1])
+    dv_ref[0] = jax.lax.dot_general(
+        p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32).astype(dv_ref.dtype)
+    dp = jax.lax.dot_general(
+        do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+    ds = p * (dp - delta) * scale
+    dk_ref[0] = jax.lax.dot_general(
+        ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32).astype(dk_ref.dtype)
+
+
 # ---------------------------------------------------------------------------
 # backward: dK/dV kernel — grid (b*h, kv_blocks, q_blocks)
 # ---------------------------------------------------------------------------
@@ -455,60 +510,112 @@ def _flash_bwd(q, k, v, out, lse, g, causal, scale, block_q, block_kv, interpret
         def q_index_dkv(i, jkv, qb):
             return (i, qb, 0)
 
-    dq = pl.pallas_call(
-        functools.partial(_dq_kernel, scale=scale, causal=causal, block_q=bq,
-                          block_kv=bkv, q_offset=q_offset, n_kvb=n_kvb),
-        grid=(b * h, n_qb, n_kvb),
-        in_specs=[
-            pl.BlockSpec((1, bq, d), lambda i, j, kb: (i, j, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, bkv, d), kv_index, memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, bkv, d), kv_index, memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, bq, d), lambda i, j, kb: (i, j, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, bq, d), lambda i, j, kb: (i, j, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, bq, LANES), lambda i, j, kb: (i, j, 0), memory_space=pltpu.VMEM),
-        ],
-        out_specs=pl.BlockSpec((1, bq, d), lambda i, j, kb: (i, j, 0),
-                               memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((b * h, s_q, d), q.dtype),
-        scratch_shapes=[
-            pltpu.VMEM((bq, d), jnp.float32),
-            pltpu.VMEM((bq, LANES), jnp.float32),
-        ],
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary")
-        ),
-        interpret=interpret,
-    )(qr, kr, vr, orr, gr, lse)
+    if n_kvb == 1:
+        # single-pass dQ (no accumulation scratch): the winner's bwd shape
+        dq = pl.pallas_call(
+            functools.partial(_dq_kernel_single, scale=scale, causal=causal,
+                              block_q=bq, block_kv=bkv, q_offset=q_offset),
+            grid=(b * h, n_qb),
+            in_specs=[
+                pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0), memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, bkv, d), lambda i, j: (i, 0, 0), memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, bkv, d), lambda i, j: (i, 0, 0), memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0), memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0), memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, bq, LANES), lambda i, j: (i, j, 0), memory_space=pltpu.VMEM),
+            ],
+            out_specs=pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0),
+                                   memory_space=pltpu.VMEM),
+            out_shape=jax.ShapeDtypeStruct((b * h, s_q, d), q.dtype),
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("parallel", "parallel")
+            ),
+            interpret=interpret,
+        )(qr, kr, vr, orr, gr, lse)
+    else:
+        dq = pl.pallas_call(
+            functools.partial(_dq_kernel, scale=scale, causal=causal, block_q=bq,
+                              block_kv=bkv, q_offset=q_offset, n_kvb=n_kvb),
+            grid=(b * h, n_qb, n_kvb),
+            in_specs=[
+                pl.BlockSpec((1, bq, d), lambda i, j, kb: (i, j, 0), memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, bkv, d), kv_index, memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, bkv, d), kv_index, memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, bq, d), lambda i, j, kb: (i, j, 0), memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, bq, d), lambda i, j, kb: (i, j, 0), memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, bq, LANES), lambda i, j, kb: (i, j, 0), memory_space=pltpu.VMEM),
+            ],
+            out_specs=pl.BlockSpec((1, bq, d), lambda i, j, kb: (i, j, 0),
+                                   memory_space=pltpu.VMEM),
+            out_shape=jax.ShapeDtypeStruct((b * h, s_q, d), q.dtype),
+            scratch_shapes=[
+                pltpu.VMEM((bq, d), jnp.float32),
+                pltpu.VMEM((bq, LANES), jnp.float32),
+            ],
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("parallel", "parallel", "arbitrary")
+            ),
+            interpret=interpret,
+        )(qr, kr, vr, orr, gr, lse)
 
-    dk, dv = pl.pallas_call(
-        functools.partial(_dkv_kernel, scale=scale, causal=causal, block_q=bq,
-                          block_kv=bkv, q_offset=q_offset, n_qb=n_qb),
-        grid=(b * h, n_kvb, n_qb),
-        in_specs=[
-            pl.BlockSpec((1, bq, d), q_index_dkv, memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, bkv, d), lambda i, j, qb: (i, j, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, bkv, d), lambda i, j, qb: (i, j, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, bq, d), q_index_dkv, memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, bq, d), q_index_dkv, memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, bq, LANES), q_index_dkv, memory_space=pltpu.VMEM),
-        ],
-        out_specs=[
-            pl.BlockSpec((1, bkv, d), lambda i, j, qb: (i, j, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, bkv, d), lambda i, j, qb: (i, j, 0), memory_space=pltpu.VMEM),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((b * h, s_kv, d), k.dtype),
-            jax.ShapeDtypeStruct((b * h, s_kv, d), v.dtype),
-        ],
-        scratch_shapes=[
-            pltpu.VMEM((bkv, d), jnp.float32),
-            pltpu.VMEM((bkv, d), jnp.float32),
-        ],
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary")
-        ),
-        interpret=interpret,
-    )(qr, kr, vr, orr, gr, lse)
+    if n_qb == 1:
+        # single-pass dK/dV (no accumulation scratch): the maxq bwd shape
+        dk, dv = pl.pallas_call(
+            functools.partial(_dkv_kernel_single, scale=scale, causal=causal,
+                              block_q=bq, block_kv=bkv, q_offset=q_offset),
+            grid=(b * h, n_kvb),
+            in_specs=[
+                pl.BlockSpec((1, bq, d), lambda i, j: (i, 0, 0), memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, bkv, d), lambda i, j: (i, j, 0), memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, bkv, d), lambda i, j: (i, j, 0), memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, bq, d), lambda i, j: (i, 0, 0), memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, bq, d), lambda i, j: (i, 0, 0), memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, bq, LANES), lambda i, j: (i, 0, 0), memory_space=pltpu.VMEM),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, bkv, d), lambda i, j: (i, j, 0), memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, bkv, d), lambda i, j: (i, j, 0), memory_space=pltpu.VMEM),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((b * h, s_kv, d), k.dtype),
+                jax.ShapeDtypeStruct((b * h, s_kv, d), v.dtype),
+            ],
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("parallel", "parallel")
+            ),
+            interpret=interpret,
+        )(qr, kr, vr, orr, gr, lse)
+    else:
+        dk, dv = pl.pallas_call(
+            functools.partial(_dkv_kernel, scale=scale, causal=causal,
+                              block_q=bq, block_kv=bkv, q_offset=q_offset,
+                              n_qb=n_qb),
+            grid=(b * h, n_kvb, n_qb),
+            in_specs=[
+                pl.BlockSpec((1, bq, d), q_index_dkv, memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, bkv, d), lambda i, j, qb: (i, j, 0), memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, bkv, d), lambda i, j, qb: (i, j, 0), memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, bq, d), q_index_dkv, memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, bq, d), q_index_dkv, memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, bq, LANES), q_index_dkv, memory_space=pltpu.VMEM),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, bkv, d), lambda i, j, qb: (i, j, 0), memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, bkv, d), lambda i, j, qb: (i, j, 0), memory_space=pltpu.VMEM),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((b * h, s_kv, d), k.dtype),
+                jax.ShapeDtypeStruct((b * h, s_kv, d), v.dtype),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((bkv, d), jnp.float32),
+                pltpu.VMEM((bkv, d), jnp.float32),
+            ],
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("parallel", "parallel", "arbitrary")
+            ),
+            interpret=interpret,
+        )(qr, kr, vr, orr, gr, lse)
 
     to4 = lambda x, s: x.reshape(b, h, s, d).transpose(0, 2, 1, 3)
     return to4(dq, s_q), to4(dk, s_kv), to4(dv, s_kv)

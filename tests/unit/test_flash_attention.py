@@ -240,20 +240,69 @@ def test_single_kv_block_path_matches_general():
     k = jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.float32)
     v = jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.float32)
 
+    # (bq, bkv, bq_bwd, bkv_bwd): each single-block specialization alone,
+    # then all at once (the maxq shape)
+    variants = [
+        (128, s, 128, 128),   # fwd single-kv-block
+        (128, 128, 128, s),   # dq single-kv-block
+        (128, 128, s, 128),   # dkv single-q-block
+        (s, s, s, s),         # everything single (maxq)
+    ]
     for causal in (True, False):
-        def loss_single(q, k, v):
-            return pallas_flash_attention(
-                q, k, v, causal=causal, block_q=128, block_kv=s,
-                block_q_bwd=128, block_kv_bwd=128, interpret=True).sum()
+        def loss(blocks):
+            bq_, bkv_, bqb, bkvb = blocks
+            def f(q, k, v):
+                return pallas_flash_attention(
+                    q, k, v, causal=causal, block_q=bq_, block_kv=bkv_,
+                    block_q_bwd=bqb, block_kv_bwd=bkvb, interpret=True).sum()
+            return f
 
-        def loss_general(q, k, v):
-            return pallas_flash_attention(
-                q, k, v, causal=causal, block_q=128, block_kv=128,
-                block_q_bwd=128, block_kv_bwd=128, interpret=True).sum()
+        o2, g2 = jax.value_and_grad(
+            loss((128, 128, 128, 128)), argnums=(0, 1, 2))(q, k, v)
+        for blocks in variants:
+            o1, g1 = jax.value_and_grad(
+                loss(blocks), argnums=(0, 1, 2))(q, k, v)
+            np.testing.assert_allclose(float(o1), float(o2), rtol=2e-5,
+                                       err_msg=str(blocks))
+            for a, b_ in zip(g1, g2):
+                np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                           rtol=2e-4, atol=2e-5,
+                                           err_msg=str(blocks))
 
-        o1, g1 = jax.value_and_grad(loss_single, argnums=(0, 1, 2))(q, k, v)
-        o2, g2 = jax.value_and_grad(loss_general, argnums=(0, 1, 2))(q, k, v)
-        np.testing.assert_allclose(float(o1), float(o2), rtol=2e-5)
-        for a, b_ in zip(g1, g2):
-            np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
-                                       rtol=2e-4, atol=2e-5)
+
+def test_single_block_paths_with_kv_longer_than_q():
+    """q_offset != 0 through every specialized kernel: non-causal uses
+    s_q < s_kv directly; causal requires s_q <= s_kv and exercises the
+    '+ q_offset' term of the single-block masks (a sign error there passes
+    all square-shape tests silently)."""
+    from deepspeed_tpu.ops.pallas.flash_attention import pallas_flash_attention
+
+    rng = np.random.RandomState(5)
+    b, s_q, s_kv, h, d = 2, 128, 256, 2, 64
+    q = jnp.asarray(rng.standard_normal((b, s_q, h, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((b, s_kv, h, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((b, s_kv, h, d)), jnp.float32)
+
+    for causal in (True, False):
+        def loss(blocks):
+            bq_, bkv_, bqb, bkvb = blocks
+            def f(q, k, v):
+                return pallas_flash_attention(
+                    q, k, v, causal=causal, block_q=bq_, block_kv=bkv_,
+                    block_q_bwd=bqb, block_kv_bwd=bkvb, interpret=True).sum()
+            return f
+
+        o2, g2 = jax.value_and_grad(
+            loss((64, 64, 64, 64)), argnums=(0, 1, 2))(q, k, v)
+        for blocks in [(64, s_kv, 64, 64),   # fwd single-kv-block
+                       (64, 64, 64, s_kv),   # dq single-kv-block
+                       (64, 64, s_q, 64),    # dkv single-q-block
+                       (s_q, s_kv, s_q, s_kv)]:
+            o1, g1 = jax.value_and_grad(
+                loss(blocks), argnums=(0, 1, 2))(q, k, v)
+            np.testing.assert_allclose(float(o1), float(o2), rtol=2e-5,
+                                       err_msg=f"causal={causal} {blocks}")
+            for a, b_ in zip(g1, g2):
+                np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                           rtol=2e-4, atol=2e-5,
+                                           err_msg=f"causal={causal} {blocks}")
