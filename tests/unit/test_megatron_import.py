@@ -142,3 +142,37 @@ def test_forward_runs_and_vocab_trim(ckpts):
 def test_missing_dir_raises(tmp_path):
     with pytest.raises(FileNotFoundError):
         load_megatron_checkpoint(str(tmp_path))
+
+
+def test_checkpoint_version0_qkv_major_layout(ckpts, tmp_path):
+    """Pre-versioning checkpoints store qkv as [3, heads*hd, d] (qkv-major);
+    absent 'checkpoint_version' must select that layout (reference
+    state_dict_factory.py:427 get(..., 0)) — defaulting to the heads-major
+    reshape would silently scramble q/k/v."""
+    d1, _ = ckpts
+    v3, _ = load_megatron_checkpoint(d1, config=_cfg())
+
+    # rebuild the same logical state in v0 layout: [nh,3,hd,X] -> [3,nh*hd,X]
+    rd = os.path.join(str(tmp_path), "mp_rank_00")
+    os.makedirs(rd)
+    src = torch.load(os.path.join(d1, "mp_rank_00", "model_optim_rng.pt"),
+                     weights_only=False)
+    lm = src["model"]["language_model"]
+    trans = {}
+    for key, val in lm["transformer"].items():
+        if "query_key_value" in key:
+            x = val.reshape((NH, 3, HD) + tuple(val.shape[1:]))
+            x = x.permute(1, 0, 2, *range(3, x.ndim))
+            trans[key] = x.reshape((3 * NH * HD,) + tuple(val.shape[1:])).clone()
+        else:
+            trans[key] = val
+    torch.save({"model": {"language_model": {
+        "embedding": lm["embedding"], "transformer": trans}}},
+        os.path.join(rd, "model_optim_rng.pt"))  # NO checkpoint_version key
+
+    v0, _ = load_megatron_checkpoint(str(tmp_path), config=_cfg())
+    import jax
+
+    for a, b in zip(jax.tree_util.tree_leaves(v3),
+                    jax.tree_util.tree_leaves(v0)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
