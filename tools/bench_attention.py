@@ -96,7 +96,11 @@ def main():
         # so the crossover table ships with tuning data.
         default_blocks = ""
         if jax.default_backend() == "tpu":
-            default_blocks = "512x512:256x512,512x1024:512x512"
+            # 512x1024:512x1024 at seq 1024 engages the r5 single-block
+            # kernels (no-scratch fwd + single-pass dq) — direct A/B vs the
+            # r4 numbers for the same tiles through the general kernels
+            default_blocks = ("512x512:256x512,512x1024:512x512,"
+                              "512x1024:512x1024")
         blocks = os.environ.get("BENCH_BLOCKS", default_blocks)
         if blocks:
             from deepspeed_tpu.ops.flash_attention import parse_block_spec
