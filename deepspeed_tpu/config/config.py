@@ -88,6 +88,12 @@ class ZeroConfig(ConfigModel):
     # "per_layer": force a gather per scanned block inside the layer loop
     # (explicit schedule — the fetch-coordinator role, bounded live params)
     zero3_gather_mode: str = "compiler"
+    # How per_layer realizes the gather: "constraint" leaves the collective
+    # to the partitioner (which gathers the fp32 master and converts after —
+    # a measured 2x on gather wire, PARITY.md known gaps); "shard_map" emits
+    # an explicit bf16 all_gather island after the compute-dtype cast, half
+    # the bytes on the wire.
+    zero3_gather_impl: str = "constraint"
     contiguous_gradients: bool = True
     reduce_scatter: bool = True
     reduce_bucket_size: int = 500_000_000

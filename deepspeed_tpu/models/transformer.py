@@ -115,6 +115,10 @@ class TransformerConfig:
     # partitioned_param_coordinator.py:230) instead of trusting XLA's schedule.
     zero3_per_layer_gather: bool = False
     zero3_gather_specs: typing.Any = None  # per-block spec tree (no layers dim)
+    # "constraint" | "shard_map" (see config.ZeroConfig.zero3_gather_impl);
+    # shard_map additionally needs the SHARDED per-block specs below
+    zero3_gather_impl: str = "constraint"
+    zero3_sharded_specs: typing.Any = None
     # Same discipline for the top-level params (wte / lm_head / ln_f / wpe):
     # {param_name: spec tree} with the data axis stripped. Without this, a
     # ZeRO-3 embedding sharded on its d_model axis (vocab % dp != 0 fallback)
@@ -283,6 +287,38 @@ def block_init(rng, cfg):
         "ln_2": _norm_init(cfg),
         "mlp": mlp,
     }
+
+
+def _shard_map_gather(cfg, p):
+    """Per-leaf explicit all_gather over the ``data`` mesh axis.
+
+    Input leaves carry their ZeRO-3 sharded layout (``zero3_sharded_specs``);
+    the output is the gathered layout (``zero3_gather_specs``). Each leaf with
+    a data-sharded dim becomes a shard_map island whose body is ONE tiled
+    ``jax.lax.all_gather`` — the collective's dtype is whatever the leaf
+    holds at this point (the compute dtype, post-cast), which a sharding
+    constraint cannot guarantee. Leaves without a data shard pass through.
+    """
+    from ..parallel.topology import DATA_AXIS
+
+    def has_data(s):
+        return s == DATA_AXIS or (isinstance(s, tuple) and DATA_AXIS in s)
+
+    def one(a, sharded, gathered):
+        axes = [i for i, s in enumerate(tuple(sharded)) if has_data(s)]
+        if not axes:
+            return a
+        k = axes[0]
+        f = jax.shard_map(
+            lambda x: jax.lax.all_gather(x, DATA_AXIS, axis=k, tiled=True),
+            mesh=cfg.mesh, in_specs=sharded, out_specs=gathered,
+            # the varying-mesh-axes inference can't prove an all_gather
+            # output replicated; it is (by construction of the collective)
+            check_vma=False)
+        return f(a)
+
+    return jax.tree_util.tree_map(one, p, cfg.zero3_sharded_specs,
+                                  cfg.zero3_gather_specs)
 
 
 def _cast_block_params(cfg, p):
@@ -599,17 +635,22 @@ def stack_apply(cfg, stacked_params, x, mask=None, rope=None, alibi=None,
         # residuals (measured +50 GB/chip on the OPT-13B/256 projection when
         # the gather sat outside jax.checkpoint).
         if cfg.zero3_per_layer_gather and cfg.zero3_gather_specs is not None:
-            # Known 2x: the partitioner gathers the fp32 master and converts
-            # after (it reshards an elementwise op's input to match the
-            # constrained output, so cast-then-gather cannot be expressed
-            # with constraint chains; jax.sharding.reshard AND an
-            # optimization_barrier between cast and constraint were both
-            # tried — each breaks Shardy propagation for the surrounding
-            # scan, measured as full-batch activation gathers). bf16 gathers
-            # need Shardy explicit-sharding mode; until then per-layer
-            # gather wire is fp32-sized. Overlap headroom absorbs it
-            # (scale_projection: 3.3x at OPT-13B/v4-256 micro=1).
-            p = _constrain(_cast_block_params(cfg, p), cfg.zero3_gather_specs)
+            if (cfg.zero3_gather_impl == "shard_map"
+                    and cfg.zero3_sharded_specs is not None):
+                # explicit bf16 all_gather island: the collective is pinned
+                # AFTER the compute-dtype cast, half the wire of gathering
+                # the fp32 master (which is all the constraint impl below
+                # can express — the partitioner reshards an elementwise op's
+                # input to match its constrained output, and both
+                # jax.sharding.reshard and an optimization_barrier broke
+                # Shardy propagation for the surrounding scan)
+                p = _shard_map_gather(cfg, _cast_block_params(cfg, p))
+            else:
+                # "constraint": fp32-sized gather wire, a known 2x
+                # (PARITY.md known gaps); overlap headroom absorbs it
+                # (scale_projection: 3.3x at OPT-13B/v4-256 micro=1)
+                p = _constrain(_cast_block_params(cfg, p),
+                               cfg.zero3_gather_specs)
         return block_apply(
             cfg, p, h, mask=m, rope=rope, alibi=alibi,
             deterministic=deterministic, dropout_rng=rng, kv_mask=kv_mask,

@@ -128,6 +128,13 @@ class DeepSpeedEngine:
 
         self.zero_stage = self._config.zero_optimization.stage
         self._persist_threshold = self._config.zero_optimization.param_persistence_threshold
+        # validated regardless of gather mode: a typo'd knob must fail at
+        # construction, not lie dormant until per_layer is enabled
+        if self._config.zero_optimization.zero3_gather_impl not in (
+                "constraint", "shard_map"):
+            raise ConfigError(
+                f"zero3_gather_impl must be 'constraint' or 'shard_map', got "
+                f"{self._config.zero_optimization.zero3_gather_impl!r}")
 
         # -- pipeline parallelism ----------------------------------------------------
         # With pipe > 1 the whole accumulation window runs as ONE compiled GPipe
@@ -279,6 +286,24 @@ class DeepSpeedEngine:
                 is_leaf=lambda x: isinstance(x, P))
             self.module.config.zero3_per_layer_gather = True
             self.module.config.zero3_gather_specs = gather_specs
+            impl = self._config.zero_optimization.zero3_gather_impl
+            if impl == "shard_map":
+                if not hasattr(self.module.config, "zero3_sharded_specs"):
+                    # refuse rather than silently run fp32-sized gather wire
+                    # while the operator believes the bf16 path is active
+                    raise ConfigError(
+                        "zero3_gather_impl: 'shard_map' requires a model "
+                        "config with a zero3_sharded_specs field (the "
+                        "transformer backbone); this module only supports "
+                        "the 'constraint' impl")
+                self.module.config.zero3_gather_impl = "shard_map"
+                # sharded specs minus the layers dim: the shard_map islands'
+                # in_specs (the all_gather's input layout)
+                self.module.config.zero3_sharded_specs = \
+                    jax.tree_util.tree_map(
+                        lambda s: P(*tuple(s)[1:]),
+                        self.param_specs["blocks"],
+                        is_leaf=lambda x: isinstance(x, P))
             # Top-level params (embedding / head / final norm) need a
             # gather-before-use constraint WHEN their ZeRO-3 shard landed on
             # the d_model ("embed") axis: that axis is the contraction dim of
