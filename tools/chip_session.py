@@ -363,8 +363,12 @@ def _serving():
     import bench_serving
 
     # gpt2 small+medium (default), then bloom-560m — the closest one-chip
-    # proxy to the BLOOM TTFT north star (BASELINE.json)
-    for argv in ([], ["--family", "bloom", "--sizes", "560m"]):
+    # proxy to the BLOOM TTFT north star (BASELINE.json); the batch-8 bf16
+    # leg separates dispatch overhead from HBM streaming (decode util at
+    # batch 1 divides the same weight reads over 1/8 the tokens)
+    for argv in ([], ["--family", "bloom", "--sizes", "560m"],
+                 ["--family", "bloom", "--sizes", "560m", "--batch", "8",
+                  "--modes", "bf16", "--prompts", "128"]):
         sys.argv = ["bench_serving.py"] + argv
         bench_serving.main()
 
