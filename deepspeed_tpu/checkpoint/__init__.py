@@ -1,3 +1,18 @@
 from .engine import CheckpointEngine, NpzCheckpointEngine, AsyncCheckpointEngine
+from .atomic import (
+    CheckpointError,
+    CheckpointCorruptionError,
+    TornWriteError,
+    verify_checkpoint_dir,
+    resume_candidates,
+    quarantine,
+    read_latest,
+    list_tags,
+)
 
-__all__ = ["CheckpointEngine", "NpzCheckpointEngine", "AsyncCheckpointEngine"]
+__all__ = [
+    "CheckpointEngine", "NpzCheckpointEngine", "AsyncCheckpointEngine",
+    "CheckpointError", "CheckpointCorruptionError", "TornWriteError",
+    "verify_checkpoint_dir", "resume_candidates", "quarantine",
+    "read_latest", "list_tags",
+]

@@ -1,0 +1,398 @@
+"""Atomic checkpoint commit protocol + corruption-recovery primitives.
+
+The durability contract (the reference ``NebulaCheckpointEngine``'s
+create/save/commit made concrete on a filesystem):
+
+1. Every save stages into ``<tag>.tmp/`` — never into the final tag dir.
+2. The stage dir gets a ``COMMITTED`` marker: per-file sizes + CRC32s,
+   per-array CRC32s, and step/mesh metadata. Files and the marker are
+   fsynced before publication.
+3. ``os.replace(<tag>.tmp, <tag>)`` publishes the tag — the rename is the
+   commit point; readers never observe a half-written tag dir.
+4. The ``latest`` pointer is its own atomic swap (``latest.tmp`` +
+   ``os.replace``) and is only advanced after the tag is durable.
+
+A crash at any point leaves either (a) a stale ``.tmp`` dir and an
+untouched ``latest``, or (b) a fully-committed tag. ``resume_candidates``
+plus ``verify_checkpoint_dir`` implement the recovery walk: newest first,
+quarantining anything that fails verification to ``<tag>.corrupt``.
+
+Fault-injection seam: all file writes funnel through ``write_bytes`` /
+``write_npz`` / ``write_json``, which call :func:`fault_point` before and
+after touching the disk. ``deepspeed_tpu.testing.fault_injection``
+registers hooks here to deterministically fail or truncate the Nth write.
+"""
+
+import json
+import os
+import shutil
+import zlib
+from types import MappingProxyType
+
+import numpy as np
+
+from ..utils.logging import logger
+
+MARKER = "COMMITTED"
+TMP_SUFFIX = ".tmp"
+CORRUPT_SUFFIX = ".corrupt"
+MARKER_VERSION = 1
+
+
+class CheckpointError(RuntimeError):
+    """Base class for checkpoint durability failures."""
+
+
+#: Falsy sentinel for a COMMITTED file that exists but cannot be parsed.
+#: Distinct from ``None`` (marker absent = pre-protocol save): torn marker
+#: bytes are proof of damage, not of age. Falsy + read-only mapping so
+#: ``if marker`` and ``marker.get(...)`` both behave for defensive callers.
+CORRUPT_MARKER = MappingProxyType({})
+
+
+class CheckpointCorruptionError(CheckpointError, ValueError):
+    """A committed checkpoint failed marker/checksum verification.
+
+    Subclasses ``ValueError`` so pre-protocol callers that caught shape /
+    coverage errors as ``ValueError`` keep working.
+    """
+
+
+class TornWriteError(CheckpointError, OSError):
+    """Staged bytes changed between write and marker sealing. The attempt is
+    invalid but a fresh re-stage may well succeed, so this subclasses
+    ``OSError`` to be retryable by the save-path policies (every retry cuts
+    a fresh stage dir)."""
+
+
+# ---------------------------------------------------------------------------
+# Fault-injection seam
+# ---------------------------------------------------------------------------
+_FAULT_HOOKS = []
+
+
+def register_fault_hook(fn):
+    """Register ``fn(event, path)`` to run at every fault point. The hook may
+    raise (simulating a crash mid-save) or mutate the file at ``path``
+    (simulating a torn write). Test-only; no-op overhead when empty."""
+    _FAULT_HOOKS.append(fn)
+
+
+def unregister_fault_hook(fn):
+    try:
+        _FAULT_HOOKS.remove(fn)
+    except ValueError:
+        pass
+
+
+def fault_point(event, path):
+    """Events: ``write`` (before a data file write), ``wrote`` (after, file on
+    disk but not fsynced), ``replace`` (before the tag-dir commit rename),
+    ``latest`` (before the latest-pointer swap)."""
+    for hook in list(_FAULT_HOOKS):
+        hook(event, path)
+
+
+# ---------------------------------------------------------------------------
+# Low-level durable writes
+# ---------------------------------------------------------------------------
+def crc32_bytes(data):
+    return zlib.crc32(data) & 0xFFFFFFFF
+
+
+def crc32_file(path, chunk=1 << 20):
+    crc = 0
+    with open(path, "rb") as f:
+        while True:
+            block = f.read(chunk)
+            if not block:
+                break
+            crc = zlib.crc32(block, crc)
+    return crc & 0xFFFFFFFF
+
+
+def fsync_file(path):
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def fsync_dir(path):
+    # directory fsync makes the rename itself durable; not supported on some
+    # filesystems — degrade silently rather than fail the save
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def write_bytes(path, data):
+    """Durable write. Returns the file's ``{"size", "crc32"}`` (computed from
+    the in-memory payload — no read-back) for :func:`write_marker`."""
+    fault_point("write", path)
+    with open(path, "wb") as f:
+        f.write(data)
+    fault_point("wrote", path)
+    fsync_file(path)
+    return {"size": len(data), "crc32": crc32_bytes(data)}
+
+
+def write_json(path, obj):
+    return write_bytes(path, json.dumps(obj, indent=1).encode())
+
+
+def write_npz(path, arrays):
+    """Durable npz write. Returns ``{"size", "crc32"}``; the CRC read-back
+    happens right here while the pages are still warm, not in a second full
+    pass at marker time (zipfile seeks back to patch headers, so the CRC
+    cannot be accumulated while streaming)."""
+    fault_point("write", path)
+    with open(path, "wb") as f:
+        np.savez(f, **arrays)
+    fault_point("wrote", path)
+    fsync_file(path)
+    return {"size": os.path.getsize(path), "crc32": crc32_file(path)}
+
+
+def write_file_atomic(path, data):
+    """tmp + fsync + rename for a single file (the ``latest`` pointer)."""
+    tmp = path + TMP_SUFFIX
+    write_bytes(tmp, data)
+    fault_point("latest" if os.path.basename(path) == "latest" else "replace",
+                path)
+    os.replace(tmp, path)
+    fsync_dir(os.path.dirname(path) or ".")
+
+
+# ---------------------------------------------------------------------------
+# Marker
+# ---------------------------------------------------------------------------
+def write_marker(stage_dir, tag, meta=None, array_crcs=None, file_crcs=None,
+                 kind="checkpoint"):
+    """Checksum every file currently in ``stage_dir`` and write the COMMITTED
+    marker. Call after all data files are staged, before publication.
+    ``file_crcs`` carries ``{filename: {"size", "crc32"}}`` captured at write
+    time (the ``write_*`` helpers return them) so sealing the marker doesn't
+    re-read multi-GB files; entries whose recorded size no longer matches the
+    file on disk are distrusted and re-streamed. ``kind="artifact"`` marks a
+    durable side product (e.g. a consolidated export) that must never enter
+    the resume chain or retention accounting."""
+    meta = meta or {}
+    file_crcs = file_crcs or {}
+    files = {}
+    for name in sorted(os.listdir(stage_dir)):
+        if name == MARKER or name.endswith(TMP_SUFFIX):
+            continue
+        full = os.path.join(stage_dir, name)
+        if not os.path.isfile(full):
+            continue
+        size = os.path.getsize(full)
+        known = file_crcs.get(name)
+        if known is not None:
+            if known["size"] != size:
+                # the staged bytes are no longer what was written — sealing
+                # a CRC of the torn content would mint a "valid" checkpoint
+                # full of garbage; fail this attempt (retryable: a fresh
+                # re-stage may succeed)
+                raise TornWriteError(
+                    f"staged file {name} changed size after write "
+                    f"({known['size']} -> {size}) — refusing to seal marker")
+            files[name] = {"size": size, "crc32": known["crc32"]}
+        else:
+            files[name] = {"size": size, "crc32": crc32_file(full)}
+    marker = {
+        "version": MARKER_VERSION,
+        "kind": kind,
+        "tag": tag,
+        "step": meta.get("global_steps", meta.get("step")),
+        "mesh": meta.get("mesh"),
+        "files": files,
+        "arrays": array_crcs or {},
+    }
+    write_json(os.path.join(stage_dir, MARKER), marker)
+    return marker
+
+
+def read_marker(path):
+    """Parse ``<path>/COMMITTED``. Returns the marker dict, ``None`` if the
+    file is absent (pre-protocol save), or the falsy :data:`CORRUPT_MARKER`
+    sentinel if it exists but cannot be parsed — a torn post-commit write is
+    evidence of damage and must NOT masquerade as a legacy checkpoint."""
+    marker_path = os.path.join(path, MARKER)
+    if not os.path.exists(marker_path):
+        return None
+    try:
+        with open(marker_path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return CORRUPT_MARKER
+
+
+def verify_checkpoint_dir(path, deep=True, skip_crc=()):
+    """Validate a (published or staged) checkpoint dir against its marker.
+
+    Returns ``(ok, reason)``. ``deep=True`` re-checksums every file (names
+    in ``skip_crc`` keep only the size check — e.g. ``arrays.npz`` when
+    per-array CRCs will be checked after decode anyway); ``deep=False`` only
+    checks marker presence and file sizes (cheap — used for retention and
+    candidate-ordering decisions).
+
+    A transient I/O error yields ``(False, "unverifiable: ...")`` — see
+    :func:`is_transient_verify_failure`; callers must treat that as
+    try-again-later, never as proof of corruption.
+    """
+    if not os.path.isdir(path):
+        return False, "missing directory"
+    marker = read_marker(path)
+    if not marker:  # absent OR present-but-unparseable
+        return False, f"missing or unreadable {MARKER} marker"
+    for name, info in marker.get("files", {}).items():
+        full = os.path.join(path, name)
+        try:
+            if not os.path.exists(full):
+                return False, f"missing file {name}"
+            size = os.path.getsize(full)
+            if size != info["size"]:
+                return False, (f"size mismatch for {name}: "
+                               f"{size} != {info['size']} (truncated?)")
+            if deep and name not in skip_crc \
+                    and crc32_file(full) != info["crc32"]:
+                return False, f"crc32 mismatch for {name}"
+        except OSError as e:
+            # TOCTOU on a shared fs (fsck/another restart renamed the tag
+            # mid-check): a verifier that crashes the recovery walk it
+            # protects is worse than a skipped candidate
+            return False, f"unverifiable: I/O error on {name}: {e}"
+    return True, "ok"
+
+
+def is_transient_verify_failure(reason):
+    """True when a verify failure means "could not check" (transient I/O)
+    rather than proven corruption — such tags must never be quarantined."""
+    return reason.startswith("unverifiable:")
+
+
+# ---------------------------------------------------------------------------
+# Staging / publication
+# ---------------------------------------------------------------------------
+def stage_dir_for(path):
+    return path.rstrip("/") + TMP_SUFFIX
+
+
+def make_stage_dir(path):
+    """Fresh stage dir for a tag (clears leftovers from a crashed save)."""
+    stage = stage_dir_for(path)
+    if os.path.exists(stage):
+        shutil.rmtree(stage)
+    os.makedirs(stage)
+    return stage
+
+
+def publish_tag(path):
+    """Commit point: rename ``<tag>.tmp`` into place. The stage dir must
+    already hold a COMMITTED marker. Re-publishing an existing tag renames
+    the old dir aside first (rmtree before the swap would leave a
+    checkpoint-sized window with no tag dir while ``latest`` still names
+    it); the aside copy carries the ``.tmp`` suffix, so readers and fsck
+    treat a crash leftover as a stale stage, never a resume target."""
+    stage = stage_dir_for(path)
+    fault_point("replace", path)
+    old = None
+    if os.path.exists(path):
+        old = path + ".old" + TMP_SUFFIX
+        if os.path.exists(old):
+            shutil.rmtree(old)
+        os.replace(path, old)
+    os.replace(stage, path)
+    fsync_dir(os.path.dirname(path) or ".")
+    if old is not None:
+        shutil.rmtree(old, ignore_errors=True)
+
+
+def publish_latest(parent, tag):
+    """Atomically swap the ``latest`` pointer to ``tag``."""
+    write_file_atomic(os.path.join(parent, "latest"), tag.encode())
+
+
+def read_latest(parent):
+    latest = os.path.join(parent, "latest")
+    if not os.path.exists(latest):
+        return None
+    try:
+        with open(latest) as f:
+            return f.read().strip() or None
+    except OSError:
+        return None
+
+
+# ---------------------------------------------------------------------------
+# Recovery walk
+# ---------------------------------------------------------------------------
+def is_tag_dir(parent, name):
+    return (os.path.isdir(os.path.join(parent, name))
+            and not name.endswith(TMP_SUFFIX)
+            and CORRUPT_SUFFIX not in name)
+
+
+def list_tags(parent, newest_first=True):
+    """Published tag dirs under ``parent``, ordered by marker step (falling
+    back to name) — excludes ``.tmp`` stages, ``.corrupt`` quarantine, and
+    marker ``kind="artifact"`` dirs (side products like consolidated exports
+    are durable but never resume candidates). Marker-less (legacy) and
+    unreadable-marker dirs stay listed — the resume walk sorts those out."""
+    if not os.path.isdir(parent):
+        return []
+    entries = []
+    for d in os.listdir(parent):
+        if not is_tag_dir(parent, d):
+            continue
+        marker = read_marker(os.path.join(parent, d))
+        if marker and marker.get("kind", "checkpoint") != "checkpoint":
+            continue
+        step = marker.get("step") if marker else None
+        entries.append(((step if isinstance(step, (int, float)) else -1, d), d))
+    entries.sort(reverse=newest_first)
+    return [d for _, d in entries]
+
+
+def resume_candidates(parent):
+    """Tags to try resuming from, best first: the ``latest`` pointer's target
+    (if it names an existing tag dir), then every other tag newest-first."""
+    latest = read_latest(parent)
+    tags = list_tags(parent, newest_first=True)
+    if latest is not None and latest in tags:
+        tags.remove(latest)
+        tags.insert(0, latest)
+    elif latest is not None:
+        logger.warning(
+            "checkpoint 'latest' points at %r which does not exist under %s — "
+            "falling back to the newest published tag", latest, parent)
+    return tags
+
+
+def quarantine(path):
+    """Move a corrupt checkpoint aside to ``<tag>.corrupt`` (suffixed with a
+    counter if that name is taken) so it is never retried but stays around
+    for forensics. Returns the quarantine path (or None if gone already —
+    including losing the rename race to another process on a shared fs)."""
+    if not os.path.exists(path):
+        return None
+    dest = path + CORRUPT_SUFFIX
+    n = 0
+    while os.path.exists(dest):
+        n += 1
+        dest = f"{path}{CORRUPT_SUFFIX}.{n}"
+    try:
+        os.replace(path, dest)
+    except OSError:
+        return None  # another rank quarantined it first
+    logger.warning("quarantined corrupt checkpoint %s -> %s", path, dest)
+    return dest

@@ -9,10 +9,16 @@ an async thread-pool variant (the ``NebulaCheckpointEngine`` role,
 Layout (one directory per tag):
     <path>/meta.json            — counters, mesh shape, leaf manifest
     <path>/arrays.npz           — all pytree leaves keyed by joined path
+    <path>/COMMITTED            — durability marker: per-file + per-array CRC32s
 
 Arrays are gathered to host before writing (single-host). The multi-host sharded
 layout (per-shard files + universal reshape, reference ``deepspeed/checkpoint/``)
 builds on the same manifest format.
+
+Durability: every save stages into ``<tag>.tmp/`` and only reaches the final
+tag name via the atomic commit protocol in ``checkpoint/atomic.py`` — a crash
+or injected fault mid-save can never advance the ``latest`` pointer or leave a
+half-written tag where a reader will find it.
 """
 
 import json
@@ -23,6 +29,9 @@ import numpy as np
 import jax
 
 from ..utils.logging import logger
+from ..utils.retry import io_retry_policy, retry_call
+from . import atomic
+from .atomic import CheckpointCorruptionError, CheckpointError  # noqa: F401 (re-export)
 
 
 def _flatten_with_names(tree):
@@ -46,7 +55,7 @@ class CheckpointEngine:
     def save(self, state_tree, path, meta=None):
         raise NotImplementedError
 
-    def load(self, path, template=None, shardings=None):
+    def load(self, path, template=None, shardings=None, verify=True):
         raise NotImplementedError
 
     def commit(self, tag):
@@ -54,33 +63,91 @@ class CheckpointEngine:
 
 
 class NpzCheckpointEngine(CheckpointEngine):
-    def save(self, state_tree, path, meta=None):
-        os.makedirs(path, exist_ok=True)
-        named, _ = _flatten_with_names(state_tree)
-        host_arrays = {k: np.asarray(jax.device_get(v)) for k, v in named.items()}
-        np.savez(os.path.join(path, "arrays.npz"), **host_arrays)
+    def __init__(self, retry_policy=None):
+        self._retry = retry_policy or io_retry_policy()
+
+    def _write_tag(self, host_arrays, path, meta, kind="checkpoint"):
+        """Atomic tag commit: stage -> marker -> publish. Runs under retry —
+        a fresh stage dir is cut on every attempt. The 'latest' swap is NOT
+        part of this unit (see ``_commit_tag``). ``kind="artifact"`` seals a
+        side product (e.g. a consolidated copy) that stays out of the resume
+        chain and retention accounting entirely."""
+        stage = atomic.make_stage_dir(path)
+        file_crcs = {"arrays.npz": atomic.write_npz(
+            os.path.join(stage, "arrays.npz"), host_arrays)}
         manifest = {k: {"shape": list(v.shape), "dtype": str(v.dtype)}
                     for k, v in host_arrays.items()}
-        with open(os.path.join(path, "meta.json"), "w") as f:
-            json.dump({"meta": meta or {}, "manifest": manifest}, f, indent=1)
-        # reference writes a 'latest' file next to the tag dirs (engine.py:2876)
-        parent = os.path.dirname(path)
-        with open(os.path.join(parent, "latest"), "w") as f:
-            f.write(os.path.basename(path))
+        file_crcs["meta.json"] = atomic.write_json(
+            os.path.join(stage, "meta.json"),
+            {"meta": meta or {}, "manifest": manifest})
+        # crc32 accepts any contiguous buffer — no tobytes() copy
+        array_crcs = {k: atomic.crc32_bytes(np.ascontiguousarray(v))
+                      for k, v in host_arrays.items()}
+        atomic.write_marker(stage, os.path.basename(path), meta=meta or {},
+                            array_crcs=array_crcs, file_crcs=file_crcs,
+                            kind=kind)
+        atomic.publish_tag(path)
 
-    def load(self, path, template=None, shardings=None):
+    def _commit_tag(self, host_arrays, path, meta):
+        """Full durable save: the tag commit and the 'latest' swap are
+        SEPARATE retry units — a transient flake on the ~20-byte pointer
+        write must not re-stage and re-publish the multi-GB tag."""
+        retry_call(self._write_tag, host_arrays, path, meta,
+                   policy=self._retry, describe=f"checkpoint save {path}")
+        # reference writes a 'latest' file next to the tag dirs (engine.py:2876)
+        retry_call(atomic.publish_latest, os.path.dirname(path),
+                   os.path.basename(path), policy=self._retry,
+                   describe=f"latest swap {path}")
+
+    def save(self, state_tree, path, meta=None):
+        named, _ = _flatten_with_names(state_tree)
+        host_arrays = {k: np.asarray(jax.device_get(v)) for k, v in named.items()}
+        self._commit_tag(host_arrays, path, meta)
+
+    def load(self, path, template=None, shardings=None, verify=True):
+        marker = None
+        if verify:
+            marker = atomic.read_marker(path)
+            if marker is None:
+                logger.warning("checkpoint %s has no %s marker (pre-protocol "
+                               "save?) — loading unverified", path, atomic.MARKER)
+            else:
+                # an unreadable marker is the CORRUPT_MARKER sentinel, not
+                # None — it reaches verify (which rejects it) instead of
+                # masquerading as a pre-protocol save. arrays.npz skips the
+                # file-level CRC only when the per-array CRCs (checked after
+                # decode below) cover it end-to-end; small files like
+                # meta.json are still CRC-verified.
+                ok, reason = atomic.verify_checkpoint_dir(
+                    path,
+                    skip_crc=("arrays.npz",) if marker.get("arrays") else ())
+                if not ok:
+                    raise CheckpointCorruptionError(
+                        f"checkpoint {path} failed verification: {reason}")
         with open(os.path.join(path, "meta.json")) as f:
             blob = json.load(f)
         arrays = np.load(os.path.join(path, "arrays.npz"))
+
+        def check_array(key, arr):
+            """End-to-end decode check against the marker's per-array CRCs
+            (the file-level CRC can't catch npz-decode corruption)."""
+            want = (marker or {}).get("arrays", {}).get(key)
+            if want is not None and atomic.crc32_bytes(
+                    np.ascontiguousarray(arr)) != want:
+                raise CheckpointCorruptionError(
+                    f"checkpoint {path}: array '{key}' fails its CRC32 "
+                    f"after decode")
+            return arr
+
         if template is None:
-            return {k: arrays[k] for k in arrays.files}, blob["meta"]
+            return {k: check_array(k, arrays[k]) for k in arrays.files}, blob["meta"]
         named_template, treedef = _flatten_with_names(template)
         named_shardings, _ = _flatten_with_names(shardings) if shardings is not None else ({}, None)
         leaves = []
         for key, tmpl in named_template.items():
             if key not in arrays:
                 raise KeyError(f"Checkpoint missing array '{key}'")
-            arr = arrays[key]
+            arr = check_array(key, arrays[key])
             if tuple(arr.shape) != tuple(tmpl.shape):
                 raise ValueError(
                     f"Checkpoint shape mismatch for '{key}': {arr.shape} vs {tmpl.shape}"
@@ -91,38 +158,65 @@ class NpzCheckpointEngine(CheckpointEngine):
         return tree, blob["meta"]
 
 
-class AsyncCheckpointEngine(NpzCheckpointEngine):
-    """Write in a background thread; ``commit`` joins (the Nebula engine's
-    commit-based durability contract, ``nebula_checkpoint_engine.py:20``)."""
+class AsyncWriterMixin:
+    """Background-writer scaffolding shared by the async engines: one
+    in-flight writer thread, its failure captured and re-raised exactly once
+    — from ``commit()``, or from the next ``save()`` if commit was skipped —
+    so a failed async checkpoint can never be treated as durable."""
 
-    def __init__(self):
-        self._thread = None
+    _thread = None
+    _error = None
+    _commit_err = None
+
+    def _drain(self):
+        """Join any in-flight write and surface its failure exactly once.
+        ``commit()`` additionally records the failure in ``_commit_err`` so
+        a RETRIED commit fails again instead of falsely reporting
+        durability; a fresh ``save()`` clears that record."""
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+        if self._error is not None:
+            err, self._error = self._error, None
+            raise CheckpointError("async checkpoint write failed") from err
+
+    def _spawn_writer(self, fn):
+        def run():
+            try:
+                fn()
+            except BaseException as e:  # surfaced at commit / next save
+                self._error = e
+
+        self._thread = threading.Thread(target=run, daemon=True)
+        self._thread.start()
+
+
+class AsyncCheckpointEngine(AsyncWriterMixin, NpzCheckpointEngine):
+    """Write in a background thread; ``commit`` joins and re-raises any
+    background failure (the Nebula engine's commit-based durability contract,
+    ``nebula_checkpoint_engine.py:20``). A failed async write can never be
+    treated as durable: the exception surfaces from ``commit()`` — or from
+    the next ``save()`` if the caller skipped commit — and the atomic
+    protocol guarantees ``latest`` was not advanced."""
 
     def save(self, state_tree, path, meta=None):
         # device_get on the caller thread (arrays may be donated right after)
         named, _ = _flatten_with_names(state_tree)
         host = {k: np.asarray(jax.device_get(v)) for k, v in named.items()}
 
-        def write():
-            os.makedirs(path, exist_ok=True)
-            np.savez(os.path.join(path, "arrays.npz"), **host)
-            manifest = {k: {"shape": list(v.shape), "dtype": str(v.dtype)}
-                        for k, v in host.items()}
-            with open(os.path.join(path, "meta.json"), "w") as f:
-                json.dump({"meta": meta or {}, "manifest": manifest}, f, indent=1)
-            parent = os.path.dirname(path)
-            with open(os.path.join(parent, "latest"), "w") as f:
-                f.write(os.path.basename(path))
-
-        # Serialize with any in-flight save: two writers would race on the shared
-        # "latest" pointer and commit() only joins the newest thread.
-        if self._thread is not None:
-            self._thread.join()
-        self._thread = threading.Thread(target=write, daemon=True)
-        self._thread.start()
+        # Serialize with any in-flight save (two writers would race on the
+        # shared "latest" pointer) and re-raise its failure here rather than
+        # silently dropping it.
+        self._drain()
+        self._commit_err = None  # fresh attempt: drop any sticky commit failure
+        self._spawn_writer(lambda: self._commit_tag(host, path, meta))
 
     def commit(self, tag):
-        if self._thread is not None:
-            self._thread.join()
-            self._thread = None
+        try:
+            self._drain()
+        except CheckpointError as e:
+            self._commit_err = e
+            raise
+        if self._commit_err is not None:
+            raise self._commit_err  # retried commit: still not durable
         return True

@@ -33,7 +33,12 @@ import re
 import numpy as np
 import jax
 
-from .engine import CheckpointEngine, NpzCheckpointEngine, _flatten_with_names
+from ..utils.logging import logger
+from ..utils.retry import io_retry_policy, retry_call
+from . import atomic
+from .atomic import CheckpointCorruptionError, CheckpointError
+from .engine import (AsyncWriterMixin, CheckpointEngine, NpzCheckpointEngine,
+                     _flatten_with_names)
 
 
 def _ranges_key(leaf_key, index, shape):
@@ -64,68 +69,240 @@ class ShardedCheckpointEngine(CheckpointEngine):
         for key, leaf in named.items():
             arr = jnp_aslike(leaf)
             manifest[key] = {"shape": list(arr.shape), "dtype": str(arr.dtype)}
-            entries = []
+            # pieces entry: {ranges_key: crc32 of the raw shard bytes} — the
+            # CRC is checked after npz decode on load (end-to-end), letting
+            # verified loads skip a whole-file CRC pass over the shard npzs
+            entries = {}
             if hasattr(arr, "addressable_shards") and arr.addressable_shards:
                 for shard in arr.addressable_shards:
                     if getattr(shard, "replica_id", 0) != 0:
                         continue  # someone else's identical copy
                     rk = _ranges_key(key, shard.index, arr.shape)
                     blobs[rk] = np.asarray(shard.data)
-                    entries.append(rk)
+                    entries[rk] = atomic.crc32_bytes(
+                        np.ascontiguousarray(blobs[rk]))
             else:
                 rk = _ranges_key(key, tuple(slice(0, d) for d in arr.shape),
                                  arr.shape)
                 blobs[rk] = np.asarray(arr)
-                entries.append(rk)
+                entries[rk] = atomic.crc32_bytes(
+                    np.ascontiguousarray(blobs[rk]))
             if entries:
                 pieces[key] = entries
         return blobs, pieces, manifest
 
-    def _write(self, path, blobs, pieces, manifest, meta):
+    def __init__(self, retry_policy=None):
+        self._retry = retry_policy or io_retry_policy()
+        # _finalize cannot cut a fresh stage dir (the premise behind
+        # TornWriteError being retryable in atomic.py), so a torn stage is
+        # terminal there — retrying would fail identically every attempt
+        self._finalize_retry = self._retry.excluding(atomic.TornWriteError)
+
+    def _stage(self, path, blobs, pieces, manifest, meta):
+        """Write this process's shards into the ``<tag>.tmp`` stage dir.
+        Returns the staged files' write-time CRCs for the marker pass (they
+        cover only THIS process's files — ``_finalize`` streams the rest)."""
         proc = jax.process_index()
-        os.makedirs(path, exist_ok=True)
-        np.savez(os.path.join(path, f"shards-{proc}.npz"), **blobs)
-        with open(os.path.join(path, f"pieces-{proc}.json"), "w") as f:
-            json.dump(pieces, f)
+        stage = atomic.stage_dir_for(path)
+        if proc == 0 and jax.process_count() == 1:
+            stage = atomic.make_stage_dir(path)
+        else:
+            os.makedirs(stage, exist_ok=True)
+        crcs = {f"shards-{proc}.npz": atomic.write_npz(
+            os.path.join(stage, f"shards-{proc}.npz"), blobs)}
+        crcs[f"pieces-{proc}.json"] = atomic.write_json(
+            os.path.join(stage, f"pieces-{proc}.json"), pieces)
         if proc == 0:
-            with open(os.path.join(path, "meta.json"), "w") as f:
-                json.dump({"meta": meta or {}, "manifest": manifest,
-                           "layout": "sharded"}, f, indent=1)
+            crcs["meta.json"] = atomic.write_json(
+                os.path.join(stage, "meta.json"),
+                {"meta": meta or {}, "manifest": manifest,
+                 "layout": "sharded"})
+        self._stage_crcs = crcs
+        return crcs
+
+    def _finalize(self, path, meta):
+        """Process 0 only: checksum everything staged, write the COMMITTED
+        marker, and atomically publish the tag dir. Shard files from ranks
+        beyond the current world size are stale leftovers of a crashed save
+        at a larger scale (the multi-process stage dir is reused, not
+        cleared) — purge them or the marker would seal old-step data into a
+        "valid" checkpoint that load() happily assembles."""
+        stage = atomic.stage_dir_for(path)
+        if not os.path.isdir(stage):
+            # a previous attempt already published this stage and failed
+            # later (e.g. at the pointer swap) — a retried commit has
+            # nothing left to seal
+            if os.path.isdir(path):
+                return
+            raise CheckpointError(f"no stage or published dir for {path}")
+        nproc = jax.process_count()
+        for fn in os.listdir(stage):
+            m = re.match(r"(?:shards|pieces)-(\d+)\.(?:npz|json)$", fn)
+            if m and int(m.group(1)) >= nproc:
+                os.remove(os.path.join(stage, fn))
+        atomic.write_marker(stage, os.path.basename(path), meta=meta or {},
+                            file_crcs=getattr(self, "_stage_crcs", None))
+        atomic.publish_tag(path)
 
     def _point_latest(self, path):
         """Repoint 'latest' — only after EVERY process's shards are durable
-        (the barrier), or a preempted host leaves 'latest' naming a checkpoint
-        whose pieces don't cover the leaves and clobbers the last good one."""
+        and the tag is published (the barrier), or a preempted host leaves
+        'latest' naming a checkpoint whose pieces don't cover the leaves and
+        clobbers the last good one. The pointer write is its own retry unit,
+        and its outcome is group-fenced: a rank-0 flake must fail EVERY
+        rank's commit(), or a caller-level commit retry re-enters _seal's
+        collectives on rank 0 alone and deadlocks."""
         from .. import comm as dist
 
         dist.barrier()
+        err = None
         if jax.process_index() == 0:
-            parent = os.path.dirname(path)
-            with open(os.path.join(parent, "latest"), "w") as f:
-                f.write(os.path.basename(path))
+            try:
+                retry_call(atomic.publish_latest, os.path.dirname(path),
+                           os.path.basename(path), policy=self._retry,
+                           describe=f"latest swap {path}")
+            except Exception as e:
+                err = e
+        if jax.process_count() > 1 and not dist.all_agree(err is None):
+            if err is None:
+                err = CheckpointError(
+                    f"latest swap failed on process 0 for {path}")
+        if err is not None:
+            raise err
+
+    def _save_local(self, state_tree, path, meta):
+        blobs, pieces, manifest = self._prepare(state_tree)
+        retry_call(self._stage, path, blobs, pieces, manifest, meta,
+                   policy=self._retry, describe=f"sharded stage {path}")
+        if jax.process_count() == 1:
+            # single-process: the tag is complete the moment our shards are
+            # staged — publish immediately so the dir is loadable pre-commit
+            retry_call(self._finalize, path, meta,
+                       policy=self._finalize_retry,
+                       describe=f"sharded publish {path}")
+        self._last_meta = meta
 
     def save(self, state_tree, path, meta=None):
-        blobs, pieces, manifest = self._prepare(state_tree)
-        self._write(path, blobs, pieces, manifest, meta)
+        if jax.process_count() > 1:
+            # defer a rank-local stage failure to commit's consensus fence —
+            # raising here would strand the other ranks in _seal's collective
+            try:
+                self._save_local(state_tree, path, meta)
+                self._save_err = None
+            except Exception as e:
+                self._save_err = e
+        else:
+            self._save_local(state_tree, path, meta)
+            self._save_err = None
         self._last_path = path
+
+    def _seal(self, path, local_err=None):
+        """Multi-process commit tail. The first consensus IS the staging
+        fence: every rank reports its stage outcome (``local_err``) — a rank
+        whose write failed joins the collective and fails the whole group
+        instead of raising early and stranding everyone else in a barrier.
+        Then process 0 seals the tag and ALL ranks agree on that outcome
+        before the pointer moves."""
+        if jax.process_count() > 1:
+            from .. import comm as dist
+
+            if not dist.all_agree(local_err is None):
+                if local_err is not None:
+                    raise local_err
+                raise CheckpointError(
+                    f"checkpoint staging failed on another process for {path}")
+            # every rank already computed write-time CRCs for its own staged
+            # files — ship them to the sealing rank, or write_marker's
+            # fallback re-streams every other host's shards over the shared
+            # fs and commit cost becomes O(total checkpoint size) on rank 0
+            all_crcs = dist.allgather_obj(getattr(self, "_stage_crcs", {}))
+            err = None
+            if jax.process_index() == 0:
+                self._stage_crcs = {name: info for crcs in all_crcs
+                                    for name, info in (crcs or {}).items()}
+                try:
+                    retry_call(self._finalize, path,
+                               getattr(self, "_last_meta", None),
+                               policy=self._finalize_retry,
+                               describe=f"sharded publish {path}")
+                except Exception as e:
+                    err = e
+            if not dist.all_agree(err is None):
+                if err is not None:
+                    raise err
+                raise CheckpointError(
+                    f"checkpoint finalize failed on process 0 for {path}")
+        elif local_err is not None:
+            raise local_err
+        self._point_latest(path)
 
     def commit(self, tag):
         path = getattr(self, "_last_path", None)
         if path is not None:
-            self._point_latest(path)
+            # _save_err and _last_path stay set until the seal SUCCEEDS: a
+            # retried commit() after a failed stage must fail again (the
+            # stage is incomplete — only a fresh save() clears the error),
+            # never silently advance 'latest'; after a transient _finalize
+            # failure the retry re-seals the intact stage and succeeds.
+            self._seal(path, local_err=getattr(self, "_save_err", None))
+            self._save_err = None
             self._last_path = None
         return True
 
     # ------------------------------------------------------------------
-    def load(self, path, template=None, shardings=None):
+    def load(self, path, template=None, shardings=None, verify=True):
         if not os.path.exists(os.path.join(path, "pieces-0.json")):
             # legacy single-file layout
             return NpzCheckpointEngine().load(path, template=template,
-                                              shardings=shardings)
+                                              shardings=shardings,
+                                              verify=verify)
+        def _entry_crc_layout():
+            """True when the pieces files carry per-entry CRCs (checked
+            after decode), so the file-level CRC of the shard npzs is
+            redundant — pre-upgrade checkpoints fall back to the file CRC."""
+            try:
+                with open(os.path.join(path, "pieces-0.json")) as f:
+                    return any(isinstance(v, dict)
+                               for v in json.load(f).values())
+            except (OSError, ValueError):
+                return False
+
+        def _verify_dir():
+            marker = atomic.read_marker(path)
+            if marker is None:
+                return None
+            skip = tuple(n for n in marker.get("files", {})
+                         if n.startswith("shards-")) \
+                if _entry_crc_layout() else ()
+            return atomic.verify_checkpoint_dir(path, skip_crc=skip)
+
+        if verify:
+            if jax.process_count() > 1:
+                # Rank 0 decides BOTH marker presence and the deep verdict in
+                # one broadcast: per-rank read_marker on a laggy network fs
+                # could diverge, leaving some ranks in a collective the
+                # others never join — and per-rank deep verification would
+                # read the whole checkpoint P times anyway.
+                from .. import comm as dist
+
+                res = _verify_dir() if jax.process_index() == 0 else None
+                res = dist.broadcast_obj(res, src=0)
+            else:
+                res = _verify_dir()
+            if res is None:
+                logger.warning("checkpoint %s has no %s marker (pre-protocol "
+                               "save?) — loading unverified", path, atomic.MARKER)
+            else:
+                ok, reason = res
+                if not ok:
+                    raise CheckpointCorruptionError(
+                        f"checkpoint {path} failed verification: {reason}")
         with open(os.path.join(path, "meta.json")) as f:
             blob = json.load(f)
 
-        # piece index across all processes: leaf -> [(ranges, file, npz key)]
+        # piece index across all processes:
+        #   leaf -> [(ranges, file, npz key, expected crc32-or-None)]
+        # (legacy pieces files carry plain lists — no per-entry CRCs)
         index = {}
         files = {}
         for fn in sorted(os.listdir(path)):
@@ -139,21 +316,39 @@ class ShardedCheckpointEngine(CheckpointEngine):
                 for key, entries in json.load(f).items():
                     for rk in entries:
                         ranges = _parse_ranges(rk.split("@", 1)[1])
-                        index.setdefault(key, []).append((ranges, shard_file, rk))
+                        crc = entries[rk] if isinstance(entries, dict) else None
+                        index.setdefault(key, []).append(
+                            (ranges, shard_file, rk, crc))
+        checked_pieces = set()
+
+        def checked(shard_file, rk, crc):
+            """End-to-end decode check of one stored piece against its
+            pieces-index CRC (once per piece — pieces are reused across
+            regions). This is what lets verified loads skip the redundant
+            whole-file CRC pass over the shard npzs."""
+            src = files[shard_file][rk]
+            if verify and crc is not None \
+                    and (shard_file, rk) not in checked_pieces:
+                if atomic.crc32_bytes(np.ascontiguousarray(src)) != crc:
+                    raise CheckpointCorruptionError(
+                        f"checkpoint {path}: piece '{rk}' fails its CRC32 "
+                        f"after decode")
+                checked_pieces.add((shard_file, rk))
+            return src
 
         def read_region(key, starts, stops, shape, dtype):
             """Assemble [starts, stops) of leaf ``key`` from stored pieces."""
             out_shape = tuple(b - a for a, b in zip(starts, stops))
             out = np.empty(out_shape, dtype)
             filled = 0
-            for ranges, shard_file, rk in index.get(key, ()):
+            for ranges, shard_file, rk, crc in index.get(key, ()):
                 src_starts = [r[0] for r in ranges]
                 src_stops = [r[1] for r in ranges]
                 lo = [max(a, sa) for a, sa in zip(starts, src_starts)]
                 hi = [min(b, sb) for b, sb in zip(stops, src_stops)]
                 if any(a >= b for a, b in zip(lo, hi)):
                     continue
-                src = files[shard_file][rk]
+                src = checked(shard_file, rk, crc)
                 src_sel = tuple(slice(a - sa, b - sa)
                                 for a, b, sa in zip(lo, hi, src_starts))
                 dst_sel = tuple(slice(a - oa, b - oa)
@@ -206,48 +401,55 @@ class ShardedCheckpointEngine(CheckpointEngine):
         return tree, blob["meta"]
 
 
-class AsyncShardedCheckpointEngine(ShardedCheckpointEngine):
+class AsyncShardedCheckpointEngine(AsyncWriterMixin, ShardedCheckpointEngine):
     """Sharded save with the file IO in a background thread; ``commit`` joins,
     re-raises any background failure, THEN repoints 'latest' (the
     Nebula-engine durability contract). The device->host shard pull and all
     collectives stay on the caller thread — donated buffers and multihost sync
     are both thread-unsafe."""
 
-    def __init__(self):
-        self._thread = None
-        self._error = None
-
-    def _join(self):
-        if self._thread is not None:
-            self._thread.join()
-            self._thread = None
-        if self._error is not None:
-            err, self._error = self._error, None
-            raise RuntimeError("async checkpoint write failed") from err
-
     def save(self, state_tree, path, meta=None):
-        import threading
-
         blobs, pieces, manifest = self._prepare(state_tree)
-        self._join()  # serialize with (and surface errors from) prior save
+        # serialize with (and surface errors from) the prior save. Multi-host:
+        # a rank-local raise here would strand the other ranks in _seal's
+        # collectives (they save fine and enter commit), so the failure is
+        # deferred to the next commit's consensus fence instead — the
+        # contract holds: a failed async checkpoint is never reported durable
+        if jax.process_count() > 1:
+            try:
+                self._drain()
+                self._save_err = None
+            except Exception as e:
+                self._save_err = e
+        else:
+            self._drain()
+            self._save_err = None  # fresh attempt: drop sticky commit failure
 
         def write():
-            try:
-                self._write(path, blobs, pieces, manifest, meta)
-            except BaseException as e:  # surfaced at commit/next save
-                self._error = e
+            retry_call(self._stage, path, blobs, pieces, manifest, meta,
+                       policy=self._retry,
+                       describe=f"async sharded stage {path}")
+            if jax.process_count() == 1:
+                retry_call(self._finalize, path, meta,
+                           policy=self._finalize_retry,
+                           describe=f"async sharded publish {path}")
 
-        self._thread = threading.Thread(target=write, daemon=True)
-        self._thread.start()
+        self._spawn_writer(write)
         self._last_path = path
+        self._last_meta = meta
 
     def commit(self, tag):
-        self._join()
-        path = getattr(self, "_last_path", None)
-        if path is not None:
-            self._point_latest(path)
-            self._last_path = None
-        return True
+        # a local background failure joins the group consensus in _seal
+        # instead of raising pre-fence and stranding the other ranks; it is
+        # recorded sticky (like a sync stage failure) so a RETRIED commit
+        # fails again instead of sealing the incomplete stage
+        try:
+            self._drain()
+        except Exception as e:
+            if getattr(self, "_last_path", None) is None:
+                raise
+            self._save_err = e
+        return super().commit(tag)
 
 
 def jnp_aslike(leaf):
@@ -261,10 +463,7 @@ def consolidate(path, out_path=None):
     ``zero_to_fp32.py`` / ``_zero3_consolidated_16bit_state_dict`` role)."""
     arrays, meta = ShardedCheckpointEngine().load(path, template=None)
     out_path = out_path or path + "-consolidated"
-    os.makedirs(out_path, exist_ok=True)
-    np.savez(os.path.join(out_path, "arrays.npz"), **arrays)
-    manifest = {k: {"shape": list(v.shape), "dtype": str(v.dtype)}
-                for k, v in arrays.items()}
-    with open(os.path.join(out_path, "meta.json"), "w") as f:
-        json.dump({"meta": meta, "manifest": manifest}, f, indent=1)
+    # the full npz commit sequence incl. per-array CRCs, minus the 'latest'
+    # swap — a consolidated side artifact must not become the resume target
+    NpzCheckpointEngine()._write_tag(arrays, out_path, meta, kind="artifact")
     return out_path

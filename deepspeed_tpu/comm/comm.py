@@ -263,10 +263,64 @@ def init_distributed(dist_backend=None, timeout=None, init_method=None, rank=-1,
             # multinode_runner.py:51 PDSHRunner)
             pid_env = str(_rank_from_hostlist(os.environ["DS_TPU_HOSTS"]))
         process_id = int(pid_env or "0")
-        jax.distributed.initialize(
+        # The coordinator races worker restarts on pod preemption: workers
+        # relaunched a beat before process 0 see connection refused. Retry the
+        # handshake with backoff instead of dying (knobs: DS_TPU_INIT_RETRIES /
+        # DS_TPU_INIT_BACKOFF seconds).
+        from ..utils.retry import RetryPolicy, retry_call
+
+        def _transient(exc):
+            # RuntimeErrors are retried only when they look like rendezvous
+            # trouble; 'already initialized' / bad-address errors must surface
+            # immediately, not after a masked backoff schedule
+            if isinstance(exc, (OSError, ConnectionError)):
+                return True
+            msg = str(exc).lower()
+            return any(s in msg for s in ("timeout", "timed out", "deadline",
+                                          "unavailable", "connect", "refused",
+                                          "reset", "temporarily"))
+
+        handshake_policy = RetryPolicy(
+            max_attempts=int(os.environ.get("DS_TPU_INIT_RETRIES", "3")),
+            base_delay=float(os.environ.get("DS_TPU_INIT_BACKOFF", "1.0")),
+            max_delay=30.0,
+            retry_on=(RuntimeError, OSError, ConnectionError),
+            retry_if=_transient,
+        )
+
+        def _teardown_half_init(exc, attempt):
+            # jax assigns global_state.client BEFORE client.connect(), so a
+            # failed handshake leaves half-initialized state and the next
+            # initialize() would die with 'should only be called once'
+            # instead of retrying the connect — tear it down between attempts
+            try:
+                jax.distributed.shutdown()
+            except Exception:
+                # client.shutdown() itself fails on a never-connected client
+                # (and then State.shutdown leaves .client set) — force-clear
+                try:
+                    from jax._src import distributed as _jdist
+
+                    state = _jdist.global_state
+                    for attr in ("client", "service"):
+                        obj = getattr(state, attr, None)
+                        if obj is not None:
+                            try:
+                                obj.shutdown()
+                            except Exception:
+                                pass
+                            setattr(state, attr, None)
+                except Exception:
+                    pass
+
+        retry_call(
+            jax.distributed.initialize,
             coordinator_address=f"{coordinator}:{port}",
             num_processes=num_processes,
             process_id=process_id,
+            policy=handshake_policy,
+            on_retry=_teardown_half_init,
+            describe=f"coordinator handshake ({coordinator}:{port})",
         )
         log_dist(
             f"Initialized distributed JAX: {num_processes} processes, "
@@ -324,6 +378,41 @@ def broadcast_obj(obj, src=0):
         buf[:] = payload
     buf = multihost_utils.broadcast_one_to_all(buf, is_source=is_source)
     return pickle.loads(np.asarray(buf).tobytes())
+
+
+def allgather_obj(obj):
+    """Host-side object all-gather: every process contributes one picklable
+    object, every process gets the list ordered by process index. Collective.
+    Payloads are pickled, padded to the group max, and moved with two
+    ``process_allgather`` calls — multihost gathers only move numeric arrays.
+    """
+    if jax.process_count() == 1:
+        return [obj]
+    import pickle
+
+    from jax.experimental import multihost_utils
+
+    payload = np.frombuffer(pickle.dumps(obj), dtype=np.uint8)
+    sizes = np.asarray(multihost_utils.process_allgather(
+        np.asarray(payload.size, np.int64))).reshape(-1)
+    buf = np.zeros(int(sizes.max()), np.uint8)
+    buf[:payload.size] = payload
+    gathered = np.asarray(multihost_utils.process_allgather(buf))
+    return [pickle.loads(gathered[i, :int(sizes[i])].tobytes())
+            for i in range(gathered.shape[0])]
+
+
+def all_agree(flag):
+    """Host-side consensus: True iff EVERY process passes ``flag`` truthy.
+    Collective — all processes must call it. Single-process: just bool(flag).
+    Used where a rank-local failure (e.g. one host's checkpoint read) must
+    fail the whole group instead of letting ranks silently diverge."""
+    if jax.process_count() == 1:
+        return bool(flag)
+    from jax.experimental import multihost_utils
+
+    flags = multihost_utils.process_allgather(np.asarray(bool(flag)))
+    return bool(np.all(flags))
 
 
 def assert_same_across_ranks(values, name="value"):

@@ -187,6 +187,20 @@ class CheckpointConfig(ConfigModel):
 
     engine: str = "sharded"  # sharded | npz
     async_save: bool = False
+    # transient-I/O retry (network filesystems): total attempts per durable
+    # write step, and the exponential-backoff base delay in seconds
+    retries: int = 3
+    retry_backoff: float = 0.05
+
+    def _validate(self):
+        if self.retries < 1:
+            raise ConfigError(
+                f"checkpoint.retries is the TOTAL attempts per durable write "
+                f"step and must be >= 1 (1 = no retry), got {self.retries}")
+        if self.retry_backoff < 0:
+            raise ConfigError(
+                f"checkpoint.retry_backoff must be >= 0, got "
+                f"{self.retry_backoff}")
 
 
 class PipelineConfig(ConfigModel):

@@ -12,21 +12,36 @@ preemption arrives as SIGTERM with a grace window. The agent
 - on (re)start it loads the latest checkpoint INTO WHATEVER MESH the new
   engine has — the index-range-addressed checkpoint reshapes itself, and the
   elastic batch config (``compute_elastic_config``, ported reference math)
-  keeps the global batch constant across world sizes.
+  keeps the global batch constant across world sizes;
+- resume walks the **recovery chain**: if ``latest`` names a missing or
+  corrupt tag (preempted mid-save, torn write, bit rot), the bad tag is
+  quarantined to ``<tag>.corrupt`` and the next-newest COMMITTED checkpoint
+  is tried, until one verifies and loads — a preempted pod can always
+  restart from *some* valid state;
+- ``keep_last=N`` prunes the oldest committed tags after each save so
+  preemption-heavy runs don't fill the disk (the newest valid checkpoint is
+  never pruned).
 """
 
 import os
+import shutil
 import signal
 
-from ..utils.logging import log_dist
+from ..checkpoint import atomic
+from ..utils.logging import log_dist, logger
 
 
 class ElasticAgent:
-    def __init__(self, engine, save_dir, *, save_interval=100, tag_prefix="elastic"):
+    def __init__(self, engine, save_dir, *, save_interval=100,
+                 tag_prefix="elastic", keep_last=None):
         self.engine = engine
         self.save_dir = save_dir
         self.save_interval = save_interval
         self.tag_prefix = tag_prefix
+        if keep_last is not None and keep_last < 1:
+            raise ValueError("keep_last must be >= 1 (the newest valid "
+                             "checkpoint is never pruned)")
+        self.keep_last = keep_last
         self._preempted = False
         self._prev_handlers = {}
 
@@ -51,17 +66,162 @@ class ElasticAgent:
 
     def save(self):
         self.engine.save_checkpoint(self.save_dir, tag=self._tag())
+        if self.keep_last is not None:
+            self._prune()
+
+    def _prune(self):
+        """Retention: drop this agent's committed tags (``<tag_prefix>-*``)
+        beyond the newest ``keep_last`` *valid* ones — never tags some other
+        writer put in the same save_dir. Uncommitted stages and quarantined dirs are left for
+        fsck; the newest valid checkpoint always survives. Multi-process:
+        only process 0 mutates the shared directory (save_checkpoint's
+        commit barrier has already fenced every rank's shards)."""
+        import jax
+
+        if jax.process_count() > 1 and jax.process_index() != 0:
+            return
+        prefix = self.tag_prefix + "-"
+        valid = []
+        for tag in atomic.list_tags(self.save_dir, newest_first=True):
+            if not tag.startswith(prefix):
+                continue  # not ours: a shared save_dir may hold user tags
+            ok, _ = atomic.verify_checkpoint_dir(
+                os.path.join(self.save_dir, tag), deep=False)
+            if ok:
+                valid.append(tag)
+        for tag in valid[self.keep_last:]:
+            path = os.path.join(self.save_dir, tag)
+            log_dist(f"ElasticAgent: pruning old checkpoint {tag} "
+                     f"(keep_last={self.keep_last})", ranks=[0])
+            shutil.rmtree(path, ignore_errors=True)
+
+    def _walk_candidates(self):
+        """Shallow ordering pass over the resume chain (marker presence +
+        file sizes only — deep CRC verification happens lazily in
+        ``try_resume`` right before a candidate is loaded, so a restart pays
+        one full read of ONE checkpoint, not of every retained tag). Returns
+        ``(verified, legacy, skipped)``: marker-bearing tags in resume order,
+        marker-less pre-protocol tags demoted behind them, and ``(tag,
+        reason)`` pairs for everything quarantined."""
+        verified, legacy, skipped = [], [], []
+        for tag in atomic.resume_candidates(self.save_dir):
+            path = os.path.join(self.save_dir, tag)
+            if atomic.read_marker(path) is None:
+                legacy.append(tag)  # pre-protocol save: unverifiable, not corrupt
+                continue
+            ok, reason = atomic.verify_checkpoint_dir(path, deep=False)
+            if not ok:
+                skipped.append((tag, reason))
+                # "unverifiable" = transient I/O, not proof of corruption —
+                # skip it this restart but leave the data in place
+                if not atomic.is_transient_verify_failure(reason):
+                    atomic.quarantine(path)
+                continue
+            verified.append(tag)
+        return verified, legacy, skipped
 
     def try_resume(self):
-        """Load the newest checkpoint if one exists; reshapes to the current
-        engine's mesh automatically. Returns the restored step (or 0)."""
-        latest = os.path.join(self.save_dir, "latest")
-        if not os.path.exists(latest):
-            return 0
-        self.engine.load_checkpoint(self.save_dir)
-        log_dist(f"ElasticAgent: resumed at step {self.engine.global_steps} "
-                 f"on mesh {dict(self.engine.mesh.shape)}", ranks=[0])
-        return self.engine.global_steps
+        """Resume from the newest *valid* checkpoint; reshapes to the current
+        engine's mesh automatically. Returns the restored step (or 0).
+
+        Walks the recovery chain: the ``latest`` pointer's target first, then
+        every other published tag newest-first; marker-less (pre-protocol)
+        checkpoints are demoted to last-resort candidates rather than treated
+        as corrupt. Quarantine to ``<tag>.corrupt`` happens only on *proven*
+        corruption (checksum/size mismatch, missing files, or a corruption
+        error during load) — never for legacy layouts, transient I/O errors,
+        or shape-incompatible-but-intact checkpoints — and the walk
+        continues, so a stale or torn ``latest`` never prevents restart.
+        """
+        import jax
+
+        from ..checkpoint.atomic import CheckpointCorruptionError
+        from ..utils.retry import io_retry_policy, retry_call
+
+        multi = jax.process_count() > 1
+        if multi:
+            # filesystem decisions (verify/quarantine/candidate order) must be
+            # made ONCE — per-rank walks would quarantine dirs out from under
+            # each other's collective load. Process 0 decides, everyone loads.
+            from .. import comm as dist
+
+            order = self._walk_candidates() \
+                if jax.process_index() == 0 else None
+            verified, legacy, skipped = dist.broadcast_obj(order, src=0)
+        else:
+            verified, legacy, skipped = self._walk_candidates()
+        decides = not multi or jax.process_index() == 0
+        tainted = False
+        for tag in verified + legacy:
+            path = os.path.join(self.save_dir, tag)
+            if tag not in legacy:
+                # deep-CRC only the candidate about to be loaded — not the
+                # whole retained chain (legacy tags have nothing to check)
+                res = atomic.verify_checkpoint_dir(path) if decides else None
+                ok, reason = dist.broadcast_obj(res, src=0) if multi else res
+                if not ok:
+                    skipped.append((tag, reason))
+                    if decides and \
+                            not atomic.is_transient_verify_failure(reason):
+                        atomic.quarantine(path)
+                    continue
+            corrupt = False
+            try:
+                # verify=False: this tag was just deep-checksummed above.
+                # Reuse the policy the engine was configured with
+                # (checkpoint.retries / retry_backoff), not the env defaults
+                retry_call(self.engine.load_checkpoint, self.save_dir,
+                           tag=tag, verify=False,
+                           policy=getattr(self.engine.checkpoint_engine,
+                                          "_retry", None) or io_retry_policy(),
+                           describe=f"resume load {tag}")
+                loaded, err = True, None
+            except Exception as e:
+                loaded, err = False, e
+                corrupt = isinstance(e, CheckpointCorruptionError)
+            if multi:
+                # one host failing its shard read must fail the whole group,
+                # or ranks resume from DIFFERENT tags and silently diverge
+                group_ok = dist.all_agree(loaded)
+                # a locally-loaded but group-rejected tag left this rank's
+                # engine holding that tag's state; a later successful load
+                # fully overwrites it, but if the chain ends here the ranks
+                # are divergent — remember, and fail loudly at the end
+                tainted = tainted or (loaded and not group_ok)
+                loaded = group_ok
+            if not loaded:
+                skipped.append(
+                    (tag, f"load failed: {err or 'on another process'}"))
+                # quarantine only proven corruption (never shape changes or
+                # transient I/O), only by the deciding process, and only
+                # after every rank has exited the load (the consensus above
+                # is the fence) — keep unloadable-but-intact data around
+                if corrupt and decides:
+                    atomic.quarantine(path)
+                continue
+            if skipped:
+                logger.warning(
+                    "ElasticAgent: skipped %d corrupt checkpoint(s) on "
+                    "resume: %s", len(skipped),
+                    "; ".join(f"{t} ({r})" for t, r in skipped))
+            log_dist(f"ElasticAgent: resumed at step {self.engine.global_steps} "
+                     f"on mesh {dict(self.engine.mesh.shape)}", ranks=[0])
+            return self.engine.global_steps
+        if multi and not dist.all_agree(not tainted):
+            # some rank still holds a group-rejected tag's loaded state while
+            # others hold fresh init — "resume from step 0" would silently
+            # diverge. Every rank raises together; a restart re-walks cleanly.
+            from ..checkpoint.atomic import CheckpointError
+
+            raise CheckpointError(
+                "resume chain exhausted after a group-rejected load left "
+                "process state inconsistent across ranks — restart the job")
+        if skipped:
+            logger.warning(
+                "ElasticAgent: no valid checkpoint found under %s (%d "
+                "quarantined: %s) — starting from step 0", self.save_dir,
+                len(skipped), "; ".join(f"{t} ({r})" for t, r in skipped))
+        return 0
 
     # -- the loop -----------------------------------------------------------
     def run(self, data_iter, total_steps):

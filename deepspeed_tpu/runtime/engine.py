@@ -389,20 +389,25 @@ class DeepSpeedEngine:
 
         # -- checkpointing -----------------------------------------------------------
         ckpt_cfg = self._config.checkpoint
+        from ..utils.retry import RetryPolicy
+
+        ckpt_retry = RetryPolicy(max_attempts=ckpt_cfg.retries,
+                                 base_delay=ckpt_cfg.retry_backoff,
+                                 retry_on=(OSError,))
         if ckpt_cfg.engine == "sharded":
             from ..checkpoint.sharded import (AsyncShardedCheckpointEngine,
                                               ShardedCheckpointEngine)
 
-            self.checkpoint_engine = AsyncShardedCheckpointEngine() \
-                if ckpt_cfg.async_save else ShardedCheckpointEngine()
+            self.checkpoint_engine = AsyncShardedCheckpointEngine(ckpt_retry) \
+                if ckpt_cfg.async_save else ShardedCheckpointEngine(ckpt_retry)
         elif ckpt_cfg.async_save:
             from ..checkpoint.engine import AsyncCheckpointEngine
 
-            self.checkpoint_engine = AsyncCheckpointEngine()
+            self.checkpoint_engine = AsyncCheckpointEngine(ckpt_retry)
         else:
             from ..checkpoint.engine import NpzCheckpointEngine
 
-            self.checkpoint_engine = NpzCheckpointEngine()
+            self.checkpoint_engine = NpzCheckpointEngine(ckpt_retry)
 
         # -- compiled functions (built lazily) ---------------------------------------
         self._fwd_bwd_fn = None
@@ -1492,23 +1497,34 @@ class DeepSpeedEngine:
         log_dist(f"Saved checkpoint {path}", ranks=[0])
         return path
 
-    def load_checkpoint(self, load_dir, tag=None, load_optimizer_states=True):
+    def load_checkpoint(self, load_dir, tag=None, load_optimizer_states=True,
+                        verify=True):
         if tag is None:
-            latest = os.path.join(load_dir, "latest")
-            if os.path.exists(latest):
-                tag = open(latest).read().strip()
-            else:
-                tags = sorted(d for d in os.listdir(load_dir)
-                              if os.path.isdir(os.path.join(load_dir, d)))
+            from ..checkpoint import atomic as ckpt_atomic
+
+            tag = ckpt_atomic.read_latest(load_dir)
+            if tag is not None and not os.path.isdir(
+                    os.path.join(load_dir, tag)):
+                # dangling pointer: the tag was quarantined/pruned out from
+                # under it (routine after try_resume's recovery walk)
+                log_dist(f"checkpoint 'latest' points at missing tag "
+                         f"{tag!r} — falling back to newest published tag",
+                         ranks=[0])
+                tag = None
+            if tag is None:
+                # newest published tag; stale .tmp stages and quarantined
+                # .corrupt dirs are never resume targets
+                tags = ckpt_atomic.list_tags(load_dir, newest_first=True)
                 if not tags:
                     return None, {}
-                tag = tags[-1]
+                tag = tags[0]
         path = os.path.join(load_dir, tag)
         if self._offloaded is not None:
             template = {"params": self._offloaded.masters,
                         "optimizer_state": self._offloaded.state_for_checkpoint()}
             state, meta = self.checkpoint_engine.load(path, template=template,
-                                                      shardings=None)
+                                                      shardings=None,
+                                                      verify=verify)
             self._offloaded.load_masters(state["params"])
             if load_optimizer_states:
                 self._offloaded.load_state(state["optimizer_state"])
@@ -1518,7 +1534,8 @@ class DeepSpeedEngine:
             shardings = {"params": self.param_shardings,
                          "optimizer_state": self._opt_shardings}
             state, meta = self.checkpoint_engine.load(path, template=template,
-                                                      shardings=shardings)
+                                                      shardings=shardings,
+                                                      verify=verify)
             self.params = state["params"]
             if load_optimizer_states:
                 self.optimizer_state = state["optimizer_state"]

@@ -1,0 +1,10 @@
+from .fault_injection import (
+    FaultInjector,
+    InjectedFault,
+    truncate_file,
+    sigterm_data_iter,
+)
+
+__all__ = [
+    "FaultInjector", "InjectedFault", "truncate_file", "sigterm_data_iter",
+]

@@ -1,0 +1,186 @@
+"""Deterministic fault injection for checkpoint durability tests.
+
+Hooks into the fault-point seam in ``checkpoint/atomic.py`` (every durable
+write funnels through ``write_bytes``/``write_npz``/``write_json``, which fire
+``fault_point(event, path)`` before and after touching the disk, plus
+``replace``/``latest`` events at the two commit points). The injector can:
+
+- **fail the Nth write** matching a path pattern (transient or permanent) —
+  simulates ENOSPC / network-fs flakes / a crash mid-save;
+- **truncate a file right after it is written** — simulates a torn write that
+  made it to disk (fired at the ``wrote`` event, before fsync);
+- **raise only in a background thread** — proves async writer failures
+  surface at ``commit()`` instead of vanishing;
+- **deliver SIGTERM at a chosen training step** via ``sigterm_data_iter`` —
+  drives the ElasticAgent preemption path end-to-end.
+
+All counters are deterministic: the Nth matching event is the Nth call, no
+randomness. Usage::
+
+    with FaultInjector() as fi:
+        fi.fail_write(match="arrays.npz")            # every write fails
+        fi.fail_write(match="meta.json", nth=2, times=1)  # only the 2nd
+        ...
+
+The harness is test-only but ships in the package so downstream users can
+prove their own recovery paths.
+"""
+
+import os
+import threading
+
+from ..checkpoint import atomic
+
+
+class InjectedFault(OSError):
+    """Default exception raised by injected write failures. An ``OSError``
+    subclass so it exercises the real retry/backoff path."""
+
+
+def truncate_file(path, keep_bytes=None, drop_bytes=None):
+    """Deterministically truncate ``path``: keep the first ``keep_bytes``, or
+    drop the last ``drop_bytes`` (default: drop half)."""
+    size = os.path.getsize(path)
+    if keep_bytes is None:
+        keep_bytes = max(0, size - (drop_bytes if drop_bytes is not None
+                                    else size // 2))
+    with open(path, "rb+") as f:
+        f.truncate(keep_bytes)
+    return keep_bytes
+
+
+def sigterm_data_iter(data_iter, at_step):
+    """Wrap a training data iterator; the ``at_step``-th ``next()`` (1-based)
+    delivers SIGTERM to this process before yielding — the preemption arrives
+    exactly at a chosen step."""
+    import signal
+
+    step = 0
+    for batch in data_iter:
+        step += 1
+        if step == at_step:
+            os.kill(os.getpid(), signal.SIGTERM)
+        yield batch
+
+
+class _Fault:
+    def __init__(self, event, match, nth, times, action, only_background):
+        self.event = event
+        self.match = match
+        self.nth = nth
+        self.times = times  # None = every match from nth on
+        self.action = action
+        self.only_background = only_background
+        self.seen = 0
+        self.fired = 0
+
+    def maybe_fire(self, event, path):
+        if event != self.event:
+            return
+        if self.match and self.match not in path:
+            return
+        if (self.only_background
+                and threading.current_thread() is threading.main_thread()):
+            return
+        self.seen += 1
+        if self.seen < self.nth:
+            return
+        if self.times is not None and self.fired >= self.times:
+            return
+        self.fired += 1
+        self.action(path)
+
+
+class FaultInjector:
+    """Context manager registering deterministic faults at the atomic-write
+    seam. Faults stack; each keeps its own occurrence counter."""
+
+    def __init__(self):
+        self._faults = []
+        self._hook_installed = False
+
+    # -- registration -------------------------------------------------------
+    def _add(self, event, match, nth, times, action, only_background=False):
+        fault = _Fault(event, match, nth, times, action, only_background)
+        self._faults.append(fault)
+        return fault
+
+    def fail_write(self, match="", nth=1, times=None, exc=None,
+                   only_background=False):
+        """Raise before the Nth matching data-file write (and on every later
+        match unless ``times`` bounds it). ``times=1`` models a transient
+        error the retry policy should absorb."""
+        err = exc or InjectedFault(f"injected write failure (match={match!r})")
+
+        def action(path):
+            raise err
+
+        return self._add("write", match, nth, times, action, only_background)
+
+    def truncate_write(self, match="", nth=1, times=1, keep_bytes=0,
+                       then_fail=True):
+        """Truncate the file right after the Nth matching write lands (the
+        ``wrote`` event — on disk, not yet fsynced). With ``then_fail`` (the
+        default) the write call also raises: the classic torn-write crash —
+        half a file on disk and the save dead. ``then_fail=False`` leaves the
+        truncation silent, which the COMMITTED marker will then faithfully
+        checksum — use :func:`truncate_file` on a *committed* checkpoint to
+        model post-commit corruption instead."""
+
+        def action(path):
+            truncate_file(path, keep_bytes=keep_bytes)
+            if then_fail:
+                raise InjectedFault(f"injected torn write on {path}")
+
+        return self._add("wrote", match, nth, times, action)
+
+    def fail_replace(self, match="", nth=1, times=None, exc=None):
+        """Raise at the tag-dir commit rename — the save died after staging
+        everything but before publication."""
+        err = exc or InjectedFault("injected failure at tag publish")
+
+        def action(path):
+            raise err
+
+        return self._add("replace", match, nth, times, action)
+
+    def fail_latest(self, match="", nth=1, times=None, exc=None):
+        """Raise at the ``latest``-pointer swap — the tag committed but the
+        pointer never moved (resume must still find the tag)."""
+        err = exc or InjectedFault("injected failure at latest swap")
+
+        def action(path):
+            raise err
+
+        return self._add("latest", match, nth, times, action)
+
+    def fail_async_write(self, match="", nth=1, times=None, exc=None):
+        """Like :meth:`fail_write` but only fires off the main thread —
+        targets the async engines' background writer specifically."""
+        return self.fail_write(match=match, nth=nth, times=times, exc=exc,
+                               only_background=True)
+
+    # -- bookkeeping --------------------------------------------------------
+    @property
+    def total_fired(self):
+        return sum(f.fired for f in self._faults)
+
+    def writes_seen(self):
+        """Matching-event counts per registered fault (harness self-tests)."""
+        return [f.seen for f in self._faults]
+
+    # -- hook lifecycle -----------------------------------------------------
+    def _hook(self, event, path):
+        for fault in self._faults:
+            fault.maybe_fire(event, path)
+
+    def __enter__(self):
+        atomic.register_fault_hook(self._hook)
+        self._hook_installed = True
+        return self
+
+    def __exit__(self, *exc_info):
+        if self._hook_installed:
+            atomic.unregister_fault_hook(self._hook)
+            self._hook_installed = False
+        return False

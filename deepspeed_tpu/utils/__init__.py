@@ -1,5 +1,6 @@
 from .logging import logger, log_dist
 from .init_on_device import OnDevice
+from .retry import RetryPolicy, retry_call, retryable, io_retry_policy
 from .timer import SynchronizedWallClockTimer, ThroughputTimer
 from .tensor_fragment import (
     param_names,
@@ -12,6 +13,7 @@ from .tensor_fragment import (
 
 __all__ = [
     "logger", "log_dist", "OnDevice",
+    "RetryPolicy", "retry_call", "retryable", "io_retry_policy",
     "SynchronizedWallClockTimer", "ThroughputTimer",
     "param_names",
     "safe_get_full_fp32_param", "safe_get_full_grad",

@@ -1,0 +1,106 @@
+"""Retry with exponential backoff for flaky I/O and rendezvous paths.
+
+Checkpoint writes hit network filesystems (GCS fuse, NFS) where transient
+``OSError``s are routine, and ``jax.distributed.initialize`` races the
+coordinator process coming up on pod restart. Both get the same treatment:
+a :class:`RetryPolicy` (attempts, exponential backoff, jitter, exception
+filter) applied via :func:`retry_call` or the :func:`retryable` decorator.
+"""
+
+import functools
+import os
+import random
+import time
+
+from .logging import logger
+
+
+class RetryPolicy:
+    """max_attempts total tries; delay before retry ``i`` (1-based) is
+    ``min(max_delay, base_delay * multiplier**(i-1))`` scaled by up to
+    ``jitter`` fractional randomness. ``retry_on`` filters which exception
+    types are retried — anything else propagates immediately."""
+
+    def __init__(self, max_attempts=3, base_delay=0.05, multiplier=2.0,
+                 max_delay=5.0, jitter=0.25, retry_on=(OSError,), seed=None,
+                 retry_if=None):
+        if max_attempts < 1:
+            raise ValueError("max_attempts must be >= 1")
+        self.max_attempts = max_attempts
+        self.base_delay = base_delay
+        self.multiplier = multiplier
+        self.max_delay = max_delay
+        self.jitter = jitter
+        self.retry_on = tuple(retry_on)
+        # optional predicate ANDed with the type filter — e.g. match only
+        # transient-looking messages so permanent errors surface immediately
+        self.retry_if = retry_if
+        self._rng = random.Random(seed)
+
+    def excluding(self, *exc_types):
+        """Clone of this policy with ``exc_types`` made non-retryable —
+        for call sites where an otherwise-retryable type is known terminal
+        (composes with any existing ``retry_if``)."""
+        prev = self.retry_if
+        return RetryPolicy(
+            max_attempts=self.max_attempts, base_delay=self.base_delay,
+            multiplier=self.multiplier, max_delay=self.max_delay,
+            jitter=self.jitter, retry_on=self.retry_on,
+            retry_if=lambda exc: not isinstance(exc, exc_types)
+            and (prev is None or bool(prev(exc))))
+
+    def delay(self, attempt):
+        """Backoff before retry number ``attempt`` (1 = first retry)."""
+        base = min(self.max_delay, self.base_delay * self.multiplier ** (attempt - 1))
+        return base * (1.0 + self.jitter * self._rng.random())
+
+    def should_retry(self, exc, attempt):
+        if attempt >= self.max_attempts or not isinstance(exc, self.retry_on):
+            return False
+        return self.retry_if is None or bool(self.retry_if(exc))
+
+
+def retry_call(fn, *args, policy=None, describe=None, on_retry=None, **kwargs):
+    """Call ``fn(*args, **kwargs)`` under ``policy``; re-raises the last
+    exception once attempts are exhausted (or immediately for non-retryable
+    types). ``on_retry(exc, attempt)`` runs before each sleep."""
+    policy = policy or RetryPolicy()
+    what = describe or getattr(fn, "__name__", repr(fn))
+    attempt = 0
+    while True:
+        attempt += 1
+        try:
+            return fn(*args, **kwargs)
+        except BaseException as exc:
+            if not policy.should_retry(exc, attempt):
+                raise
+            delay = policy.delay(attempt)
+            logger.warning("%s failed (attempt %d/%d): %s — retrying in %.2fs",
+                           what, attempt, policy.max_attempts, exc, delay)
+            if on_retry is not None:
+                on_retry(exc, attempt)
+            if delay > 0:
+                time.sleep(delay)
+
+
+def retryable(policy=None, describe=None):
+    """Decorator form of :func:`retry_call`."""
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            return retry_call(fn, *args, policy=policy,
+                              describe=describe or fn.__qualname__, **kwargs)
+
+        return wrapper
+
+    return deco
+
+
+def io_retry_policy():
+    """Default policy for checkpoint I/O; knobs via env for ops overrides."""
+    return RetryPolicy(
+        max_attempts=int(os.environ.get("DS_TPU_CKPT_RETRIES", "3")),
+        base_delay=float(os.environ.get("DS_TPU_CKPT_BACKOFF", "0.05")),
+        retry_on=(OSError,),
+    )

@@ -8,16 +8,52 @@ jax is imported anywhere.
 """
 
 import os
+import subprocess
+import sys
 
 os.environ["JAX_PLATFORMS"] = "cpu"  # force: the shell presets JAX_PLATFORMS=axon (TPU)
+
+_COLLECTIVE_FLAGS = ("--xla_cpu_collective_call_terminate_timeout_seconds=600"
+                     " --xla_cpu_collective_timeout_seconds=600")
+
+
+def _collective_flags_supported():
+    """XLA hard-aborts the process on unknown XLA_FLAGS, so the collective
+    timeout flags (absent from older jaxlibs) must be probed in a subprocess
+    before we inject them. Cached per jaxlib version."""
+    import jaxlib
+
+    cache = os.path.join(os.path.dirname(__file__), ".jax_cache",
+                         f"xla_flag_probe-{jaxlib.__version__}")
+    if os.path.exists(cache):
+        return open(cache).read().strip() == "yes"
+    env = dict(os.environ,
+               XLA_FLAGS=_COLLECTIVE_FLAGS, JAX_PLATFORMS="cpu")
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; jax.config.update('jax_platforms', 'cpu'); jax.devices()"],
+            env=env, capture_output=True, timeout=120)
+    except (subprocess.SubprocessError, OSError):
+        return False  # transient (timeout, load): skip flags now, re-probe next run
+    ok = proc.returncode == 0
+    if ok or b"Unknown flags" in proc.stderr:
+        # only cache definitive answers; a flaky crash shouldn't permanently
+        # disable the collective-timeout flags for this jaxlib
+        os.makedirs(os.path.dirname(cache), exist_ok=True)
+        with open(cache, "w") as f:
+            f.write("yes" if ok else "no")
+    return ok
+
+
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     _flags += " --xla_force_host_platform_device_count=8"
-if "xla_cpu_collective_call_terminate_timeout_seconds" not in _flags:
+if ("xla_cpu_collective_call_terminate_timeout_seconds" not in _flags
+        and _collective_flags_supported()):
     # 8 emulated devices share this box's core(s); under load the default 40s
     # collective rendezvous can fire spuriously and SIGABRT the whole suite
-    _flags += (" --xla_cpu_collective_call_terminate_timeout_seconds=600"
-               " --xla_cpu_collective_timeout_seconds=600")
+    _flags += " " + _COLLECTIVE_FLAGS
 os.environ["XLA_FLAGS"] = _flags.strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
 

@@ -1,0 +1,690 @@
+"""Fault-tolerant checkpointing: atomic commit, corruption recovery, retry.
+
+Every scenario from the durability contract (``checkpoint/atomic.py``):
+an interrupted save never advances ``latest``; resume always finds the
+newest *valid* checkpoint, quarantining anything corrupt along the way;
+async writer failures surface at ``commit()``; SIGTERM at an arbitrary step
+still ends with a loadable checkpoint. Faults are injected deterministically
+via ``deepspeed_tpu.testing.fault_injection``.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.checkpoint import atomic
+from deepspeed_tpu.checkpoint.atomic import CheckpointCorruptionError
+from deepspeed_tpu.checkpoint.engine import (AsyncCheckpointEngine,
+                                             NpzCheckpointEngine)
+from deepspeed_tpu.elasticity import ElasticAgent
+from deepspeed_tpu.models import get_model
+from deepspeed_tpu.testing import (FaultInjector, InjectedFault,
+                                   sigterm_data_iter, truncate_file)
+from deepspeed_tpu.utils.retry import RetryPolicy, retry_call
+
+pytestmark = pytest.mark.faults
+
+FAST_RETRY = RetryPolicy(max_attempts=2, base_delay=0.0, jitter=0.0)
+
+
+def _state(step=0):
+    return {"w": np.arange(64, dtype=np.float32) + step,
+            "b": np.full((8,), float(step), np.float32)}
+
+
+def _save(tmp_path, tag, step=0, engine=None):
+    eng = engine or NpzCheckpointEngine(FAST_RETRY)
+    eng.save(_state(step), str(tmp_path / tag), meta={"global_steps": step})
+    eng.commit(tag)
+    return eng
+
+
+# ---------------------------------------------------------------------------
+# atomic protocol
+# ---------------------------------------------------------------------------
+def test_commit_protocol_on_disk_layout(tmp_path):
+    _save(tmp_path, "t1", step=5)
+    marker = atomic.read_marker(str(tmp_path / "t1"))
+    assert marker["step"] == 5
+    assert set(marker["files"]) == {"arrays.npz", "meta.json"}
+    assert set(marker["arrays"]) == {"w", "b"}
+    for info in marker["files"].values():
+        assert info["size"] > 0 and 0 <= info["crc32"] <= 0xFFFFFFFF
+    assert atomic.read_latest(str(tmp_path)) == "t1"
+    assert not (tmp_path / "t1.tmp").exists()
+    ok, reason = atomic.verify_checkpoint_dir(str(tmp_path / "t1"))
+    assert ok, reason
+
+
+def test_failed_save_never_advances_latest(tmp_path):
+    eng = _save(tmp_path, "t1", step=1)
+    with FaultInjector() as fi:
+        fi.fail_write(match="arrays.npz")  # permanent: retries exhaust
+        with pytest.raises(OSError):
+            eng.save(_state(2), str(tmp_path / "t2"),
+                     meta={"global_steps": 2})
+    assert atomic.read_latest(str(tmp_path)) == "t1"
+    assert not (tmp_path / "t2").exists()
+    # the good checkpoint is untouched
+    ok, reason = atomic.verify_checkpoint_dir(str(tmp_path / "t1"))
+    assert ok, reason
+
+
+def test_torn_write_never_advances_latest(tmp_path):
+    eng = _save(tmp_path, "t1", step=1)
+    with FaultInjector() as fi:
+        fi.truncate_write(match="arrays.npz", times=None)  # truncate + crash
+        with pytest.raises(OSError):
+            eng.save(_state(2), str(tmp_path / "t2"),
+                     meta={"global_steps": 2})
+    assert atomic.read_latest(str(tmp_path)) == "t1"
+    assert not (tmp_path / "t2").exists()
+
+
+def test_transient_write_failure_is_retried(tmp_path):
+    eng = NpzCheckpointEngine(RetryPolicy(max_attempts=3, base_delay=0.0,
+                                          jitter=0.0))
+    with FaultInjector() as fi:
+        fault = fi.fail_write(match="arrays.npz", times=1)  # first try only
+        eng.save(_state(3), str(tmp_path / "t"), meta={"global_steps": 3})
+        eng.commit("t")
+    assert fault.fired == 1
+    assert atomic.read_latest(str(tmp_path)) == "t"
+    out, meta = eng.load(str(tmp_path / "t"))
+    np.testing.assert_array_equal(out["w"], _state(3)["w"])
+
+
+def test_failed_latest_swap_leaves_tag_loadable(tmp_path):
+    eng = _save(tmp_path, "t1", step=1)
+    with FaultInjector() as fi:
+        fi.fail_latest()
+        with pytest.raises(OSError):
+            eng.save(_state(2), str(tmp_path / "t2"),
+                     meta={"global_steps": 2})
+    # tag committed, pointer stale — exactly the state the resume chain handles
+    assert atomic.read_latest(str(tmp_path)) == "t1"
+    ok, _ = atomic.verify_checkpoint_dir(str(tmp_path / "t2"))
+    assert ok
+    # commit semantics: the latest POINTER is the commit record, so t1 leads;
+    # the orphaned-but-durable t2 stays in the chain as a fallback
+    assert atomic.resume_candidates(str(tmp_path)) == ["t1", "t2"]
+
+
+def test_load_detects_truncated_arrays(tmp_path):
+    eng = _save(tmp_path, "t1", step=1)
+    truncate_file(str(tmp_path / "t1" / "arrays.npz"), drop_bytes=16)
+    with pytest.raises(CheckpointCorruptionError, match="mismatch"):
+        eng.load(str(tmp_path / "t1"))
+
+
+def test_load_verifies_per_array_crcs(tmp_path):
+    """The marker's per-array CRCs are checked after npz decode — corruption
+    the file-level CRC can't see (here simulated by editing the marker, which
+    is itself outside the file checksum set) still fails the load."""
+    eng = _save(tmp_path, "t1", step=1)
+    marker_path = tmp_path / "t1" / "COMMITTED"
+    marker = json.loads(marker_path.read_text())
+    marker["arrays"]["w"] ^= 0xDEADBEEF
+    marker_path.write_text(json.dumps(marker))
+    ok, _ = atomic.verify_checkpoint_dir(str(tmp_path / "t1"))
+    assert ok  # file-level view is clean...
+    with pytest.raises(CheckpointCorruptionError, match="CRC32 after decode"):
+        eng.load(str(tmp_path / "t1"))  # ...the decode check is not
+
+
+def test_verify_detects_missing_marker_and_files(tmp_path):
+    _save(tmp_path, "t1")
+    os.remove(tmp_path / "t1" / "COMMITTED")
+    ok, reason = atomic.verify_checkpoint_dir(str(tmp_path / "t1"))
+    assert not ok and "marker" in reason
+
+    _save(tmp_path, "t2")
+    os.remove(tmp_path / "t2" / "arrays.npz")
+    ok, reason = atomic.verify_checkpoint_dir(str(tmp_path / "t2"))
+    assert not ok and "missing file" in reason
+
+
+# ---------------------------------------------------------------------------
+# async engine durability
+# ---------------------------------------------------------------------------
+def test_async_writer_failure_surfaces_in_commit(tmp_path):
+    eng = AsyncCheckpointEngine(FAST_RETRY)
+    with FaultInjector() as fi:
+        fi.fail_async_write(match="arrays.npz")
+        eng.save(_state(1), str(tmp_path / "t1"), meta={"global_steps": 1})
+        with pytest.raises(RuntimeError, match="async checkpoint write failed"):
+            eng.commit("t1")
+    assert atomic.read_latest(str(tmp_path)) is None
+    assert not (tmp_path / "t1").exists()
+
+
+def test_async_writer_failure_surfaces_in_next_save(tmp_path):
+    eng = AsyncCheckpointEngine(FAST_RETRY)
+    with FaultInjector() as fi:
+        fi.fail_async_write(match="arrays.npz", times=2)  # both retries of save 1
+        eng.save(_state(1), str(tmp_path / "t1"), meta={"global_steps": 1})
+        with pytest.raises(RuntimeError, match="async checkpoint write failed"):
+            eng.save(_state(2), str(tmp_path / "t2"), meta={"global_steps": 2})
+    # the error is surfaced exactly once; the engine is reusable afterwards
+    eng.save(_state(3), str(tmp_path / "t3"), meta={"global_steps": 3})
+    assert eng.commit("t3")
+    assert atomic.read_latest(str(tmp_path)) == "t3"
+
+
+def test_async_good_save_roundtrips(tmp_path):
+    eng = AsyncCheckpointEngine(FAST_RETRY)
+    eng.save(_state(4), str(tmp_path / "t"), meta={"global_steps": 4})
+    assert eng.commit("t")
+    out, meta = eng.load(str(tmp_path / "t"))
+    np.testing.assert_array_equal(out["w"], _state(4)["w"])
+    assert meta["global_steps"] == 4
+
+
+def test_async_sharded_failure_never_advances_latest(tmp_path, devices8):
+    """The acceptance-criteria path for the sharded async engine."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    import jax
+
+    from deepspeed_tpu.checkpoint.sharded import AsyncShardedCheckpointEngine
+    from deepspeed_tpu.config import MeshConfig
+    from deepspeed_tpu.parallel import build_mesh
+
+    mesh = build_mesh(MeshConfig(data=8), devices=devices8)
+    state = {"w": jax.device_put(jnp.arange(64.0).reshape(8, 8),
+                                 NamedSharding(mesh, P("data", None)))}
+    eng = AsyncShardedCheckpointEngine(FAST_RETRY)
+    eng.save(state, str(tmp_path / "good"), meta={"global_steps": 1})
+    assert eng.commit("good")
+    assert atomic.read_latest(str(tmp_path)) == "good"
+
+    with FaultInjector() as fi:
+        fi.fail_async_write(match="shards-0")
+        eng.save(state, str(tmp_path / "bad"), meta={"global_steps": 2})
+        with pytest.raises(RuntimeError, match="async checkpoint write failed"):
+            eng.commit("bad")
+    assert atomic.read_latest(str(tmp_path)) == "good"
+    assert not (tmp_path / "bad").exists()
+
+
+def test_retried_commit_after_failed_save_still_fails(tmp_path, devices8):
+    """commit() must never go from raising to silently succeeding: after a
+    failed background write the failure is sticky, so retrying commit keeps
+    failing (and never advances 'latest') until a FRESH save clears it."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    import jax
+
+    from deepspeed_tpu.checkpoint.sharded import AsyncShardedCheckpointEngine
+    from deepspeed_tpu.config import MeshConfig
+    from deepspeed_tpu.parallel import build_mesh
+
+    mesh = build_mesh(MeshConfig(data=8), devices=devices8)
+    state = {"w": jax.device_put(jnp.arange(64.0).reshape(8, 8),
+                                 NamedSharding(mesh, P("data", None)))}
+    eng = AsyncShardedCheckpointEngine(FAST_RETRY)
+    eng.save(state, str(tmp_path / "good"), meta={"global_steps": 1})
+    assert eng.commit("good")
+
+    with FaultInjector() as fi:
+        fi.fail_async_write(match="shards-0")
+        eng.save(state, str(tmp_path / "bad"), meta={"global_steps": 2})
+        with pytest.raises(RuntimeError):
+            eng.commit("bad")
+    # injector gone, but the staged data never landed: a retried commit must
+    # fail again, not publish the incomplete stage
+    with pytest.raises(RuntimeError):
+        eng.commit("bad")
+    assert atomic.read_latest(str(tmp_path)) == "good"
+    assert not (tmp_path / "bad").exists()
+    # a fresh save clears the sticky failure and commits cleanly
+    eng.save(state, str(tmp_path / "ok"), meta={"global_steps": 3})
+    assert eng.commit("ok")
+    assert atomic.read_latest(str(tmp_path)) == "ok"
+
+
+def test_npz_async_retried_commit_still_fails(tmp_path):
+    eng = AsyncCheckpointEngine(FAST_RETRY)
+    with FaultInjector() as fi:
+        fi.fail_async_write(match="arrays.npz")
+        eng.save(_state(1), str(tmp_path / "t1"), meta={"global_steps": 1})
+        with pytest.raises(RuntimeError):
+            eng.commit("t1")
+    with pytest.raises(RuntimeError):
+        eng.commit("t1")  # still not durable — must not flip to True
+    assert atomic.read_latest(str(tmp_path)) is None
+    # a fresh save clears the sticky record (no stale re-raise) and commits
+    eng.save(_state(2), str(tmp_path / "t2"), meta={"global_steps": 2})
+    assert eng.commit("t2")
+    assert atomic.read_latest(str(tmp_path)) == "t2"
+
+
+def test_torn_sharded_stage_is_not_retried(tmp_path, devices8):
+    """The sharded publish path cannot cut a fresh stage dir, so a torn
+    stage (TornWriteError) must fail fast instead of burning the whole
+    backoff schedule on deterministic re-failures."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    import jax
+
+    from deepspeed_tpu.checkpoint.sharded import ShardedCheckpointEngine
+    from deepspeed_tpu.config import MeshConfig
+    from deepspeed_tpu.parallel import build_mesh
+
+    mesh = build_mesh(MeshConfig(data=8), devices=devices8)
+    state = {"w": jax.device_put(jnp.arange(64.0).reshape(8, 8),
+                                 NamedSharding(mesh, P("data", None)))}
+    eng = ShardedCheckpointEngine(RetryPolicy(max_attempts=3, base_delay=0.0,
+                                              jitter=0.0))
+    attempts = []
+    real_finalize = eng._finalize
+    eng._finalize = lambda *a, **k: (attempts.append(1),
+                                     real_finalize(*a, **k))
+    with FaultInjector() as fi:
+        # silent tear of a payload-checksummed staged file: its recorded
+        # write-time size no longer matches the disk — detected when the
+        # marker is sealed in _finalize
+        fi.truncate_write(match="pieces-0", then_fail=False)
+        with pytest.raises(atomic.TornWriteError):
+            eng.save(state, str(tmp_path / "t"), meta={"global_steps": 1})
+    assert attempts == [1]  # terminal, not retried
+    assert atomic.read_latest(str(tmp_path)) is None
+
+
+def test_retry_policy_excluding():
+    policy = RetryPolicy(max_attempts=3, retry_on=(OSError,))
+    no_torn = policy.excluding(atomic.TornWriteError)
+    assert policy.should_retry(atomic.TornWriteError("torn"), 1)
+    assert not no_torn.should_retry(atomic.TornWriteError("torn"), 1)
+    assert no_torn.should_retry(OSError("transient"), 1)
+
+
+def test_sharded_load_verifies_per_piece_crcs(tmp_path, devices8):
+    """The sharded pieces index carries per-piece CRCs checked after npz
+    decode — verified loads skip the whole-file CRC pass over the shard npzs,
+    so the decode check must catch what that pass no longer sees (simulated
+    by editing the index, which is outside its own checksum set)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    import jax
+
+    from deepspeed_tpu.checkpoint.sharded import ShardedCheckpointEngine
+    from deepspeed_tpu.config import MeshConfig
+    from deepspeed_tpu.parallel import build_mesh
+
+    mesh = build_mesh(MeshConfig(data=8), devices=devices8)
+    state = {"w": jax.device_put(jnp.arange(64.0).reshape(8, 8),
+                                 NamedSharding(mesh, P("data", None)))}
+    eng = ShardedCheckpointEngine(FAST_RETRY)
+    eng.save(state, str(tmp_path / "t"), meta={"global_steps": 1})
+    assert eng.commit("t")
+
+    pieces_path = tmp_path / "t" / "pieces-0.json"
+    pieces = json.loads(pieces_path.read_text())
+    rk = next(iter(pieces["w"]))
+    pieces["w"][rk] ^= 0xDEADBEEF
+    pieces_path.write_text(json.dumps(pieces))
+    # keep the file-level view clean: reseal the marker's entry for the
+    # edited index file (the marker itself is outside the checksum set)
+    marker_path = tmp_path / "t" / "COMMITTED"
+    marker = json.loads(marker_path.read_text())
+    data = pieces_path.read_bytes()
+    marker["files"]["pieces-0.json"] = {"size": len(data),
+                                        "crc32": atomic.crc32_bytes(data)}
+    marker_path.write_text(json.dumps(marker))
+
+    ok, reason = atomic.verify_checkpoint_dir(str(tmp_path / "t"),
+                                              skip_crc=("shards-0.npz",))
+    assert ok, reason  # the file-level view is clean...
+    with pytest.raises(CheckpointCorruptionError, match="CRC32 after decode"):
+        eng.load(str(tmp_path / "t"), template=state,
+                 shardings={"w": NamedSharding(mesh, P("data", None))})
+
+
+# ---------------------------------------------------------------------------
+# harness self-tests
+# ---------------------------------------------------------------------------
+def test_injector_counts_and_nth_semantics(tmp_path):
+    eng = NpzCheckpointEngine(RetryPolicy(max_attempts=1))
+    with FaultInjector() as fi:
+        fault = fi.fail_write(match="meta.json", nth=2)
+        eng.save(_state(1), str(tmp_path / "t1"), meta={})  # 1st meta.json: ok
+        with pytest.raises(InjectedFault):
+            eng.save(_state(2), str(tmp_path / "t2"), meta={})  # 2nd: fires
+        assert fault.seen == 2 and fault.fired == 1
+    # hooks removed on exit: saves work again
+    eng.save(_state(3), str(tmp_path / "t3"), meta={})
+    assert fi.total_fired == 1
+
+
+def test_truncate_file_is_deterministic(tmp_path):
+    p = tmp_path / "blob"
+    p.write_bytes(b"x" * 100)
+    assert truncate_file(str(p), keep_bytes=37) == 37
+    assert p.stat().st_size == 37
+    p.write_bytes(b"y" * 100)
+    truncate_file(str(p), drop_bytes=10)
+    assert p.stat().st_size == 90
+
+
+def test_retry_policy_backoff_and_filter():
+    policy = RetryPolicy(max_attempts=4, base_delay=1.0, multiplier=2.0,
+                         max_delay=3.0, jitter=0.0)
+    assert [policy.delay(i) for i in (1, 2, 3)] == [1.0, 2.0, 3.0]
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise OSError("transient")
+        return "ok"
+
+    fast = RetryPolicy(max_attempts=3, base_delay=0.0, jitter=0.0)
+    assert retry_call(flaky, policy=fast) == "ok"
+    assert len(calls) == 3
+
+    # non-retryable types propagate immediately
+    def boom():
+        calls.append(1)
+        raise ValueError("logic bug")
+
+    calls.clear()
+    with pytest.raises(ValueError):
+        retry_call(boom, policy=fast)
+    assert len(calls) == 1
+
+
+# ---------------------------------------------------------------------------
+# ElasticAgent recovery chain (real training engine)
+# ---------------------------------------------------------------------------
+def _engine(meshcfg):
+    model = get_model("gpt2", "tiny", vocab_size=128, max_seq_len=32,
+                      compute_dtype=jnp.float32)
+    eng, _, _, _ = deepspeed_tpu.initialize(model=model, config={
+        "train_batch_size": 8,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": 2}, "mesh": meshcfg,
+        "steps_per_print": 10 ** 9})
+    return eng
+
+
+def _data():
+    rng = np.random.RandomState(0)
+    while True:
+        yield {"input_ids": rng.randint(0, 128, (8, 16)).astype(np.int32)}
+
+
+def test_resume_chain_falls_back_past_corrupt_tag(tmp_path, devices8):
+    eng = _engine({"data": 8})
+    agent = ElasticAgent(eng, str(tmp_path), save_interval=2)
+    agent.run(_data(), total_steps=4)  # saves at steps 2 and 4
+    assert atomic.read_latest(str(tmp_path)) == "elastic-step4"
+
+    # newest checkpoint rots on disk after commit
+    truncate_file(str(tmp_path / "elastic-step4" / "shards-0.npz"),
+                  drop_bytes=32)
+
+    eng2 = _engine({"data": 8})
+    agent2 = ElasticAgent(eng2, str(tmp_path))
+    assert agent2.try_resume() == 2  # fell back to the older valid tag
+    assert (tmp_path / "elastic-step4.corrupt").exists()
+    assert not (tmp_path / "elastic-step4").exists()
+
+
+def test_resume_tolerates_latest_pointing_at_missing_tag(tmp_path, devices8):
+    eng = _engine({"data": 8})
+    agent = ElasticAgent(eng, str(tmp_path), save_interval=1000)
+    agent.run(_data(), total_steps=2)
+
+    # 'latest' advanced but the tag dir vanished (partial cleanup / fs loss)
+    import shutil
+    shutil.rmtree(tmp_path / "elastic-step2")
+
+    eng2 = _engine({"data": 8})
+    agent2 = ElasticAgent(eng2, str(tmp_path))
+    assert agent2.try_resume() == 0  # no valid checkpoint: clean cold start
+
+
+def test_load_checkpoint_falls_back_past_dangling_latest(tmp_path, devices8):
+    """Plain engine.load_checkpoint (no agent): quarantine/pruning routinely
+    leaves 'latest' naming a gone tag — the load must fall back to the
+    newest published tag, not crash on the dangling pointer."""
+    eng = _engine({"data": 8})
+    agent = ElasticAgent(eng, str(tmp_path), save_interval=2)
+    agent.run(_data(), total_steps=4)  # saves at steps 2 and 4
+    assert atomic.read_latest(str(tmp_path)) == "elastic-step4"
+    assert atomic.quarantine(str(tmp_path / "elastic-step4")) is not None
+
+    eng2 = _engine({"data": 8})
+    _, meta = eng2.load_checkpoint(str(tmp_path))
+    assert eng2.global_steps == 2
+
+
+def test_resume_demotes_tag_missing_marker(tmp_path, devices8):
+    """A marker-less dir could be a pre-protocol checkpoint: it loses resume
+    priority to every verified tag but is NOT quarantined (upgrading must
+    never destroy legacy checkpoints)."""
+    eng = _engine({"data": 8})
+    agent = ElasticAgent(eng, str(tmp_path), save_interval=2)
+    agent.run(_data(), total_steps=4)
+    os.remove(tmp_path / "elastic-step4" / "COMMITTED")
+
+    eng2 = _engine({"data": 8})
+    assert ElasticAgent(eng2, str(tmp_path)).try_resume() == 2
+    assert (tmp_path / "elastic-step4").exists()  # demoted, not quarantined
+
+
+def test_resume_loads_legacy_checkpoint_when_nothing_verified(tmp_path, devices8):
+    """With ONLY a pre-protocol (marker-less) checkpoint on disk, resume
+    still restores from it via the warn-and-load path."""
+    eng = _engine({"data": 8})
+    agent = ElasticAgent(eng, str(tmp_path), save_interval=1000)
+    agent.run(_data(), total_steps=2)
+    os.remove(tmp_path / "elastic-step2" / "COMMITTED")
+
+    eng2 = _engine({"data": 8})
+    assert ElasticAgent(eng2, str(tmp_path)).try_resume() == 2
+    assert (tmp_path / "elastic-step2").exists()
+
+
+def test_retention_prunes_old_tags_but_never_last_valid(tmp_path, devices8):
+    eng = _engine({"data": 8})
+    agent = ElasticAgent(eng, str(tmp_path), save_interval=1, keep_last=2)
+    agent.run(_data(), total_steps=5)
+    tags = atomic.list_tags(str(tmp_path))
+    assert tags == ["elastic-step5", "elastic-step4"]
+    # newest valid is never pruned even at keep_last=1
+    agent.keep_last = 1
+    agent._prune()
+    assert atomic.list_tags(str(tmp_path)) == ["elastic-step5"]
+    ok, _ = atomic.verify_checkpoint_dir(str(tmp_path / "elastic-step5"))
+    assert ok
+
+
+def test_retention_never_touches_foreign_tags(tmp_path):
+    """A shared save_dir may hold checkpoints some other writer created
+    (a manual 'best', another agent's prefix) — retention only prunes the
+    agent's own ``<tag_prefix>-*`` tags."""
+    _save(tmp_path, "best", step=0)
+    _save(tmp_path, "elastic-step1", step=1)
+    _save(tmp_path, "elastic-step2", step=2)
+    agent = ElasticAgent(None, str(tmp_path), keep_last=1)
+    agent._prune()
+    assert atomic.list_tags(str(tmp_path)) == ["elastic-step2", "best"]
+
+
+def test_sigterm_at_step_k_ends_with_loadable_checkpoint(tmp_path, devices8):
+    """The acceptance-criteria preemption path: SIGTERM at a chosen step,
+    agent checkpoints and stops, and a fresh engine resumes from it."""
+    eng = _engine({"data": 8})
+    agent = ElasticAgent(eng, str(tmp_path), save_interval=1000)
+    status, steps = agent.run(sigterm_data_iter(_data(), at_step=3),
+                              total_steps=100)
+    assert status == "preempted" and steps == 3
+    assert signal.getsignal(signal.SIGTERM) == signal.SIG_DFL
+
+    tag = atomic.read_latest(str(tmp_path))
+    ok, reason = atomic.verify_checkpoint_dir(str(tmp_path / tag))
+    assert ok, reason
+    eng2 = _engine({"data": 8})
+    assert ElasticAgent(eng2, str(tmp_path)).try_resume() == 3
+
+
+# ---------------------------------------------------------------------------
+# fsck CLI
+# ---------------------------------------------------------------------------
+def _run_fsck(*args):
+    tool = os.path.join(os.path.dirname(__file__), "..", "..", "tools",
+                        "fsck_checkpoint.py")
+    return subprocess.run([sys.executable, tool, *args],
+                          capture_output=True, text=True, timeout=120)
+
+
+def test_fsck_reports_and_repairs(tmp_path):
+    _save(tmp_path, "t1", step=1)
+    _save(tmp_path, "t2", step=2)
+    truncate_file(str(tmp_path / "t2" / "arrays.npz"), drop_bytes=8)
+    (tmp_path / "t3.tmp").mkdir()  # stale stage from a crashed save
+
+    r = _run_fsck(str(tmp_path), "--json")
+    assert r.returncode == 1, r.stdout + r.stderr
+    report = json.loads(r.stdout)
+    by_tag = {t["tag"]: t for t in report["tags"]}
+    assert by_tag["t1"]["ok"] and not by_tag["t2"]["ok"]
+    assert report["stale_stages"] == ["t3.tmp"]
+    assert report["latest"] == "t2" and not report["latest_ok"]
+
+    r = _run_fsck(str(tmp_path), "--repair")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert (tmp_path / "t2.corrupt").exists()
+    assert not (tmp_path / "t3.tmp").exists()
+    assert atomic.read_latest(str(tmp_path)) == "t1"
+
+    r = _run_fsck(str(tmp_path))
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+def test_fsck_repair_of_everything_is_a_failure(tmp_path):
+    """Quarantining every checkpoint is not a successful repair: no resume
+    target remains, so --repair must exit nonzero (ops gate on this)."""
+    _save(tmp_path, "t1", step=1)
+    truncate_file(str(tmp_path / "t1" / "arrays.npz"), drop_bytes=8)
+    r = _run_fsck(str(tmp_path), "--repair")
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert (tmp_path / "t1.corrupt").exists()
+    assert atomic.read_latest(str(tmp_path)) is None
+
+
+def test_fsck_never_quarantines_legacy_checkpoints(tmp_path):
+    """Marker-less pre-protocol tags are last-resort resume candidates, not
+    corruption — --repair must leave them (and may point latest at one)."""
+    _save(tmp_path, "old", step=1)
+    os.remove(str(tmp_path / "old" / "COMMITTED"))  # pre-protocol layout
+
+    r = _run_fsck(str(tmp_path), "--json")
+    assert r.returncode == 0, r.stdout + r.stderr  # unverifiable != damaged
+    report = json.loads(r.stdout)
+    assert report["tags"][0]["legacy"] and not report["tags"][0]["ok"]
+
+    r = _run_fsck(str(tmp_path), "--repair")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert (tmp_path / "old").exists()
+    assert not (tmp_path / "old.corrupt").exists()
+    assert atomic.read_latest(str(tmp_path)) == "old"
+
+
+def test_republish_same_tag_swaps_cleanly(tmp_path):
+    """Re-saving an existing tag name (e.g. a rolling 'best') must swap the
+    old dir out without a window where the tag is missing, and leave no
+    leftovers behind."""
+    for step in (1, 2, 3):
+        _save(tmp_path, "best", step=step)
+        marker = atomic.read_marker(str(tmp_path / "best"))
+        assert marker["step"] == step
+        ok, reason = atomic.verify_checkpoint_dir(str(tmp_path / "best"))
+        assert ok, reason
+    assert sorted(os.listdir(tmp_path)) == ["best", "latest"]
+
+
+def test_fsck_rescues_orphaned_committed_stage(tmp_path):
+    """A crash inside publish_tag's rename window leaves fully-COMMITTED
+    data under <tag>.tmp with no published tag — --repair must publish it,
+    never delete it."""
+    _save(tmp_path, "t1", step=1)
+    # model the crash: the committed tag demoted back to a stage name
+    os.rename(str(tmp_path / "t1"), str(tmp_path / "t2.tmp"))
+    (tmp_path / "junk.tmp").mkdir()  # a genuinely stale (empty) stage
+
+    r = _run_fsck(str(tmp_path), "--repair")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert (tmp_path / "t2").exists() and not (tmp_path / "t2.tmp").exists()
+    assert not (tmp_path / "junk.tmp").exists()
+    ok, reason = atomic.verify_checkpoint_dir(str(tmp_path / "t2"))
+    assert ok, reason
+    assert atomic.read_latest(str(tmp_path)) == "t2"
+
+
+def test_fsck_rescue_of_latest_named_stage_exits_clean(tmp_path):
+    """Crash inside publish_tag while RE-saving tag T: latest names T, T is
+    gone, T.tmp holds the committed stage. --repair must rescue T.tmp -> T
+    and report the untouched latest pointer as valid (exit 0), not keep the
+    scan-time BROKEN verdict."""
+    _save(tmp_path, "t1", step=1)
+    os.rename(str(tmp_path / "t1"), str(tmp_path / "t1.tmp"))
+    assert atomic.read_latest(str(tmp_path)) == "t1"
+
+    r = _run_fsck(str(tmp_path), "--repair", "--json")
+    assert r.returncode == 0, r.stdout + r.stderr
+    report = json.loads(r.stdout)
+    assert report["latest"] == "t1" and report["latest_ok"]
+    ok, reason = atomic.verify_checkpoint_dir(str(tmp_path / "t1"))
+    assert ok, reason
+
+
+def test_unreadable_marker_is_corruption_not_legacy(tmp_path):
+    """A COMMITTED file that exists but cannot be parsed is torn post-commit
+    state — it must fail verification (and be quarantined by the resume
+    walk), never masquerade as a trusted pre-protocol checkpoint."""
+    _save(tmp_path, "t1", step=1)
+    _save(tmp_path, "t2", step=2)
+    (tmp_path / "t2" / "COMMITTED").write_bytes(b"\x00 not json")
+
+    marker = atomic.read_marker(str(tmp_path / "t2"))
+    assert marker is not None and not marker  # the CORRUPT_MARKER sentinel
+    with pytest.raises(CheckpointCorruptionError):
+        NpzCheckpointEngine(FAST_RETRY).load(str(tmp_path / "t2"))
+
+    verified, legacy, skipped = ElasticAgent(None, str(tmp_path)) \
+        ._walk_candidates()
+    assert verified == ["t1"] and legacy == []
+    assert (tmp_path / "t2.corrupt").exists()
+
+
+def test_transient_io_error_never_quarantines(tmp_path, monkeypatch):
+    """An ESTALE/EIO while *checking* a checkpoint proves nothing about the
+    data: the walk must skip the tag for this restart and leave it on disk."""
+    _save(tmp_path, "t1", step=1)
+    real_getsize = os.path.getsize
+
+    def flaky(p):
+        if os.sep + "t1" + os.sep in p:
+            raise OSError("stale NFS handle")
+        return real_getsize(p)
+
+    monkeypatch.setattr(atomic.os.path, "getsize", flaky)
+    ok, reason = atomic.verify_checkpoint_dir(str(tmp_path / "t1"))
+    assert not ok and atomic.is_transient_verify_failure(reason)
+
+    verified, legacy, skipped = ElasticAgent(None, str(tmp_path)) \
+        ._walk_candidates()
+    assert verified == [] and legacy == []
+    assert skipped and atomic.is_transient_verify_failure(skipped[0][1])
+    monkeypatch.undo()
+    assert (tmp_path / "t1").exists()
+    assert not (tmp_path / "t1.corrupt").exists()
+    ok, reason = atomic.verify_checkpoint_dir(str(tmp_path / "t1"))
+    assert ok, reason  # next restart, healthy fs: fully recoverable

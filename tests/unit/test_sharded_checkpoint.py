@@ -91,6 +91,13 @@ def test_consolidate(tmp_path, devices8):
     out_dir = consolidate(str(tmp_path / "t"))
     arrays = np.load(os.path.join(out_dir, "arrays.npz"))
     np.testing.assert_array_equal(arrays["w"], np.asarray(state["w"]))
+    # the export is durable (committed, checksummed) but a side ARTIFACT:
+    # never a resume candidate, never counted/pruned by retention
+    from deepspeed_tpu.checkpoint import atomic
+    marker = atomic.read_marker(out_dir)
+    assert marker["kind"] == "artifact" and marker["arrays"]
+    assert atomic.list_tags(str(tmp_path)) == ["t"]
+    assert atomic.resume_candidates(str(tmp_path)) == ["t"]
 
 
 def test_incomplete_checkpoint_raises(tmp_path, devices8):
@@ -98,11 +105,12 @@ def test_incomplete_checkpoint_raises(tmp_path, devices8):
     state = _mk_state(mesh, P("data", None))
     eng = ShardedCheckpointEngine()
     eng.save(state, str(tmp_path / "t"))
-    # corrupt: claim a piece exists but drop it from the blob file
+    # corrupt: claim a piece exists but drop it from the blob file — caught
+    # either by COMMITTED-marker verification or by piece-coverage assembly
     pieces = json.load(open(tmp_path / "t" / "pieces-0.json"))
-    pieces["w"] = pieces["w"][:1]  # forget the rest of the leaf
+    pieces["w"] = dict(list(pieces["w"].items())[:1])  # forget the rest of the leaf
     json.dump(pieces, open(tmp_path / "t" / "pieces-0.json", "w"))
-    with pytest.raises(ValueError, match="do not cover"):
+    with pytest.raises(ValueError, match="do not cover|failed verification"):
         eng.load(str(tmp_path / "t"), template=state,
                  shardings={"w": NamedSharding(mesh, P()),
                             "scalar": NamedSharding(mesh, P())})

@@ -1,0 +1,204 @@
+#!/usr/bin/env python
+"""fsck for deepspeed_tpu checkpoint directories.
+
+Validates every tag under a checkpoint root against the atomic commit
+protocol (COMMITTED marker, per-file sizes + CRC32s, latest-pointer target)
+and prints a repair report. With ``--repair`` it quarantines corrupt tags to
+``<tag>.corrupt``, removes stale ``.tmp`` stages, and repoints ``latest`` at
+the newest valid tag.
+
+Usage:
+    python tools/fsck_checkpoint.py <checkpoint-dir> [--repair] [--json]
+                                    [--shallow]
+
+Exit status: 0 = every published tag valid and latest points at a valid tag
+(or repairs brought it to that state); 1 = problems remain.
+"""
+
+import argparse
+import json
+import os
+import shutil
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from deepspeed_tpu.checkpoint import atomic  # noqa: E402
+
+
+def scan(root, deep=True):
+    """Inventory a checkpoint root. Returns a report dict."""
+    report = {"root": root, "tags": [], "stale_stages": [],
+              "quarantined": [], "latest": None, "latest_ok": False}
+    if not os.path.isdir(root):
+        report["error"] = "not a directory"
+        return report
+    for name in sorted(os.listdir(root)):
+        full = os.path.join(root, name)
+        if not os.path.isdir(full):
+            continue
+        if name.endswith(atomic.TMP_SUFFIX):
+            report["stale_stages"].append(name)
+        elif atomic.CORRUPT_SUFFIX in name:
+            report["quarantined"].append(name)
+    for tag in atomic.list_tags(root, newest_first=True):
+        marker = atomic.read_marker(os.path.join(root, tag))
+        if marker is None:
+            # pre-protocol save: unverifiable, NOT proven corrupt — the
+            # resume chain keeps these as last-resort candidates, so fsck
+            # must not flag (or --repair must not eat) intact legacy data
+            report["tags"].append({
+                "tag": tag, "ok": False, "legacy": True,
+                "reason": "no COMMITTED marker (pre-protocol save)",
+                "step": None, "files": 0,
+            })
+            continue
+        ok, reason = atomic.verify_checkpoint_dir(
+            os.path.join(root, tag), deep=deep)
+        report["tags"].append({
+            "tag": tag, "ok": ok, "legacy": False, "reason": reason,
+            "step": marker.get("step"),
+            "files": len(marker.get("files", {})),
+        })
+    latest = atomic.read_latest(root)
+    report["latest"] = latest
+    by_tag = {t["tag"]: t for t in report["tags"]}
+    report["latest_ok"] = latest in by_tag and (
+        by_tag[latest]["ok"] or by_tag[latest]["legacy"])
+    return report
+
+
+def repair(root, report, deep=True):
+    """Quarantine bad tags, drop stale stages, repoint latest. Mutates and
+    returns ``report`` with an ``actions`` list."""
+    actions = []
+    for entry in report["tags"]:
+        if (not entry["ok"] and not entry["legacy"]
+                and not atomic.is_transient_verify_failure(entry["reason"])):
+            dest = atomic.quarantine(os.path.join(root, entry["tag"]))
+            if dest is None:  # removed/renamed under us (live agent pruning?)
+                actions.append(f"{entry['tag']} gone before quarantine — "
+                               f"skipped")
+                continue
+            actions.append(f"quarantined {entry['tag']} -> "
+                           f"{os.path.basename(dest)} ({entry['reason']})")
+    # A crash inside publish_tag's rename window can leave fully-COMMITTED
+    # data under <tag>.tmp (and the previous copy under <tag>.old.tmp) with
+    # no published tag: publish such orphans instead of deleting them.
+    # Plain <tag>.tmp sorts first so the newer copy wins the name; the
+    # superseded .old.tmp then has an existing target and is removed.
+    def _stage_target(name):
+        base = name[: -len(atomic.TMP_SUFFIX)]
+        return base[:-4] if base.endswith(".old") else base
+
+    for stage in sorted(report["stale_stages"],
+                        key=lambda n: _stage_target(n) + atomic.TMP_SUFFIX != n):
+        spath = os.path.join(root, stage)
+        target = _stage_target(stage)
+        ok, _reason = atomic.verify_checkpoint_dir(spath, deep=deep)
+        if ok and not os.path.isdir(os.path.join(root, target)):
+            os.replace(spath, os.path.join(root, target))
+            marker = atomic.read_marker(os.path.join(root, target))
+            report["tags"].append({
+                "tag": target, "ok": True, "legacy": False,
+                "reason": "rescued from orphaned committed stage",
+                "step": marker.get("step") if marker else None,
+                "files": len(marker.get("files", {})) if marker else 0,
+            })
+            actions.append(f"published orphaned committed stage {stage} -> "
+                           f"{target}")
+            continue
+        shutil.rmtree(spath, ignore_errors=True)
+        actions.append(f"removed stale stage {stage}")
+    # every stage was either rescued into a tag or removed — the scan-time
+    # stale list no longer describes the directory
+    report["stale_stages"] = []
+
+    def _by_step(entries):
+        return sorted(entries, key=lambda t: (
+            t["step"] if isinstance(t["step"], (int, float)) else -1,
+            t["tag"]), reverse=True)
+
+    # resume targets, best first: verified tags, then intact legacy ones
+    valid = ([t["tag"] for t in _by_step(report["tags"]) if t["ok"]]
+             or [t["tag"] for t in _by_step(report["tags"]) if t["legacy"]])
+    if valid and report["latest"] != valid[0]:
+        atomic.publish_latest(root, valid[0])
+        actions.append(f"repointed latest: {report['latest']!r} -> "
+                       f"{valid[0]!r}")
+        report["latest"] = valid[0]
+    elif not valid and report["latest"] is not None:
+        os.remove(os.path.join(root, "latest"))
+        actions.append("removed latest pointer (no valid checkpoint remains)")
+        report["latest"] = None
+    # recompute from the post-repair tag list: a rescued orphan stage may BE
+    # the tag latest already names, which the repoint branch never touches
+    by_tag = {t["tag"]: t for t in report["tags"]}
+    report["latest_ok"] = report["latest"] in by_tag and (
+        by_tag[report["latest"]]["ok"] or by_tag[report["latest"]]["legacy"])
+    report["actions"] = actions
+    return report
+
+
+def print_report(report):
+    print(f"checkpoint root: {report['root']}")
+    if "error" in report:
+        print(f"  ERROR: {report['error']}")
+        return
+    for entry in report["tags"]:
+        status = ("OK     " if entry["ok"]
+                  else "LEGACY " if entry["legacy"] else "CORRUPT")
+        step = f"step={entry['step']}" if entry["step"] is not None else "step=?"
+        print(f"  [{status}] {entry['tag']:<32} {step:<12} "
+              f"files={entry['files']}  {'' if entry['ok'] else entry['reason']}")
+    for stage in report["stale_stages"]:
+        print(f"  [STALE  ] {stage} (uncommitted save — crash leftover)")
+    for q in report["quarantined"]:
+        print(f"  [QUARANT] {q}")
+    latest = report["latest"]
+    if latest is None:
+        print("  latest: <none>")
+    else:
+        state = "valid" if report["latest_ok"] else "BROKEN — does not name a valid tag"
+        print(f"  latest: {latest} ({state})")
+    for action in report.get("actions", []):
+        print(f"  repair: {action}")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("root", help="checkpoint directory (parent of tag dirs)")
+    ap.add_argument("--repair", action="store_true",
+                    help="quarantine corrupt tags, drop stale stages, "
+                         "repoint latest")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit the report as JSON")
+    ap.add_argument("--shallow", action="store_true",
+                    help="skip CRC recomputation (marker + file sizes only)")
+    args = ap.parse_args(argv)
+
+    report = scan(args.root, deep=not args.shallow)
+    if args.repair and "error" not in report:
+        report = repair(args.root, report, deep=not args.shallow)
+    if args.as_json:
+        print(json.dumps(report, indent=1))
+    else:
+        print_report(report)
+
+    if "error" in report:
+        return 1
+    # success means a valid resume state: without --repair, every
+    # marker-bearing tag must verify (legacy tags are unverifiable, not
+    # wrong); with it, quarantining is fine but at least one resume target
+    # must survive — repairing every checkpoint away is still a failure
+    if args.repair:
+        all_ok = (any(t["ok"] or t["legacy"] for t in report["tags"])
+                  or not report["tags"])
+    else:
+        all_ok = all(t["ok"] for t in report["tags"] if not t["legacy"])
+    latest_fine = report["latest_ok"] or report["latest"] is None
+    return 0 if (all_ok and latest_fine) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
