@@ -166,6 +166,9 @@ def run_benchmark():
     import deepspeed_tpu
     from deepspeed_tpu.models import CausalLM, TransformerConfig
 
+    if os.environ.get("BENCH_CPU_PROXY") == "1":
+        return run_cpu_proxy()
+
     n_chips = len(jax.devices())
 
     # GPT-2 medium-class decoder (~350M params), bf16 compute.
@@ -349,6 +352,75 @@ def run_benchmark():
     return 0
 
 
+def run_cpu_proxy():
+    """CPU-mesh proxy measurement for when the TPU tunnel is down.
+
+    A scaled-down model (the headline GPT-2 350M shape is hours-per-step on
+    one CPU core) through the REAL fused train_batch path. The number is a
+    trajectory signal — "the training path still works and runs at N tok/s
+    on the host" — NOT comparable to TPU rows: the record carries
+    ``"backend": "cpu_proxy"`` and vs_baseline stays 0.0. Replaces the old
+    behavior of emitting value 0.0 + an error string, which made the bench
+    trajectory read as empty for every tunnel-wedged round.
+    """
+    import jax
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models import CausalLM, TransformerConfig
+    import jax.numpy as jnp
+
+    cfg = TransformerConfig(
+        vocab_size=50304, max_seq_len=256, n_layers=4, n_heads=4,
+        d_model=256, d_ff=1024, compute_dtype=jnp.bfloat16,
+        remat=False, scan_layers=True, fused_ce=True, attention_impl="xla")
+    model = CausalLM(cfg)
+    batch_size = _env_int("BENCH_PROXY_BATCH", 2)
+    seq_len = 256
+    config = {
+        "train_batch_size": batch_size,
+        "optimizer": {"type": "adamw",
+                      "params": {"lr": 1e-4, "weight_decay": 0.01}},
+        "bf16": {"enabled": True},
+        "zero_optimization": {"stage": 0},
+        "gradient_clipping": 1.0,
+        "steps_per_print": 1000000,
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=config)
+    rng = np.random.RandomState(0)
+    batch = {"input_ids": rng.randint(
+        0, cfg.vocab_size, (batch_size, seq_len)).astype(np.int32)}
+    for _ in range(2):
+        loss = engine.train_batch(batch=batch)
+    jax.block_until_ready(jax.tree_util.tree_leaves(engine.params)[0])
+    n_steps = _env_int("BENCH_PROXY_STEPS", 3)
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        loss = engine.train_batch(batch=batch)
+    jax.block_until_ready(jax.tree_util.tree_leaves(engine.params)[0])
+    dt = time.perf_counter() - t0
+    tokens_per_sec = batch_size * seq_len * n_steps / dt
+    result = {
+        "metric": METRIC,
+        "value": round(tokens_per_sec, 1),
+        "unit": UNIT,
+        "vs_baseline": 0.0,  # a host-CPU proxy can never claim MFU progress
+        "backend": "cpu_proxy",
+        "extra": {
+            "note": "TPU tunnel unavailable; CPU-mesh proxy on a scaled-down "
+                    "model (n_layers=4, d_model=256, seq=256) through the "
+                    "real fused train_batch path",
+            "n_params_m": round(engine.num_parameters / 1e6, 1),
+            "batch": batch_size,
+            "seq": seq_len,
+            "steps": n_steps,
+            "final_loss": round(float(loss), 4),
+            "platform": jax.devices()[0].platform,
+        },
+    }
+    print(json.dumps(result))
+    return 0
+
+
 def main():
     if "--probe" in sys.argv:
         return probe()
@@ -363,12 +435,32 @@ def main():
     rc, out, err = _run_subprocess(
         [sys.executable, os.path.abspath(__file__), "--probe"], PROBE_TIMEOUT_S
     )
-    if rc is None:
+    if rc is None or rc != 0:
+        # Tunnel down/wedged. A 0.0-with-error record made every wedged
+        # round read as an empty bench trajectory; instead fall back to a
+        # CPU-mesh proxy measurement, recorded as backend="cpu_proxy"
+        # (vs_baseline stays 0.0 — a host number never claims MFU progress).
+        reason = (f"TPU backend probe timed out after {PROBE_TIMEOUT_S}s "
+                  f"(tunnel wedged?)" if rc is None else
+                  f"TPU backend probe failed (rc={rc}): {err.strip()[-500:]}")
+        print(f"# {reason}; falling back to CPU-mesh proxy", file=sys.stderr)
+        prc, pout, perr = _run_subprocess(
+            [sys.executable, os.path.abspath(__file__), "--child"],
+            _env_int("BENCH_PROXY_TIMEOUT", 900),
+            env={**os.environ, "BENCH_FORCE_CPU": "1", "BENCH_CPU_PROXY": "1"})
+        for line in reversed((pout or "").strip().splitlines()):
+            try:
+                cand = json.loads(line)
+            except (json.JSONDecodeError, ValueError):
+                continue
+            if isinstance(cand, dict) and "metric" in cand:
+                cand.setdefault("extra", {})["tpu_probe_error"] = reason
+                print(json.dumps(cand))
+                return 0
+        # proxy also failed: keep the old explicit error record
         print(json.dumps(_error_record(
-            f"TPU backend probe timed out after {PROBE_TIMEOUT_S}s (tunnel wedged?)")))
-        return 0
-    if rc != 0:
-        print(json.dumps(_error_record(f"TPU backend probe failed (rc={rc}): {err.strip()}")))
+            f"{reason}; cpu proxy also failed (rc={prc}): "
+            f"{(perr or '').strip()[-500:]}")))
         return 0
 
     # Claim-handoff settle: the axon tunnel serves one claim, and a new TPU

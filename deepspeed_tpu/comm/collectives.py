@@ -1,0 +1,196 @@
+"""Quantized / compute-dtype collectives: the wire-bytes layer.
+
+One home for every "change the payload dtype before it crosses the wire"
+primitive, consumed by three clients:
+
+- the ZeRO-3 ``per_layer`` weight gathers (``models/transformer.py``): bf16
+  cast-then-gather and ZeRO++-style (arXiv:2306.10209 qwZ) int8 blockwise
+  quantized gathers — fp32 masters stay sharded, only the 16/8-bit payload
+  moves;
+- the 1-bit Adam compressed allreduce (``comm/compressed.py``), whose
+  quantization kernels were promoted here.
+
+(The engine's ``grad_reduce_dtype`` bf16 reduction lives in auto-sharding
+land — a cast BEFORE the ZeRO-2 sharding constraint in
+``runtime/engine.py``, verified on the wire by the collective audit — so it
+does not call ``reduce_scatter_cast``; that primitive is the manual
+(shard_map) counterpart for callers composing their own collectives.)
+
+All ``*_local`` functions run INSIDE a ``shard_map`` body (they call
+``jax.lax`` collectives with an axis name). The quantizers are plain jittable
+functions. EQuARX (arXiv:2506.17615) is the design reference: quantize before
+the wire, as part of the collective, not after.
+
+Precision notes:
+- bf16 gather: weights are rounded once to the compute dtype before the
+  gather — bitwise identical to the "gather fp32 then cast" program whenever
+  the consumer casts to the same dtype (pinned by test_zero3_gather_impl).
+- int8 gather: symmetric per-block scales (``block`` elements per fp32
+  scale); wire cost ~ ``1 + 4/block`` bytes/param. The backward is a
+  straight-through estimator: cotangents reduce-scatter at their own
+  (compute) dtype, so gradients never see the quantization rounding.
+- error feedback (``quantize(..., error=...)``) keeps the residual local so
+  the EXPECTED payload is unbiased across steps — required for compressed
+  gradient reductions (1-bit Adam's convergence proof), unnecessary for
+  weight gathers (masters are exact; the rounding is a forward perturbation,
+  not an accumulating one).
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+# ---------------------------------------------------------------------------
+# quantizers (promoted from comm/compressed.py)
+# ---------------------------------------------------------------------------
+
+def quantize(x, bits, error=None):
+    """Row-wise symmetric quantization over the last axis.
+
+    ``x [..., n] -> (payload int8, scale f32 [..., 1])``. 1-bit: sign *
+    mean(|x|); 8-bit: symmetric linear to int8. With ``error`` (same shape as
+    ``x``), quantizes ``x + error`` and ALSO returns the new residual:
+    ``(q, scale, new_error)``.
+    """
+    if error is not None:
+        x = x + error
+    if bits == 1:
+        scale = jnp.mean(jnp.abs(x), axis=-1, keepdims=True)
+        q = jnp.where(x >= 0, jnp.int8(1), jnp.int8(-1))
+    else:
+        scale = jnp.max(jnp.abs(x), axis=-1, keepdims=True) / 127.0
+        safe = jnp.maximum(scale, 1e-30)
+        q = jnp.clip(jnp.round(x / safe), -127, 127).astype(jnp.int8)
+    if error is not None:
+        return q, scale, x - dequantize(q, scale, bits)
+    return q, scale
+
+
+def dequantize(q, scale, bits):
+    del bits  # same affine map for 1- and 8-bit payloads
+    return q.astype(jnp.float32) * scale
+
+
+def _effective_block(n, block):
+    """Largest usable block: ``block`` when it divides n, else the whole row
+    (one scale) — keeps every leaf shape legal without padding."""
+    return block if (block > 0 and n % block == 0) else n
+
+
+def quantize_blockwise(x, block=256):
+    """ZeRO++-style symmetric int8 with per-block fp32 scales (last axis).
+
+    ``x [..., n] -> (q int8 [..., n], scale f32 [..., n // b])`` where ``b``
+    is ``block`` (or ``n`` when ``block`` does not divide ``n``).
+    """
+    n = x.shape[-1]
+    b = _effective_block(n, block)
+    g = x.reshape(*x.shape[:-1], n // b, b).astype(jnp.float32)
+    scale = jnp.max(jnp.abs(g), axis=-1, keepdims=True) / 127.0
+    safe = jnp.maximum(scale, 1e-30)
+    q = jnp.clip(jnp.round(g / safe), -127, 127).astype(jnp.int8)
+    return q.reshape(x.shape), scale[..., 0]
+
+
+def dequantize_blockwise(q, scale, dtype=jnp.float32):
+    """Inverse of ``quantize_blockwise`` (block size inferred from shapes)."""
+    n = q.shape[-1]
+    blocks = scale.shape[-1]
+    g = q.reshape(*q.shape[:-1], blocks, n // blocks).astype(jnp.float32)
+    return (g * scale[..., None]).reshape(q.shape).astype(dtype)
+
+
+# ---------------------------------------------------------------------------
+# collective primitives (call inside shard_map)
+# ---------------------------------------------------------------------------
+
+def all_gather_cast(x, axis_name, axis=0, wire_dtype=None, out_dtype=None):
+    """All-gather with the payload cast to ``wire_dtype`` BEFORE the wire.
+
+    The cast-then-gather order is the whole point: expressed as an explicit
+    ``jax.lax.all_gather`` of the already-cast operand, it cannot be undone
+    by sharding propagation (a ``with_sharding_constraint`` chain can — the
+    partitioner reshards the convert's input; PERF.md "known 2x").
+    """
+    if wire_dtype is not None:
+        x = x.astype(wire_dtype)
+    out = jax.lax.all_gather(x, axis_name, axis=axis, tiled=True)
+    if out_dtype is not None:
+        out = out.astype(out_dtype)
+    return out
+
+
+def all_gather_quantized(x, axis_name, axis=0, block=256, out_dtype=None):
+    """int8 blockwise-quantized all-gather (ZeRO++ qwZ shape).
+
+    Quantizes the LOCAL shard, gathers the int8 payload and the fp32 scales
+    (two collectives, ~``1 + 4/block`` bytes/param on the wire), dequantizes
+    everywhere. Differentiable via straight-through: the backward is a plain
+    ``psum_scatter`` of the cotangent at its own dtype — gradients never see
+    the rounding.
+    """
+    out_dtype = out_dtype or x.dtype
+
+    @jax.custom_vjp
+    def _qgather(v):
+        return _fwd(v)[0]
+
+    def _fwd(v):
+        q, scale = quantize_blockwise(v, block=block)
+        qg = jax.lax.all_gather(q, axis_name, axis=axis, tiled=True)
+        sg = jax.lax.all_gather(scale, axis_name,
+                                axis=min(axis, scale.ndim - 1), tiled=True)
+        return dequantize_blockwise(qg, sg, dtype=out_dtype), None
+
+    def _bwd(_, g):
+        return (jax.lax.psum_scatter(
+            g, axis_name, scatter_dimension=axis, tiled=True).astype(x.dtype),)
+
+    _qgather.defvjp(_fwd, _bwd)
+    return _qgather(x)
+
+
+def reduce_scatter_cast(x, axis_name, axis=0, wire_dtype=None, out_dtype=None):
+    """Reduce-scatter with the payload cast to ``wire_dtype`` first (the
+    reduction itself then runs at the wire dtype — document the precision)."""
+    if wire_dtype is not None:
+        x = x.astype(wire_dtype)
+    out = jax.lax.psum_scatter(x, axis_name, scatter_dimension=axis,
+                               tiled=True)
+    if out_dtype is not None:
+        out = out.astype(out_dtype)
+    return out
+
+
+def reduce_scatter_quantized(x, axis_name, error, bits=8):
+    """Compressed mean reduce-scatter with worker-side error feedback.
+
+    Phase 1 of the 1-bit Adam exchange: split the local tensor into world
+    chunks, quantize ``chunk_i + error``, ``all_to_all`` payloads + scales,
+    dequantize and mean-reduce own chunk. ``x [n]`` (n divisible by world) ->
+    ``(mean_chunk [n/world], new_error [n])``.
+    """
+    world = jax.lax.axis_size(axis_name)
+    chunks = x.reshape(world, x.shape[-1] // world)
+    q, scale, new_error = quantize(chunks, bits,
+                                   error=error.reshape(chunks.shape))
+    q_recv = jax.lax.all_to_all(q, axis_name, split_axis=0, concat_axis=0,
+                                tiled=False)
+    s_recv = jax.lax.all_to_all(scale, axis_name, split_axis=0, concat_axis=0,
+                                tiled=False)
+    mine = jnp.sum(dequantize(q_recv, s_recv, bits), axis=0) / world
+    return mine, new_error.reshape(-1)
+
+
+def all_gather_quantized_ef(x, axis_name, error, bits=8):
+    """Compressed all-gather with (server-side) error feedback.
+
+    Phase 2 of the 1-bit Adam exchange: quantize ``x + error``, gather the
+    payload + scale, dequantize everywhere. ``x [m]`` -> ``(gathered
+    [world * m], new_error [m])``.
+    """
+    q, scale, new_error = quantize(x[None, :], bits, error=error[None, :])
+    q_all = jax.lax.all_gather(q[0], axis_name)
+    s_all = jax.lax.all_gather(scale[0], axis_name)
+    out = dequantize(q_all, s_all, bits).reshape(-1)
+    return out, new_error[0]

@@ -20,29 +20,16 @@ update is unbiased — the property 1-bit Adam's convergence proof needs.
 Wire cost per device: 2 x N/world quantized payloads (1 or 8 bits) instead of
 2 x N x 32 bits for a ring allreduce — the same 16-32x compression the
 reference claims for its NCCL backend.
-"""
 
-import functools
+The quantize/dequantize kernels and the two collective phases live in
+``comm/collectives.py`` (shared with the ZeRO-3 quantized weight gathers);
+this module keeps the 1-bit Adam composition and its entry points.
+"""
 
 import jax
 import jax.numpy as jnp
 
-
-def _quantize(x, bits):
-    """x [..., n] -> (payload int8, scale f32). 1-bit: sign * mean(|x|);
-    8-bit: symmetric linear to int8."""
-    if bits == 1:
-        scale = jnp.mean(jnp.abs(x), axis=-1, keepdims=True)
-        q = jnp.where(x >= 0, jnp.int8(1), jnp.int8(-1))
-        return q, scale
-    scale = jnp.max(jnp.abs(x), axis=-1, keepdims=True) / 127.0
-    safe = jnp.maximum(scale, 1e-30)
-    q = jnp.clip(jnp.round(x / safe), -127, 127).astype(jnp.int8)
-    return q, scale
-
-
-def _dequantize(q, scale, bits):
-    return q.astype(jnp.float32) * scale
+from .collectives import all_gather_quantized_ef, reduce_scatter_quantized
 
 
 def compressed_allreduce_local(x, worker_error, server_error, axis_name,
@@ -57,27 +44,14 @@ def compressed_allreduce_local(x, worker_error, server_error, axis_name,
     if n % world:
         raise ValueError(f"compressed allreduce length {n} not divisible by "
                          f"world {world}")
-    chunk = n // world
 
     # ---- phase 1: compressed reduce-scatter via all_to_all
-    compensated = x + worker_error
-    chunks = compensated.reshape(world, chunk)
-    q, scale = _quantize(chunks, bits)  # [world, chunk], [world, 1]
-    new_worker_error = (compensated
-                        - _dequantize(q, scale, bits).reshape(-1))
-    q_recv = jax.lax.all_to_all(q, axis_name, split_axis=0, concat_axis=0,
-                                tiled=False)
-    s_recv = jax.lax.all_to_all(scale, axis_name, split_axis=0, concat_axis=0,
-                                tiled=False)
-    mine = jnp.sum(_dequantize(q_recv, s_recv, bits), axis=0) / world  # [chunk]
+    mine, new_worker_error = reduce_scatter_quantized(
+        x, axis_name, worker_error, bits=bits)
 
     # ---- phase 2: compressed all-gather of the reduced chunk
-    compensated2 = mine + server_error
-    q2, scale2 = _quantize(compensated2[None, :], bits)
-    new_server_error = compensated2 - _dequantize(q2, scale2, bits)[0]
-    q_all = jax.lax.all_gather(q2[0], axis_name)          # [world, chunk]
-    s_all = jax.lax.all_gather(scale2[0], axis_name)      # [world, 1]
-    out = _dequantize(q_all, s_all, bits).reshape(-1)
+    out, new_server_error = all_gather_quantized_ef(
+        mine, axis_name, server_error, bits=bits)
     return out, new_worker_error, new_server_error
 
 

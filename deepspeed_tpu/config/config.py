@@ -94,6 +94,27 @@ class ZeroConfig(ConfigModel):
     # an explicit bf16 all_gather island after the compute-dtype cast, half
     # the bytes on the wire.
     zero3_gather_impl: str = "constraint"
+    # Wire dtype of the per-layer weight gathers. "auto" keeps the impl's
+    # historical behavior (fp32 masters under "constraint", the compute dtype
+    # under "shard_map"); "fp32" gathers masters; "bf16" casts to the 16-bit
+    # compute dtype before the wire (half the gather bytes); "int8" is the
+    # ZeRO++-style (qwZ) blockwise-quantized gather (~quarter the bytes,
+    # per-block fp32 scales). bf16/int8 require stage 3 +
+    # zero3_gather_mode="per_layer" and imply the shard_map impl (a
+    # constraint chain cannot pin the wire dtype — PERF.md "known 2x").
+    # Masters stay sharded fp32 in every mode; only the wire payload changes.
+    zero3_gather_dtype: str = "auto"
+    # int8 gather quantization granularity: elements per fp32 scale block
+    # (wire overhead ~ 4/block bytes/param; leaves whose last dim the block
+    # does not divide fall back to one scale per row)
+    zero3_gather_block: int = 256
+    # Wire dtype of the gradient reduction (reduce-scatter at stage >= 2,
+    # all-reduce below): "bf16" casts each micro-batch's grads before the
+    # sharding constraint, halving reduce wire bytes; accumulation across
+    # micro-batches then also runs in bf16 (the reference's
+    # communication_data_type / grad_accum_dtype semantics). The optimizer
+    # step always runs fp32 on the sharded masters.
+    grad_reduce_dtype: str = "fp32"
     contiguous_gradients: bool = True
     reduce_scatter: bool = True
     reduce_bucket_size: int = 500_000_000
@@ -126,6 +147,37 @@ class ZeroConfig(ConfigModel):
     def _validate(self):
         if self.stage not in (0, 1, 2, 3):
             raise ConfigError(f"zero_optimization.stage must be in 0..3, got {self.stage}")
+        if self.zero3_gather_mode not in ("compiler", "per_layer"):
+            raise ConfigError(
+                f"zero_optimization.zero3_gather_mode must be 'compiler' or "
+                f"'per_layer', got {self.zero3_gather_mode!r}")
+        if self.zero3_gather_dtype not in ("auto", "fp32", "bf16", "int8"):
+            raise ConfigError(
+                f"zero_optimization.zero3_gather_dtype must be one of "
+                f"auto|fp32|bf16|int8, got {self.zero3_gather_dtype!r}")
+        if self.zero3_gather_dtype in ("bf16", "int8"):
+            if self.stage != 3:
+                raise ConfigError(
+                    f"zero_optimization.zero3_gather_dtype="
+                    f"{self.zero3_gather_dtype!r} requires stage 3 (got stage "
+                    f"{self.stage}); below stage 3 params are not partitioned "
+                    f"and there is no weight gather to compress")
+            if self.zero3_gather_mode != "per_layer":
+                raise ConfigError(
+                    f"zero_optimization.zero3_gather_dtype="
+                    f"{self.zero3_gather_dtype!r} requires "
+                    f"zero3_gather_mode='per_layer' (got "
+                    f"{self.zero3_gather_mode!r}): under 'compiler' the "
+                    f"partitioner owns the gathers and reshards the fp32 "
+                    f"masters — the wire dtype cannot be pinned")
+        if self.zero3_gather_block < 1:
+            raise ConfigError(
+                f"zero_optimization.zero3_gather_block must be >= 1, got "
+                f"{self.zero3_gather_block}")
+        if self.grad_reduce_dtype not in ("fp32", "bf16"):
+            raise ConfigError(
+                f"zero_optimization.grad_reduce_dtype must be 'fp32' or "
+                f"'bf16', got {self.grad_reduce_dtype!r}")
 
     @classmethod
     def from_dict(cls, d):

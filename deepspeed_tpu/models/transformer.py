@@ -119,6 +119,13 @@ class TransformerConfig:
     # shard_map additionally needs the SHARDED per-block specs below
     zero3_gather_impl: str = "constraint"
     zero3_sharded_specs: typing.Any = None
+    # Wire dtype of the shard_map gathers (set by the engine from
+    # zero_optimization.zero3_gather_dtype): "compute" (historical — gather
+    # at the compute dtype), "fp32" (gather masters, cast after), "bf16" /
+    # "fp16" (explicit 16-bit wire), "int8" (ZeRO++ qwZ blockwise-quantized
+    # payload + per-block fp32 scales). Masters stay sharded fp32 throughout.
+    zero3_gather_dtype: str = "compute"
+    zero3_gather_block: int = 256
     # Same discipline for the top-level params (wte / lm_head / ln_f / wpe):
     # {param_name: spec tree} with the data axis stripped. Without this, a
     # ZeRO-3 embedding sharded on its d_model axis (vocab % dp != 0 fallback)
@@ -295,11 +302,29 @@ def _shard_map_gather(cfg, p):
     Input leaves carry their ZeRO-3 sharded layout (``zero3_sharded_specs``);
     the output is the gathered layout (``zero3_gather_specs``). Each leaf with
     a data-sharded dim becomes a shard_map island whose body is ONE tiled
-    ``jax.lax.all_gather`` — the collective's dtype is whatever the leaf
-    holds at this point (the compute dtype, post-cast), which a sharding
-    constraint cannot guarantee. Leaves without a data shard pass through.
+    ``jax.lax.all_gather`` — something a sharding constraint cannot pin (the
+    partitioner reshards an elementwise op's input to match its constrained
+    output, so cast/quantize-then-gather is inexpressible there). Leaves
+    without a data shard pass through.
+
+    Wire dtype per ``cfg.zero3_gather_dtype`` (matmul-weight leaves, ndim>=2):
+    - ``"compute"`` / 16-bit names: the leaf is gathered at whatever dtype it
+      holds (the compute dtype after ``_cast_block_params``; the explicit
+      cast-before-wire corner only triggers when the leaf dtype differs,
+      e.g. a bf16 wire under fp32 compute);
+    - ``"int8"``: ZeRO++-style blockwise-quantized gather
+      (``comm/collectives.all_gather_quantized``, per-block fp32 scales,
+      straight-through backward);
+    - ``"fp32"``: plain gather of the (fp32 master) leaf.
+    1-D leaves (biases, norm scales) always gather at their own dtype — they
+    are persistence-threshold-sized and norm math wants them exact.
     """
+    from ..comm.collectives import all_gather_cast, all_gather_quantized
     from ..parallel.topology import DATA_AXIS
+
+    wire = getattr(cfg, "zero3_gather_dtype", "compute") or "compute"
+    wire_dtype = {"compute": cfg.compute_dtype, "bf16": jnp.bfloat16,
+                  "fp16": jnp.float16, "fp32": None, "int8": None}[wire]
 
     def has_data(s):
         return s == DATA_AXIS or (isinstance(s, tuple) and DATA_AXIS in s)
@@ -309,9 +334,19 @@ def _shard_map_gather(cfg, p):
         if not axes:
             return a
         k = axes[0]
+        compressible = a.ndim >= 2 and jnp.issubdtype(a.dtype, jnp.floating)
+        if wire == "int8" and compressible:
+            body = lambda x: all_gather_quantized(
+                x, DATA_AXIS, axis=k, block=cfg.zero3_gather_block,
+                out_dtype=a.dtype)
+        elif compressible and wire_dtype is not None and a.dtype != wire_dtype:
+            body = lambda x: all_gather_cast(
+                x, DATA_AXIS, axis=k, wire_dtype=wire_dtype, out_dtype=a.dtype)
+        else:
+            body = lambda x: jax.lax.all_gather(x, DATA_AXIS, axis=k,
+                                                tiled=True)
         f = jax.shard_map(
-            lambda x: jax.lax.all_gather(x, DATA_AXIS, axis=k, tiled=True),
-            mesh=cfg.mesh, in_specs=sharded, out_specs=gathered,
+            body, mesh=cfg.mesh, in_specs=sharded, out_specs=gathered,
             # the varying-mesh-axes inference can't prove an all_gather
             # output replicated; it is (by construction of the collective)
             check_vma=False)
@@ -637,14 +672,19 @@ def stack_apply(cfg, stacked_params, x, mask=None, rope=None, alibi=None,
         if cfg.zero3_per_layer_gather and cfg.zero3_gather_specs is not None:
             if (cfg.zero3_gather_impl == "shard_map"
                     and cfg.zero3_sharded_specs is not None):
-                # explicit bf16 all_gather island: the collective is pinned
-                # AFTER the compute-dtype cast, half the wire of gathering
-                # the fp32 master (which is all the constraint impl below
-                # can express — the partitioner reshards an elementwise op's
+                # explicit all_gather island with the wire dtype pinned
+                # BEFORE the collective (compute-dtype cast or int8
+                # quantization) — half/quarter the wire of gathering the
+                # fp32 master (which is all the constraint impl below can
+                # express — the partitioner reshards an elementwise op's
                 # input to match its constrained output, and both
                 # jax.sharding.reshard and an optimization_barrier broke
                 # Shardy propagation for the surrounding scan)
-                p = _shard_map_gather(cfg, _cast_block_params(cfg, p))
+                if cfg.zero3_gather_dtype == "fp32":
+                    # explicit-but-fp32 wire: gather the masters, cast after
+                    p = _cast_block_params(cfg, _shard_map_gather(cfg, p))
+                else:
+                    p = _shard_map_gather(cfg, _cast_block_params(cfg, p))
             else:
                 # "constraint": fp32-sized gather wire, a known 2x
                 # (PARITY.md known gaps); overlap headroom absorbs it

@@ -1,5 +1,9 @@
+from .collectives import (audit_lowered, check_budgets,
+                          compile_with_partitioned_hlo,
+                          parse_collectives_by_dtype)
 from .flops_profiler import (FlopsProfiler, get_model_profile,
                              get_module_profile, transformer_train_flops)
 
 __all__ = ["FlopsProfiler", "get_model_profile", "get_module_profile",
-           "transformer_train_flops"]
+           "transformer_train_flops", "parse_collectives_by_dtype",
+           "compile_with_partitioned_hlo", "audit_lowered", "check_budgets"]

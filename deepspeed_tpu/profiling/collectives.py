@@ -1,0 +1,268 @@
+"""HLO collective-bytes accounting (by kind AND payload dtype).
+
+The compiler is the source of truth for wire traffic, the same way it is for
+flops (``flops_profiler.py``): every collective in the compiled step is
+parsed out of the HLO with its payload dtype, and ring-algorithm wire costs
+are attributed per chip per step. Used by:
+
+- ``tools/collective_audit.py`` — the CI gate that keeps fp32 master
+  gathers from silently reappearing on the ZeRO-3 hot path;
+- ``FlopsProfiler`` (``collectives=True``) — live wire-bytes alongside
+  flops;
+- ``DeepSpeedEngine.collective_wire_stats`` — monitor events for training
+  runs (``comms_logger.enabled``).
+
+Why the post-partitioning snapshot: the CPU backend's float-normalization
+pass legalizes bf16 collectives to f32 + converts (CPU has no native bf16),
+so the backend-optimized HLO shows fp32 gathers regardless of what the
+program pinned. The snapshot taken right after the SPMD partitioner — via
+XLA's pass-dump machinery, per-compile — is the platform-independent SPMD
+program a TPU receives, with the partitioner's committed wire dtypes.
+(int8 payloads survive even the CPU pipeline: integer collectives are not
+float-normalized — a useful cross-check.)
+"""
+
+import glob
+import os
+import re
+import shutil
+import tempfile
+
+DTYPE_BYTES = {"f64": 8, "f32": 4, "bf16": 2, "f16": 2, "s32": 4, "u32": 4,
+               "s64": 8, "u64": 8, "s8": 1, "u8": 1, "pred": 1, "s16": 2,
+               "u16": 2, "f8e4m3fn": 1, "f8e5m2": 1, "s4": 0.5, "u4": 0.5}
+
+_RESULT_RE = re.compile(r"=\s+(?:\()?(\w+)\[([\d,]*)\]")
+_TUPLE_SHAPES_RE = re.compile(r"(\w+)\[([\d,]*)\]")
+KINDS = ("all-gather", "reduce-scatter", "all-reduce", "all-to-all",
+         "collective-permute")
+
+
+def _nbytes(dtype, dims):
+    n = 1
+    for d in dims.split(","):
+        if d:
+            n *= int(d)
+    return n * DTYPE_BYTES.get(dtype, 4)
+
+
+def _result_shape(line, is_start=False):
+    """(dtype, dims) of the op's RESULT. Async ``-start`` ops return a tuple
+    ``(operand, ..., output)`` — the output (last element) is the
+    gathered/reduced result; counting the first would skew all-gather ~N x."""
+    if is_start:
+        head = line.split("-start(")[0]
+        shapes = _TUPLE_SHAPES_RE.findall(head)
+        return shapes[-1] if shapes else None
+    m = _RESULT_RE.search(line)
+    return m.groups() if m else None
+
+
+_GROUPS_LIST_RE = re.compile(r"replica_groups=\{\{([\d,]+)\}")
+_GROUPS_IOTA_RE = re.compile(r"replica_groups=\[(\d+),(\d+)\]")
+
+
+def _group_size(line, default_n):
+    """Ring size of THIS op: the replica-group size from the op's
+    ``replica_groups`` attribute, not the global device count. On a
+    multi-axis mesh a ZeRO reduce-scatter spans only the ``data`` group —
+    charging it the full device product would overreport by the non-data
+    mesh factor. Explicit list form ``{{0,1,..},..}`` and iota form
+    ``[groups,size]<=[N]`` are both parsed; absent/empty groups mean
+    all devices."""
+    m = _GROUPS_LIST_RE.search(line)
+    if m:
+        return len(m.group(1).split(","))
+    m = _GROUPS_IOTA_RE.search(line)
+    if m:
+        return int(m.group(2))
+    return default_n
+
+
+def parse_collectives_by_dtype(hlo, n_devices, loop_trip_count=1):
+    """Per-chip wire bytes for each collective kind, split by payload dtype.
+
+    Wire accounting (ring algorithms, per chip, with G = the op's OWN
+    replica-group size, falling back to ``n_devices`` when the op carries no
+    groups): all-gather receives (G-1)/G of the full result; reduce-scatter
+    sends (G-1)/G of the full input (= result x G); all-reduce is RS+AG =
+    2 x (G-1)/G x full; all-to-all moves (G-1)/G of its payload;
+    collective-permute moves its payload once.
+
+    Ops inside a ``while`` body appear ONCE in the text but run once per
+    iteration — multiplied by ``loop_trip_count`` (= n_layers for the layer
+    scan; the same static-text trap that broke the r4 autotuner cost model).
+    Documented approximation: every while in the audited programs is a layer
+    scan (the audit runs with gradient accumulation 1).
+    """
+    body_names = set(re.findall(r"body=%?([\w.\-]+)", hlo))
+    stats = {k: {"count": 0, "wire_bytes": 0.0, "by_dtype": {},
+                 "by_computation": {}} for k in KINDS}
+    comp = "<entry>"
+    for line in hlo.splitlines():
+        s = line.strip()
+        # computation headers, both HLO text styles: the full signature form
+        # `%name (p: ...) -> type {` and the pass-dump compact form `name {`
+        if s.endswith("{") and "=" not in s and not s.startswith("ROOT"):
+            m = re.match(r"(?:ENTRY\s+)?%?([\w.\-]+)\s*[({]", s)
+            if m and m.group(1) not in ("if", "while", "true", "false"):
+                comp = m.group(1)
+            continue
+        for kind in stats:
+            if f" {kind}(" in s or f" {kind}-start(" in s:
+                shape = _result_shape(s, is_start=f" {kind}-start(" in s)
+                if shape is None:
+                    break
+                dtype, dims = shape
+                b = _nbytes(dtype, dims)
+                g = _group_size(s, n_devices)
+                frac = (g - 1) / g if g > 1 else 1.0
+                if kind == "all-gather":
+                    wire = b * frac
+                elif kind == "reduce-scatter":
+                    wire = b * g * frac
+                elif kind == "all-reduce":
+                    wire = 2 * b * frac
+                elif kind == "all-to-all":
+                    wire = b * frac
+                else:  # collective-permute
+                    wire = b
+                if comp in body_names:
+                    wire *= loop_trip_count
+                st = stats[kind]
+                st["count"] += 1
+                st["wire_bytes"] += wire
+                st["by_dtype"][dtype] = st["by_dtype"].get(dtype, 0.0) + wire
+                st["by_computation"][comp] = \
+                    st["by_computation"].get(comp, 0) + 1
+                break
+    stats["_loop_body_computations"] = sorted(body_names)
+    return stats
+
+
+def fp32_param_bytes(hlo):
+    """Sum of f32 ENTRY-parameter bytes per chip (masters + optimizer
+    moments + small replicated leaves). Proves the master-weight discipline:
+    sharded fp32 state is ~3 x 4 x P / N bytes, nowhere near the 12 x P a
+    replicated layout would show."""
+    total = 0.0
+    in_entry = False
+    for line in hlo.splitlines():
+        s = line.strip()
+        if s.startswith("ENTRY"):
+            in_entry = True
+            continue
+        if in_entry:
+            m = re.match(r"%?[\w.\-]+\s*=\s*f32\[([\d,]*)\][^ ]*\s+parameter\(",
+                         s)
+            if m:
+                total += _nbytes("f32", m.group(1))
+    return total
+
+
+def compile_with_partitioned_hlo(lowered):
+    """Compile a jax ``Lowered``, also capturing the post-SPMD-partitioning
+    / pre-backend-pipeline HLO snapshot via XLA's pass-dump machinery
+    (per-compile compiler options — no env fiddling, no global flags).
+
+    Returns ``(compiled, partitioned_hlo_text)``.
+    """
+    import jax
+
+    def _reset_cache():
+        # the cache object is a lazily-initialized global: flipping the dir
+        # config alone does not evict an already-initialized instance
+        try:
+            from jax._src import compilation_cache as _cc
+
+            _cc.reset_cache()
+        except Exception:
+            pass
+
+    d = tempfile.mkdtemp(prefix="collective_audit_")
+    # a persistent-compile-cache HIT skips the pass pipeline entirely — no
+    # dump gets written — so the cache must be hard-off for this one compile
+    # (observed: the second audit of an identical program returned no
+    # snapshot; compiler_options are NOT part of the cache key).
+    cache_dir_prev = jax.config.jax_compilation_cache_dir
+    try:
+        jax.config.update("jax_compilation_cache_dir", None)
+        _reset_cache()
+        compiled = lowered.compile(compiler_options={
+            "xla_dump_to": d,
+            "xla_dump_hlo_pass_re": "spmd-partition.*",
+        })
+        files = glob.glob(os.path.join(d, "*after_spmd-partitioning*"))
+        if not files:
+            raise RuntimeError(
+                "XLA dumped no after_spmd-partitioning snapshot (flag "
+                "unsupported by this jaxlib?); cannot audit wire dtypes")
+        # the audited step is by far the largest module in the dump dir
+        path = max(files, key=os.path.getsize)
+        with open(path) as f:
+            text = f.read()
+    finally:
+        jax.config.update("jax_compilation_cache_dir", cache_dir_prev)
+        _reset_cache()  # re-initialize with the restored dir on next use
+        shutil.rmtree(d, ignore_errors=True)
+    return compiled, text
+
+
+def audit_lowered(lowered, n_devices, loop_trip_count=1):
+    """Compile + parse: the full wire report for one lowered step program."""
+    compiled, hlo = compile_with_partitioned_hlo(lowered)
+    stats = parse_collectives_by_dtype(hlo, n_devices, loop_trip_count)
+    mem = compiled.memory_analysis()
+    body_names = stats.pop("_loop_body_computations")
+    total = sum(s["wire_bytes"] for s in stats.values())
+    by_dtype = {}
+    for s in stats.values():
+        for dt, b in s["by_dtype"].items():
+            by_dtype[dt] = by_dtype.get(dt, 0.0) + b
+    return {
+        "collectives": stats,
+        "total_wire_bytes": total,
+        "total_by_dtype": by_dtype,
+        "fp32_param_bytes_per_chip": fp32_param_bytes(hlo),
+        "loop_body_computations": body_names,
+        "memory_per_chip": {
+            "temp": mem.temp_size_in_bytes,
+            "arguments": mem.argument_size_in_bytes,
+            "output": mem.output_size_in_bytes,
+            "alias": mem.alias_size_in_bytes,
+        },
+        "hlo_bytes": len(hlo),
+    }
+
+
+def check_budgets(report, budget, n_params=None, n_devices=None):
+    """Compare a report against one budget entry (a dict from
+    ``tools/collective_budgets.json``). Returns human-readable violation
+    strings (empty = pass)."""
+    v = []
+    ag = report["collectives"]["all-gather"]["wire_bytes"]
+    if "all_gather_gb_max" in budget and \
+            ag > budget["all_gather_gb_max"] * 1e9:
+        v.append(f"all-gather wire {ag / 1e9:.2f} GB/chip/step exceeds "
+                 f"budget {budget['all_gather_gb_max']} GB")
+    if "fp32_all_gather_gb_max" in budget:
+        f32 = report["collectives"]["all-gather"]["by_dtype"].get("f32", 0.0)
+        if f32 > budget["fp32_all_gather_gb_max"] * 1e9:
+            v.append(f"fp32 all-gather wire {f32 / 1e9:.2f} GB/chip/step "
+                     f"exceeds budget {budget['fp32_all_gather_gb_max']} GB "
+                     f"(fp32 master gathers reintroduced?)")
+    if "total_wire_gb_max" in budget and \
+            report["total_wire_bytes"] > budget["total_wire_gb_max"] * 1e9:
+        v.append(f"total wire {report['total_wire_bytes'] / 1e9:.2f} "
+                 f"GB/chip/step exceeds budget {budget['total_wire_gb_max']} "
+                 f"GB")
+    if budget.get("masters_sharded_fp32") and n_params and n_devices:
+        # sharded fp32 state (params + adam moments) ~= 3 x 4 x P / N;
+        # 10% + 64 MB slack covers replicated small leaves
+        bound = 3 * 4 * n_params / n_devices * 1.10 + 64e6
+        got = report["fp32_param_bytes_per_chip"]
+        if got > bound:
+            v.append(f"fp32 argument bytes/chip {got / 1e9:.3f} GB exceed "
+                     f"the sharded-master bound {bound / 1e9:.3f} GB — "
+                     f"masters look replicated or upcast")
+    return v

@@ -23,16 +23,36 @@ from ..utils.logging import logger
 
 
 class FlopsProfiler:
-    """Profile a jitted function: XLA-reported flops + measured latency."""
+    """Profile a jitted function: XLA-reported flops + measured latency.
 
-    def __init__(self, fn):
+    With ``collectives=True`` the compile also captures per-step collective
+    wire bytes by kind and payload dtype (``profiling/collectives.py``) — a
+    live run then reports wire bytes next to FLOPs. ``collective_trip_count``
+    multiplies ops inside ``while`` bodies (pass ``n_layers`` for
+    scan-over-layers programs; defaults to 1).
+    """
+
+    def __init__(self, fn, collectives=False, collective_trip_count=1):
         self.fn = fn
         self._compiled = None
         self._flops = None
+        self._want_collectives = collectives
+        self._trip_count = collective_trip_count
+        self._collectives = None
 
     def compile(self, *args, **kwargs):
         lowered = jax.jit(self.fn).lower(*args, **kwargs)
-        self._compiled = lowered.compile()
+        if self._want_collectives:
+            from .collectives import (compile_with_partitioned_hlo,
+                                      parse_collectives_by_dtype)
+
+            self._compiled, hlo = compile_with_partitioned_hlo(lowered)
+            stats = parse_collectives_by_dtype(
+                hlo, jax.device_count(), self._trip_count)
+            stats.pop("_loop_body_computations", None)
+            self._collectives = stats
+        else:
+            self._compiled = lowered.compile()
         cost = self._compiled.cost_analysis()
         if isinstance(cost, list):  # older jax returns [dict]
             cost = cost[0] if cost else {}
@@ -48,6 +68,18 @@ class FlopsProfiler:
     def bytes_accessed(self):
         return self._bytes
 
+    @property
+    def collective_stats(self):
+        """Per-kind wire stats (None unless compiled with collectives=True)."""
+        return self._collectives
+
+    @property
+    def collective_wire_bytes(self):
+        """Total collective wire bytes per chip per step (0 when unknown)."""
+        if not self._collectives:
+            return 0.0
+        return sum(s["wire_bytes"] for s in self._collectives.values())
+
     def measure(self, *args, n_iters=10, warmup=2, **kwargs):
         """Run the compiled fn; returns dict with flops, latency, achieved FLOP/s."""
         if self._compiled is None:
@@ -60,12 +92,16 @@ class FlopsProfiler:
             out = self._compiled(*args, **kwargs)
         jax.block_until_ready(out)
         dt = (time.perf_counter() - t0) / n_iters
-        return {
+        stats = {
             "flops": self._flops,
             "bytes_accessed": self._bytes,
             "latency_s": dt,
             "flops_per_s": self._flops / dt if dt > 0 else 0.0,
         }
+        if self._collectives is not None:
+            stats["collective_wire_bytes"] = self.collective_wire_bytes
+            stats["collectives"] = self._collectives
+        return stats
 
 
 def transformer_train_flops(cfg, batch_size, seq_len, include_backward=True,

@@ -128,6 +128,13 @@ class DeepSpeedEngine:
 
         self.zero_stage = self._config.zero_optimization.stage
         self._persist_threshold = self._config.zero_optimization.param_persistence_threshold
+        # bf16 gradient reduction wire (reduce-scatter at stage >= 2,
+        # all-reduce below): grads are cast BEFORE the sharding constraint so
+        # the collective moves 16-bit payloads; accumulation across
+        # micro-batches then also runs at the wire dtype. fp32 = exact.
+        self._grad_wire_dtype = jnp.bfloat16 \
+            if self._config.zero_optimization.grad_reduce_dtype == "bf16" \
+            else None
         # validated regardless of gather mode: a typo'd knob must fail at
         # construction, not lie dormant until per_layer is enabled
         if self._config.zero_optimization.zero3_gather_impl not in (
@@ -286,17 +293,32 @@ class DeepSpeedEngine:
                 is_leaf=lambda x: isinstance(x, P))
             self.module.config.zero3_per_layer_gather = True
             self.module.config.zero3_gather_specs = gather_specs
-            impl = self._config.zero_optimization.zero3_gather_impl
+            impl, wire = self._resolve_gather_wire()
             if impl == "shard_map":
                 if not hasattr(self.module.config, "zero3_sharded_specs"):
                     # refuse rather than silently run fp32-sized gather wire
-                    # while the operator believes the bf16 path is active
+                    # while the operator believes the bf16/int8 path is active
                     raise ConfigError(
                         "zero3_gather_impl: 'shard_map' requires a model "
                         "config with a zero3_sharded_specs field (the "
                         "transformer backbone); this module only supports "
                         "the 'constraint' impl")
                 self.module.config.zero3_gather_impl = "shard_map"
+                if hasattr(self.module.config, "zero3_gather_dtype"):
+                    self.module.config.zero3_gather_dtype = wire
+                    self.module.config.zero3_gather_block = \
+                        self._config.zero_optimization.zero3_gather_block
+                elif wire != "compute":
+                    # "compute" is the field-less module's historical
+                    # behavior; anything EXPLICIT (fp32 included — an
+                    # exact-gather baseline silently running bf16 wire is
+                    # precisely the mismatch this guard exists for) needs a
+                    # config that can carry it
+                    raise ConfigError(
+                        f"zero3_gather_dtype={wire!r} requires a model config "
+                        f"with a zero3_gather_dtype field (the transformer "
+                        f"backbone); this module would silently gather at "
+                        f"the compute dtype")
                 # sharded specs minus the layers dim: the shard_map islands'
                 # in_specs (the all_gather's input layout)
                 self.module.config.zero3_sharded_specs = \
@@ -334,8 +356,8 @@ class DeepSpeedEngine:
                         _strip_embed_axis, self._axes[k], v,
                         is_leaf=is_axes)
                     for k, v in self.param_specs.items() if k != "blocks"}
-            log_dist("ZeRO-3 gather mode: per_layer (explicit schedule)",
-                     ranks=[0])
+            log_dist(f"ZeRO-3 gather mode: per_layer (explicit schedule, "
+                     f"impl={impl}, wire={wire})", ranks=[0])
 
         # -- progressive layer drop (reference engine.py:680 PLD hook) ---------------
         self._pld = None
@@ -416,6 +438,9 @@ class DeepSpeedEngine:
         self._train_step_fn = None
         self._eval_fn = None
         self._train_mode = True
+        # per-step collective wire stats (comms_logger / collective_wire_stats)
+        self._wire_stats = None
+        self._last_batch_struct = None
 
         log_dist(
             f"DeepSpeedEngine: mesh={dict(self.mesh.shape)} zero_stage={self.zero_stage} "
@@ -434,6 +459,31 @@ class DeepSpeedEngine:
     # ------------------------------------------------------------------------------
     # init helpers
     # ------------------------------------------------------------------------------
+    def _resolve_gather_wire(self):
+        """``zero3_gather_dtype`` -> (impl, wire-dtype name for the model).
+
+        bf16/int8 wires imply the shard_map impl — a constraint chain cannot
+        pin the wire dtype (the partitioner reshards an elementwise op's
+        input to match its constrained output; PERF.md "known 2x"). "bf16"
+        means "the 16-bit compute dtype": under fp16 training the wire is
+        fp16. Masters stay sharded fp32 in every mode.
+        """
+        z = self._config.zero_optimization
+        impl, gdtype = z.zero3_gather_impl, z.zero3_gather_dtype
+        if gdtype in ("bf16", "int8") and impl != "shard_map":
+            log_dist(
+                f"zero3_gather_dtype={gdtype!r} implies "
+                f"zero3_gather_impl='shard_map' (a sharding-constraint chain "
+                f"cannot pin the wire dtype); upgrading", ranks=[0])
+            impl = "shard_map"
+        if gdtype == "auto":
+            wire = "compute" if impl == "shard_map" else "fp32"
+        elif gdtype == "bf16":
+            wire = "fp16" if self._config.fp16.enabled else "bf16"
+        else:
+            wire = gdtype  # "fp32" | "int8"
+        return impl, wire
+
     def _init_parameters(self, model_parameters):
         if model_parameters is not None:
             if isinstance(model_parameters, tuple) and len(model_parameters) == 2:
@@ -707,6 +757,13 @@ class DeepSpeedEngine:
     def _build_fwd_bwd(self):
         gas = self.gradient_accumulation_steps_
 
+        if self._grad_wire_dtype is not None and (
+                self._use_1f1b() or self._use_pm_1f1b()):
+            logger.warning(
+                "grad_reduce_dtype=bf16 does not apply to 1F1B schedules "
+                "(their grads cross manual boundaries in fp32 by design); "
+                "reducing in fp32")
+
         if self._use_pm_1f1b(warn=True):
             # 1F1B over a user PipelineModule layer list: the module builds
             # the schedule (switch-vjp per tick); same fwd_bwd contract
@@ -747,6 +804,9 @@ class DeepSpeedEngine:
                 return loss * scale.astype(loss.dtype) / gas, loss
 
             (_, loss), grads = jax.value_and_grad(scaled_loss, has_aux=True)(params)
+            if self._grad_wire_dtype is not None:
+                grads = jax.tree_util.tree_map(
+                    lambda g: g.astype(self._grad_wire_dtype), grads)
             return loss, grads
 
         with self.mesh:
@@ -843,8 +903,17 @@ class DeepSpeedEngine:
                 return loss * scale.astype(loss.dtype) / gas, loss
 
             grad_fn = jax.value_and_grad(scaled_loss, has_aux=True)
-            constrain = lambda g: jax.lax.with_sharding_constraint(
-                g, self._grad_shardings)  # ZeRO-2: grads sharded over data
+            grad_wire = self._grad_wire_dtype
+
+            def constrain(g):
+                # ZeRO-2: grads sharded over data; with grad_reduce_dtype=
+                # bf16 the cast lands BEFORE the constraint, so the reduce
+                # collective's payload (and the accumulation carry) is 16-bit
+                if grad_wire is not None:
+                    g = jax.tree_util.tree_map(
+                        lambda a: a.astype(grad_wire), g)
+                return jax.lax.with_sharding_constraint(
+                    g, self._grad_shardings)
             # compression runs ONCE per step, outside the accumulation scan:
             # cp is the compressed tree the micro-batches differentiate
             # against, and the vjp pulls the accumulated grads back through
@@ -871,6 +940,10 @@ class DeepSpeedEngine:
                 grads, losses = jax.lax.scan(body, zeros, (batches, micro_rngs))
                 mean_loss = jnp.mean(losses)
             if compress_vjp is not None:
+                # the pullback wants cotangents in the primal output dtype
+                # (fp32 params); harmless identity cast when grad_wire is off
+                grads = jax.tree_util.tree_map(
+                    lambda g, p: g.astype(p.dtype), grads, cp)
                 (grads,) = compress_vjp(grads)
             grads = constrain(grads)
 
@@ -924,6 +997,9 @@ class DeepSpeedEngine:
         pld_theta = jnp.asarray(
             self._pld.update_state(self.global_steps) if self._pld else 1.0,
             jnp.float32)
+        self._last_batch_struct = jax.tree_util.tree_map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype,
+                                           sharding=a.sharding), batches)
         (self.params, self.optimizer_state, self._scale, self._good_steps,
          overflow, grad_norm, mean_loss, self._rng) = self._train_step_fn(
             self.params, self.optimizer_state, batches, self._scale,
@@ -942,11 +1018,21 @@ class DeepSpeedEngine:
         elif self.lr_scheduler is not None:
             self.lr_scheduler.step()
         if self.global_steps % self._config.steps_per_print == 0:
-            self.monitor.write_events(
-                [("Train/lr", lr, self.global_steps),
-                 ("Train/grad_norm", float(grad_norm), self.global_steps),
-                 ("Train/loss", float(mean_loss), self.global_steps)]
-            )
+            events = [("Train/lr", lr, self.global_steps),
+                      ("Train/grad_norm", float(grad_norm), self.global_steps),
+                      ("Train/loss", float(mean_loss), self.global_steps)]
+            if self._config.comms_logger.enabled:
+                ws = self.collective_wire_stats()
+                if ws:
+                    for kind, s in ws["collectives"].items():
+                        if s["count"]:
+                            events.append((f"Comm/{kind.replace('-', '_')}_gb",
+                                           s["wire_bytes"] / 1e9,
+                                           self.global_steps))
+                    events.append(("Comm/total_wire_gb",
+                                   ws["total_wire_bytes"] / 1e9,
+                                   self.global_steps))
+            self.monitor.write_events(events)
             self._report_progress()
             if self._config.memory_breakdown:
                 # reference see_memory_usage role, via the accelerator seam
@@ -1399,6 +1485,50 @@ class DeepSpeedEngine:
         # OTHER live engine in the process to recompile; dropping this
         # engine's jitted wrappers frees its executables
         gc.collect()
+
+    def collective_wire_stats(self, refresh=False):
+        """Per-step collective wire bytes of the compiled train step, by
+        kind and payload dtype (``profiling/collectives.py``).
+
+        Available after the first fused ``train_batch`` call. The first call
+        triggers ONE extra AOT compile of the step program (the audit needs
+        a fresh pass-pipeline run to snapshot the post-SPMD-partitioning
+        HLO); the result is cached. Returns None when the fused step has not
+        run yet (pipeline/offload/1-bit paths are not audited here — use
+        ``tools/collective_audit.py`` on a matching config instead).
+
+        Only offered at gradient_accumulation_steps == 1: with gas > 1 the
+        accumulation scan and the layer scan are BOTH while bodies, and the
+        single loop-trip multiplier would mis-scale them in opposite
+        directions (gathers x8 under, reduces x5 over at gas=8/L=40) —
+        wrong monitor numbers are worse than none.
+        """
+        if self._wire_stats is not None and not refresh:
+            return self._wire_stats
+        if self._train_step_fn is None or self._last_batch_struct is None:
+            return None
+        if self.gradient_accumulation_steps_ > 1:
+            logger.warning(
+                "collective_wire_stats: not emitted at gradient_accumulation"
+                "_steps=%d — the HLO loop-trip attribution is only exact at "
+                "gas=1 (audit a gas=1 config with tools/collective_audit.py "
+                "instead)", self.gradient_accumulation_steps_)
+            return None
+        from ..profiling.collectives import audit_lowered
+
+        # lower() only traces avals — live trees are fine (nothing executes,
+        # nothing is donated), the batch rides as ShapeDtypeStructs
+        lowered = self._train_step_fn.lower(
+            self.params, self.optimizer_state, self._last_batch_struct,
+            self._scale, self._good_steps, self._rng,
+            jnp.asarray(0.0, jnp.float32), jnp.asarray(1.0, jnp.float32))
+        trip = getattr(self.module.config, "n_layers", 1) \
+            if getattr(self.module.config, "scan_layers", False) else 1
+        self._wire_stats = audit_lowered(
+            lowered, self.dp_world_size * self.mp_world_size
+            * self.pipe_stages * self.seq_parallel_size,
+            loop_trip_count=trip)
+        return self._wire_stats
 
     def _report_progress(self):
         """Reference ``engine.py:2167`` _report_progress."""

@@ -63,6 +63,12 @@ import jax  # noqa: E402
 # which overrides the env var — force CPU at the config level before backend init.
 jax.config.update("jax_platforms", "cpu")
 
+# jax.shard_map compat on 0.4.x jaxlibs — installed before test modules import
+# (tests do `from jax import shard_map` at module scope)
+from deepspeed_tpu.utils import jax_compat as _jax_compat  # noqa: E402
+
+_jax_compat.install()
+
 # Persistent compilation cache: the suite compiles hundreds of small SPMD
 # programs (this box has ONE core); identical programs across runs hit the disk
 # cache instead of recompiling, cutting repeat wall-clock by minutes.

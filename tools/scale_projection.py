@@ -46,6 +46,11 @@ PRESETS = {
     # headline bench shape, for sanity-checking the pipeline quickly
     "gpt2-350m": dict(n_layers=24, d_model=1024, n_heads=16, d_ff=4096,
                       vocab_size=50304, seq=1024),
+    # seconds-scale shape for the tier-1 collective audit (8-device CPU mesh;
+    # tests/unit/test_collective_audit.py) and for exercising the audit
+    # pipeline end to end without a big compile
+    "tiny-test": dict(n_layers=4, d_model=128, n_heads=4, d_ff=256,
+                      vocab_size=512, seq=64),
 }
 
 # ICI model (documented assumptions; "How to Scale Your Model" numbers):
