@@ -11,6 +11,7 @@ from .config import (
     ActivationCheckpointingConfig,
     CommsLoggerConfig,
     FlopsProfilerConfig,
+    ServingConfig,
     load_config,
 )
 
@@ -28,5 +29,6 @@ __all__ = [
     "ActivationCheckpointingConfig",
     "CommsLoggerConfig",
     "FlopsProfilerConfig",
+    "ServingConfig",
     "load_config",
 ]

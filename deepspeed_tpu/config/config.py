@@ -292,6 +292,49 @@ class CommsLoggerConfig(ConfigModel):
     prof_ops: list = []
 
 
+class ServingConfig(ConfigModel):
+    """Continuous-batching serving (Orca-style slot scheduler over ONE jitted
+    decode program; DeepSpeed-Inference's serving-side batching layer,
+    TPU-native). Consumed by ``serving/engine.py`` via the inference config's
+    ``serving`` block."""
+
+    # fixed decode batch-slot pool: static shapes, compiled once; finished
+    # requests free their slot mid-flight and queued ones are spliced in
+    n_slots: int = 8
+    # per-slot KV window (prompt + generation); 0 = inference max_tokens
+    max_len: int = 0
+    # admission control: requests beyond this queue depth are shed with a
+    # reason instead of growing until OOM
+    max_queue_depth: int = 64
+    # prefill/decode interleaving: at most this many prefills per scheduler
+    # step, so a burst of arrivals can't starve running decodes (TPOT)
+    max_prefills_per_step: int = 1
+    # fcfs is the only policy today; the field pins the config surface
+    policy: str = "fcfs"
+    # deterministic virtual-clock mode (tests/simulation): scheduler time
+    # advances by the cost model below instead of the wall clock
+    virtual_clock: bool = False
+    virtual_decode_step_cost: float = 1.0
+    virtual_prefill_cost_per_token: float = 0.0625  # ~flash prefill vs decode
+    # zero a slot's KV rows when its request finishes (the causal mask and
+    # whole-row insert already prevent stale-KV leaks; hygiene/debug knob)
+    scrub_freed_slots: bool = False
+    # emit Serving/* monitor events every N scheduler steps (0 disables)
+    monitor_interval: int = 32
+
+    def _validate(self):
+        if self.n_slots < 1:
+            raise ConfigError(f"serving.n_slots must be >= 1, got {self.n_slots}")
+        if self.max_queue_depth < 1:
+            raise ConfigError(
+                f"serving.max_queue_depth must be >= 1, got {self.max_queue_depth}")
+        if self.policy != "fcfs":
+            raise ConfigError(
+                f"serving.policy must be 'fcfs', got {self.policy!r}")
+        if self.max_prefills_per_step < 1:
+            raise ConfigError("serving.max_prefills_per_step must be >= 1")
+
+
 class FlopsProfilerConfig(ConfigModel):
     """Reference: ``profiling/config.py``."""
 

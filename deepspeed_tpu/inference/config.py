@@ -8,6 +8,8 @@ graph), kernel-injection flags disappear (XLA fuses the inference kernels).
 import typing
 
 from ..config.base import ConfigModel
+from ..config.config import (CSVConfig, ServingConfig, TensorBoardConfig,
+                             WandbConfig)
 
 
 class TensorParallelConfig(ConfigModel):
@@ -44,10 +46,24 @@ class DeepSpeedInferenceConfig(ConfigModel):
     # program per LENGTH BUCKET instead of one per distinct prompt length
     # (recompile-free TTFT for varying prompts). 1 disables bucketing.
     prompt_bucket_size: int = 64
+    # "pow2": buckets are prompt_bucket_size doublings (16, 32, 64, ...), so
+    # an adversarial prompt-length mix compiles at most log2(max_tokens)
+    # programs. "multiple": every multiple of prompt_bucket_size is a bucket
+    # (tighter padding, unbounded distinct buckets).
+    prompt_bucket_policy: str = "pow2"
+    # LRU cap on compiled prefill/decode program pairs; evicting logs one
+    # warning line. 0 = unbounded.
+    compile_cache_size: int = 32
     # generate() pads the BATCH dim up to a multiple of this (padded rows are
     # dropped from the output). 1 disables; opt in when request batch sizes
     # vary — row padding costs compute but saves the recompile.
     batch_bucket_size: int = 1
+    # continuous-batching serving layer (serving/engine.py ServingEngine)
+    serving: ServingConfig = None
+    # serving metrics backends (Serving/* events; same sections as training)
+    tensorboard: TensorBoardConfig = None
+    wandb: WandbConfig = None
+    csv_monitor: CSVConfig = None
     quant: QuantizationConfig = None
     moe: MoEInferenceConfig = None
     replace_with_kernel_inject: bool = False  # accepted for config compat; no-op
@@ -64,7 +80,19 @@ class DeepSpeedInferenceConfig(ConfigModel):
             self.quant = QuantizationConfig()
         if self.moe is None:
             self.moe = MoEInferenceConfig()
-        if self.dtype not in ("float16", "bfloat16", "float32"):
-            from ..config.base import ConfigError
+        if self.serving is None:
+            self.serving = ServingConfig()
+        if self.tensorboard is None:
+            self.tensorboard = TensorBoardConfig()
+        if self.wandb is None:
+            self.wandb = WandbConfig()
+        if self.csv_monitor is None:
+            self.csv_monitor = CSVConfig()
+        from ..config.base import ConfigError
 
+        if self.dtype not in ("float16", "bfloat16", "float32"):
             raise ConfigError(f"inference dtype must be fp16/bf16/fp32, got {self.dtype}")
+        if self.prompt_bucket_policy not in ("pow2", "multiple"):
+            raise ConfigError(
+                "prompt_bucket_policy must be 'pow2' or 'multiple', got "
+                f"{self.prompt_bucket_policy!r}")

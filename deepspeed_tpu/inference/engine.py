@@ -14,6 +14,8 @@ containers (``module_inject/replace_module.py:276``), slices weights per TP rank
   merge/split logic disappears).
 """
 
+from collections import OrderedDict
+
 import numpy as np
 import jax
 import jax.numpy as jnp
@@ -28,6 +30,27 @@ from ..parallel.sharding import param_partition_specs, named
 from ..utils.logging import log_dist
 
 DTYPES = {"float16": jnp.float16, "bfloat16": jnp.bfloat16, "float32": jnp.float32}
+
+
+def lru_compiled(cache, key, build, cap, label):
+    """LRU lookup in ``cache`` (an OrderedDict) of the compiled program(s)
+    for ``key``; ``build()`` compiles on miss. Over ``cap`` entries, the
+    least-recently-used programs are evicted with a one-line warning —
+    adversarial key mixes (e.g. prompt lengths) can't grow compiled programs
+    without bound. Shared by the generate cache and the serving prefill
+    cache."""
+    if key in cache:
+        cache.move_to_end(key)
+        return cache[key]
+    fns = build()
+    cache[key] = fns
+    if cap > 0 and len(cache) > cap:
+        import logging
+
+        evicted, _ = cache.popitem(last=False)
+        log_dist(f"{label} compile cache over cap ({cap}): evicted programs "
+                 f"for key {evicted}", ranks=[0], level=logging.WARNING)
+    return fns
 
 
 class InferenceEngine:
@@ -54,11 +77,17 @@ class InferenceEngine:
             model.config.mesh = self.mesh
 
         self._rng = jax.random.PRNGKey(config.seed)
+        self._request_seq = 0  # folded into per-call rng: two requests with
+        # the same prompt length must not share a sampling stream
         self._init_parameters(model_parameters)
 
-        self._prefill_fn = None   # keyed by prompt length
+        self._prefill_fn = None
         self._decode_fn = None
-        self._prefill_cache = {}
+        # LRU of compiled (prefill, decode) pairs keyed by (batch, prompt
+        # bucket, sampling shape); bounded by config.compile_cache_size so an
+        # adversarial length mix can't grow compiled programs without bound
+        self._prefill_cache = OrderedDict()
+        self._serving = None
 
         log_dist(
             f"InferenceEngine: mesh={dict(self.mesh.shape)} dtype={config.dtype} "
@@ -224,7 +253,6 @@ class InferenceEngine:
         share compiled programs; the pad columns are sliced off."""
         input_ids = jnp.asarray(input_ids)
         b, s = input_ids.shape
-        bucket = max(int(self._config.prompt_bucket_size), 1)
         # no config = unknown model: don't assume causality — right-padding a
         # bidirectional model would let pad tokens attend into real positions
         # and silently corrupt the logits (skipping the bucket only costs one
@@ -233,10 +261,10 @@ class InferenceEngine:
         causal = getattr(mod_cfg, "causal", True) if mod_cfg is not None \
             else False
         padded = s
-        if causal and s % bucket:
-            padded = min(-(-s // bucket) * bucket, self._config.max_tokens)
-            padded = max(padded, s)
-            input_ids = jnp.pad(input_ids, ((0, 0), (0, padded - s)))
+        if causal:
+            padded = self._bucket_prompt_len(s, self._config.max_tokens)
+            if padded > s:
+                input_ids = jnp.pad(input_ids, ((0, 0), (0, padded - s)))
         if self._prefill_fn is None:
             with self.mesh:
                 self._prefill_fn = jax.jit(
@@ -255,12 +283,39 @@ class InferenceEngine:
         self.params = None
         self._prefill_fn = None
         self._decode_fn = None
-        self._prefill_cache = {}
+        self._prefill_cache = OrderedDict()
+        if self._serving is not None:
+            self._serving.destroy()
+            self._serving = None
         import gc
 
         # no jax.clear_caches(): process-global, would wipe other live
         # engines' compiled programs; dropping our wrappers is enough
         gc.collect()
+
+    def _bucket_prompt_len(self, prompt_len, ceiling):
+        """Padded prompt length for ``prompt_len`` under the configured bucket
+        policy, clipped to ``ceiling`` (the KV window minus generation room).
+
+        "multiple": next multiple of prompt_bucket_size. "pow2" (default):
+        next prompt_bucket_size doubling — at most log2(max_tokens) distinct
+        buckets, so together with the LRU cap below the compiled-program set
+        is bounded no matter what length mix arrives."""
+        bucket = max(int(self._config.prompt_bucket_size), 1)
+        if bucket > 1 and self._config.prompt_bucket_policy == "pow2":
+            padded = bucket
+            while padded < prompt_len:
+                padded *= 2
+        else:
+            padded = -(-prompt_len // bucket) * bucket
+        return max(min(padded, ceiling), prompt_len)
+
+    def _compiled_programs(self, key, build):
+        """LRU-bounded (prefill, decode) pair for ``key`` = (batch, prompt
+        bucket, sampling shape)."""
+        return lru_compiled(self._prefill_cache, key, build,
+                            int(self._config.compile_cache_size or 0),
+                            "inference")
 
     def generate(self, input_ids, max_new_tokens=32, temperature=1.0, top_k=0,
                  greedy=True, eos_token_id=None, rng=None):
@@ -282,7 +337,13 @@ class InferenceEngine:
             raise ConfigError(
                 f"generate: prompt {prompt_len} + max_new_tokens {max_new_tokens} "
                 f"exceeds max_tokens {self._config.max_tokens}")
-        rng = rng if rng is not None else jax.random.fold_in(self._rng, prompt_len)
+        # per-request rng: fold a monotonically increasing request id into the
+        # engine key (two same-length requests must not share a stream); pass
+        # an explicit ``rng`` for reproducible sampling
+        self._request_seq += 1
+        if rng is None:
+            rng = jax.random.fold_in(
+                jax.random.fold_in(self._rng, self._request_seq), prompt_len)
 
         # cache [L, b, max_len, kvh, dh]: batch over data, kv heads over model
         # (only when divisible — MQA/GQA may have fewer kv heads than tp)
@@ -316,10 +377,8 @@ class InferenceEngine:
         # Prompt-length BUCKETING: right-pad the prompt to the next bucket and
         # pass the true length as a traced scalar, so a TTFT-critical serving
         # loop compiles once per bucket, not once per distinct prompt length.
-        bucket = max(int(self._config.prompt_bucket_size), 1)
-        padded_len = min(-(-prompt_len // bucket) * bucket,
-                         self._config.max_tokens - max_new_tokens)
-        padded_len = max(padded_len, prompt_len)
+        padded_len = self._bucket_prompt_len(
+            prompt_len, self._config.max_tokens - max_new_tokens)
         max_len = padded_len + max_new_tokens
         if padded_len > prompt_len:
             ids_in = jnp.pad(input_ids, ((0, 0), (0, padded_len - prompt_len)))
@@ -329,7 +388,8 @@ class InferenceEngine:
 
         key = (b, padded_len, max_new_tokens, bool(greedy), int(top_k),
                eos_token_id)
-        if key not in self._prefill_cache:
+
+        def build():
             from ..models.decoding import (decode_tokens, decode_tokens_until,
                                            prefill_and_first_token)
 
@@ -355,14 +415,14 @@ class InferenceEngine:
                     steps=max_new_tokens - 1, greedy=greedy, top_k=top_k)
 
             with self.mesh:
-                self._prefill_cache[key] = (
+                return (
                     jax.jit(prefill,
                             out_shardings=(token_sharding,
                                            {"k": cache_sharding, "v": cache_sharding})),
                     jax.jit(decode, donate_argnums=(1,)),
                 )
 
-        prefill_fn, decode_fn = self._prefill_cache[key]
+        prefill_fn, decode_fn = self._compiled_programs(key, build)
         rng, r1, r2 = jax.random.split(rng, 3)
         temp = jnp.asarray(temperature, jnp.float32)
         first, cache = prefill_fn(self.params, ids_in, r1, temp, true_len)
@@ -396,6 +456,24 @@ class InferenceEngine:
                           temperature=temperature, top_k=top_k, greedy=greedy,
                           eos_token_id=eos_token_id)
         return len(self._prefill_cache)
+
+    def serve(self, requests=None, **kwargs):
+        """Continuous-batching streaming serving: yields per-request
+        ``TokenEvent``s as tokens are produced (``serving/engine.py``). One
+        jitted decode program over a fixed slot pool; finished requests free
+        their slot mid-flight and queued ones are spliced in — no
+        recompilation, no waiting for the batch to drain. Configure via the
+        inference config's ``serving`` block."""
+        return self.serving.serve(requests, **kwargs)
+
+    @property
+    def serving(self):
+        """The lazily-built ServingEngine bound to this engine's weights."""
+        if self._serving is None:
+            from ..serving import ServingEngine
+
+            self._serving = ServingEngine(self)
+        return self._serving
 
     @property
     def config(self):

@@ -33,17 +33,53 @@ def init_cache(cfg, batch_size, max_len, dtype=None):
     }
 
 
+def insert_slot_kv(pool, slot_cache, slot):
+    """Write one request's freshly-prefilled cache into batch slot ``slot`` of
+    a slot-pool cache (continuous batching: a queued request joins the running
+    decode batch without draining it).
+
+    pool: {"k","v"} [L, n_slots, max_len, kvh, dh]; slot_cache: the same with
+    a batch dim of 1; ``slot`` is a TRACED scalar — one compiled insert
+    program covers every slot. The whole [max_len] row is overwritten, so
+    nothing from the slot's previous occupant survives."""
+    return {
+        "k": jax.lax.dynamic_update_slice(
+            pool["k"], slot_cache["k"].astype(pool["k"].dtype),
+            (0, slot, 0, 0, 0)),
+        "v": jax.lax.dynamic_update_slice(
+            pool["v"], slot_cache["v"].astype(pool["v"].dtype),
+            (0, slot, 0, 0, 0)),
+    }
+
+
+def reset_slot_kv(pool, slot):
+    """Zero batch slot ``slot`` of a slot-pool cache (optional hygiene when a
+    request frees its slot; the causal mask already keeps stale rows out of
+    every later request's attention window, and ``insert_slot_kv`` overwrites
+    the full row — this is for debugging / belt-and-braces serving modes)."""
+    z = jnp.zeros(pool["k"].shape[:1] + (1,) + pool["k"].shape[2:],
+                  pool["k"].dtype)
+    return {
+        "k": jax.lax.dynamic_update_slice(pool["k"], z, (0, slot, 0, 0, 0)),
+        "v": jax.lax.dynamic_update_slice(
+            pool["v"], z.astype(pool["v"].dtype), (0, slot, 0, 0, 0)),
+    }
+
+
 def _attn_with_cache(cfg, p_attn, h, k_cache, v_cache, pos, kv_len, rope=None,
                      is_local=None, prefill=False):
     """Attention for q block [b, q, d] against cache[:, :kv_len] after writing the
     new k/v at ``pos``. Returns (out [b, q, d], new k_cache, new v_cache).
 
-    k_cache/v_cache: [b, max_len, kvh, dh]; pos: scalar write offset;
-    kv_len: static upper bound on valid cache length (mask handles the rest).
+    k_cache/v_cache: [b, max_len, kvh, dh]; pos: scalar write offset, OR a
+    per-row [b] vector (continuous-batching slot pools, where each co-batched
+    request sits at its own cursor); kv_len: static upper bound on valid cache
+    length (mask handles the rest).
     ``prefill``: static caller promise that pos == 0 and the q block IS the
-    whole visible window — enables the flash fast path below.
+    whole visible window — enables the flash fast path below (scalar pos only).
     """
     b, q_len, d = h.shape
+    per_row = jnp.ndim(pos) == 1
     q = L.linear_apply(p_attn["q"], h).reshape(b, q_len, cfg.n_heads, cfg.head_dim)
     k = L.linear_apply(p_attn["k"], h).reshape(b, q_len, cfg.kv_heads, cfg.head_dim)
     v = L.linear_apply(p_attn["v"], h).reshape(b, q_len, cfg.kv_heads, cfg.head_dim)
@@ -54,10 +90,18 @@ def _attn_with_cache(cfg, p_attn, h, k_cache, v_cache, pos, kv_len, rope=None,
         k = L.apply_rotary(k, cos, sin, cfg.rotary_dim,
                            cfg.rotary_interleaved)
 
-    k_cache = jax.lax.dynamic_update_slice(k_cache, k.astype(k_cache.dtype),
-                                           (0, pos, 0, 0))
-    v_cache = jax.lax.dynamic_update_slice(v_cache, v.astype(v_cache.dtype),
-                                           (0, pos, 0, 0))
+    if per_row:
+        # each row writes its q block at its OWN cursor (slot-pool decode);
+        # vmapped dynamic_update_slice lowers to a per-row scatter
+        row_update = jax.vmap(
+            lambda c, blk, p: jax.lax.dynamic_update_slice(c, blk, (p, 0, 0)))
+        k_cache = row_update(k_cache, k.astype(k_cache.dtype), pos)
+        v_cache = row_update(v_cache, v.astype(v_cache.dtype), pos)
+    else:
+        k_cache = jax.lax.dynamic_update_slice(k_cache, k.astype(k_cache.dtype),
+                                               (0, pos, 0, 0))
+        v_cache = jax.lax.dynamic_update_slice(v_cache, v.astype(v_cache.dtype),
+                                               (0, pos, 0, 0))
 
     # Prefill is plain causal attention over the just-written prompt rows:
     # cache slot j >= q_len is in the causal future of every query, so the
@@ -71,8 +115,8 @@ def _attn_with_cache(cfg, p_attn, h, k_cache, v_cache, pos, kv_len, rope=None,
     flash_wanted = cfg.prefill_flash
     if flash_wanted is None:
         flash_wanted = jax.default_backend() == "tpu"
-    if (flash_wanted and prefill and q_len > 1 and is_local is None
-            and cfg.position_embedding != "alibi"):
+    if (flash_wanted and prefill and not per_row and q_len > 1
+            and is_local is None and cfg.position_embedding != "alibi"):
         from ..ops.flash_attention import flash_attention
 
         n_rep = cfg.n_heads // cfg.kv_heads
@@ -89,18 +133,31 @@ def _attn_with_cache(cfg, p_attn, h, k_cache, v_cache, pos, kv_len, rope=None,
     v_full = L._repeat_kv(v_cache[:, :kv_len], cfg.n_heads // cfg.kv_heads)
 
     # causal vs the cache: query i (global pos+i) sees cache slots <= pos+i
-    kv_idx = jnp.arange(kv_len)[None, :]
-    q_idx = pos + jnp.arange(q_len)[:, None]
+    if per_row:
+        kv_idx = jnp.arange(kv_len)[None, None, :]                 # [1, 1, kv]
+        q_idx = pos[:, None, None] + jnp.arange(q_len)[None, :, None]  # [b, q, 1]
+    else:
+        kv_idx = jnp.arange(kv_len)[None, :]
+        q_idx = pos + jnp.arange(q_len)[:, None]
     allowed = kv_idx <= q_idx
     if cfg.local_attention_window > 0 and is_local is not None:
         # banded local layers (GPT-Neo): is_local is a traced per-layer bool
         band = q_idx - kv_idx < cfg.local_attention_window
         allowed = allowed & (band | jnp.logical_not(is_local))
-    mask = allowed[None, None, :, :]  # [1, 1, q, kv]
+    # [b, 1, q, kv] (per-row cursors) or [1, 1, q, kv] (shared cursor)
+    mask = allowed[:, None, :, :] if per_row else allowed[None, None, :, :]
 
     alibi = None
     if cfg.position_embedding == "alibi":
-        alibi = _alibi_slice(cfg, q_len, kv_len, pos)
+        if per_row:
+            # slopes * (kv - q) per row — the same int-difference-then-
+            # fp32-multiply as _alibi_slice, so per-row values are bitwise
+            # equal to the scalar-cursor path at the same positions
+            dist = (kv_idx - q_idx).astype(jnp.float32)  # [b, q, kv]
+            alibi = (L.alibi_slopes(cfg.n_heads)[None, :, None, None]
+                     * dist[:, None, :, :])
+        else:
+            alibi = _alibi_slice(cfg, q_len, kv_len, pos)
 
     out = L.dot_product_attention(
         q, k_full, v_full, mask=mask, scale=cfg.attn_scale, alibi_bias=alibi,
@@ -175,15 +232,20 @@ def forward_with_cache(model, params, input_ids, cache, pos, kv_len,
     """Run the model on ``input_ids`` [b, q] writing k/v into ``cache`` at ``pos``.
 
     Used for both prefill (q = prompt length, pos = 0) and decode (q = 1,
-    pos = cursor). Returns (logits [b, q, vocab], new_cache).
+    pos = cursor). ``pos`` may be a scalar (whole batch at one cursor) or a
+    [b] vector (slot-pool continuous batching: every row at its own cursor).
+    Returns (logits [b, q, vocab], new_cache).
     ``prefill=True`` is the caller's static promise that pos == 0 and the
     whole visible window is this q block — it unlocks the flash fast path
     (callers with pos > 0 must leave it False).
     """
     cfg = model.config
     b, q_len = input_ids.shape
-    positions = pos + jnp.arange(q_len)[None, :]
-    positions = jnp.broadcast_to(positions, (b, q_len))
+    if jnp.ndim(pos) == 1:
+        positions = pos[:, None] + jnp.arange(q_len)[None, :]  # [b, q]
+    else:
+        positions = pos + jnp.arange(q_len)[None, :]
+        positions = jnp.broadcast_to(positions, (b, q_len))
 
     x = L.embedding_apply(params["wte"], input_ids, cfg.compute_dtype)
     if cfg.position_embedding == "learned":
@@ -229,13 +291,23 @@ def forward_with_cache(model, params, input_ids, cache, pos, kv_len,
     return logits, {"k": k_new, "v": v_new}
 
 
-def sample_token(logits, rng, *, temperature=1.0, top_k=0, greedy=False):
+def sample_token(logits, rng, *, temperature=1.0, top_k=0, top_p=1.0,
+                 greedy=False):
     """logits: [b, vocab] -> [b] int32.
 
-    ``greedy`` and ``top_k`` are static (shape the program); ``temperature``
-    may be a TRACED scalar so serving/rollout loops can change it without
-    recompiling (the reference recompiles nothing — CUDA kernels take it as a
-    runtime arg; so do we)."""
+    ``greedy``, ``top_k`` and ``top_p`` are static (shape the program);
+    ``temperature`` may be a TRACED scalar so serving/rollout loops can change
+    it without recompiling (the reference recompiles nothing — CUDA kernels
+    take it as a runtime arg; so do we).
+
+    PER-REQUEST mode: pass ``rng`` as a [b, 2] stack of PRNG keys and
+    temperature/top_k/top_p as [b] arrays — every co-batched row then samples
+    from its OWN rng stream with its own knobs (continuous-batching slot
+    pools), all traced so one compiled program covers every mix. Rows with
+    temperature <= 0 are greedy."""
+    if jnp.ndim(rng) == 2:
+        return sample_token_per_request(logits, rng, temperature=temperature,
+                                        top_k=top_k, top_p=top_p)
     logits = logits.astype(jnp.float32)
     if greedy:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
@@ -245,7 +317,66 @@ def sample_token(logits, rng, *, temperature=1.0, top_k=0, greedy=False):
     if top_k and top_k > 0:
         kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
         logits = jnp.where(logits < kth, -1e30, logits)
+    if isinstance(top_p, (int, float)) and 0.0 < top_p < 1.0:
+        logits = _apply_top_p(logits, jnp.full((logits.shape[0],), top_p,
+                                               jnp.float32))
     return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
+
+
+def _apply_top_p(logits, top_p, sorted_desc=None):
+    """Nucleus filter: per row, keep the smallest prefix of descending-prob
+    tokens whose cumulative probability reaches ``top_p``; mask the rest.
+    ``top_p`` [b] traced; rows with top_p >= 1 pass through unchanged.
+    ``sorted_desc``: optionally pass ``sort(logits)`` descending to reuse a
+    sort the caller already paid for (the serving decode hot path)."""
+    if sorted_desc is None:
+        sorted_desc = jnp.sort(logits, axis=-1)[:, ::-1]
+    probs = jax.nn.softmax(sorted_desc, axis=-1)
+    # exclusive prefix sum: token j is kept while the mass BEFORE it is < p
+    # (so the token that crosses p is included — standard nucleus semantics)
+    prefix = jnp.cumsum(probs, axis=-1) - probs
+    keep = prefix < top_p[:, None]
+    # the top token is ALWAYS kept: top_p <= 0 would otherwise keep nothing,
+    # mask everything to -1e30, and sample uniformly over the whole vocab
+    keep = keep.at[:, 0].set(True)
+    cutoff = jnp.min(jnp.where(keep, sorted_desc, jnp.inf), axis=-1,
+                     keepdims=True)
+    filtered = jnp.where(logits < cutoff, -1e30, logits)
+    return jnp.where(top_p[:, None] >= 1.0, logits, filtered)
+
+
+def sample_token_per_request(logits, rngs, *, temperature, top_k, top_p):
+    """Per-request sampling for a slot pool: logits [b, vocab], rngs [b, 2]
+    (one PRNG key per row — co-batched requests NEVER share an rng stream),
+    temperature/top_k/top_p [b] traced arrays. Rows with temperature <= 0
+    take the exact argmax (same tie-breaking as the scalar greedy path).
+    Returns [b] int32. Everything is traced: requests with any knob mix
+    join/leave the batch without recompiling."""
+    logits = logits.astype(jnp.float32)
+    b, vocab = logits.shape
+    temperature = jnp.asarray(temperature, jnp.float32)
+    top_k = jnp.asarray(top_k, jnp.int32)
+    top_p = jnp.asarray(top_p, jnp.float32)
+
+    greedy_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    scaled = logits / jnp.maximum(temperature, 1e-6)[:, None]
+    # per-row top-k: threshold at the k-th largest (k <= 0 disables)
+    sorted_desc = jnp.sort(scaled, axis=-1)[:, ::-1]
+    k = jnp.clip(top_k, 0, vocab)
+    kth = jnp.take_along_axis(
+        sorted_desc, jnp.clip(k - 1, 0, vocab - 1)[:, None], axis=-1)
+    below_kth = lambda a: (k[:, None] > 0) & (a < kth)
+    scaled = jnp.where(below_kth(scaled), -1e30, scaled)
+    # masking the same tail in the already-sorted array keeps it sorted —
+    # one O(b * V log V) sort per decode step, not two
+    sorted_masked = jnp.where(below_kth(sorted_desc), -1e30, sorted_desc)
+    scaled = _apply_top_p(scaled, top_p, sorted_desc=sorted_masked)
+
+    sampled = jax.vmap(
+        lambda key, row: jax.random.categorical(key, row))(rngs, scaled)
+    return jnp.where(temperature <= 0.0, greedy_tok,
+                     sampled.astype(jnp.int32))
 
 
 def prefill_and_first_token(model, params, ids, rng, temperature, *, max_len,

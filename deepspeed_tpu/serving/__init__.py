@@ -1,0 +1,36 @@
+"""Continuous-batching serving subsystem.
+
+Slot-pool scheduler (one jitted decode program, requests join/leave
+mid-flight), bounded-queue admission control, streaming token events, and
+Serving/* metrics — the request-level layer that turns the single-call
+``InferenceEngine`` roofline into sustained multi-tenant throughput.
+"""
+
+from .clock import VirtualClock, WallClock
+from .engine import ServingEngine
+from .metrics import ServingMetrics, percentile
+from .queue import RequestQueue
+from .request import (FINISH_EOS, FINISH_LENGTH, REJECT_PROMPT_TOO_LONG,
+                      REJECT_QUEUE_FULL, Request, RequestState,
+                      SamplingParams, TokenEvent, as_request)
+from .scheduler import ServingScheduler, simulate_static_batching
+
+__all__ = [
+    "ServingEngine",
+    "ServingScheduler",
+    "ServingMetrics",
+    "RequestQueue",
+    "Request",
+    "RequestState",
+    "SamplingParams",
+    "TokenEvent",
+    "VirtualClock",
+    "WallClock",
+    "as_request",
+    "percentile",
+    "simulate_static_batching",
+    "FINISH_EOS",
+    "FINISH_LENGTH",
+    "REJECT_QUEUE_FULL",
+    "REJECT_PROMPT_TOO_LONG",
+]

@@ -1,0 +1,449 @@
+"""Continuous-batching serving engine (the tentpole of the serving layer).
+
+Orca-style iteration-level scheduling, TPU-native by construction: ONE jitted
+decode program runs over a **fixed pool of batch slots** (static shapes,
+compiled exactly once per (model, slot-pool) configuration). Each slot holds
+one request's KV rows, cursor, last token, rng key and sampling knobs — all
+as per-slot device arrays, so a finished request frees its slot mid-flight
+and a queued one is prefilled (the existing bucketed ``prefill_flash`` path)
+and spliced into the RUNNING decode batch with ``dynamic_update_slice``
+(``models/decoding.py:insert_slot_kv``). No recompilation, no waiting for the
+whole batch to drain — the serving-side half of DeepSpeed-Inference's
+latency/throughput story (arXiv:2207.00032) on top of the kernel path.
+
+Greedy streams are bitwise-identical to sequential ``generate()`` calls: the
+per-slot decode runs the same ``forward_with_cache`` math at the same
+positions over the same KV window (pinned in tier-1
+``tests/unit/test_serving.py``).
+"""
+
+from collections import OrderedDict
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..config.base import ConfigError
+from ..inference.engine import lru_compiled
+from ..models.decoding import (forward_with_cache, init_cache, insert_slot_kv,
+                               reset_slot_kv, sample_token)
+from ..utils.logging import log_dist
+from .clock import VirtualClock, WallClock
+from .metrics import ServingMetrics
+from .queue import RequestQueue
+from .request import (FINISH_EOS, FINISH_LENGTH, FINISH_STOP, Request,
+                      RequestState, TokenEvent, as_request)
+from .scheduler import ServingScheduler
+
+
+class ServingEngine:
+    """Slot-pool continuous batching over an ``InferenceEngine``'s weights."""
+
+    def __init__(self, engine, serving_config=None, clock=None, monitor=None):
+        if not hasattr(engine.module, "config"):
+            raise ConfigError(
+                "serving needs a zoo-style model (config with kv cache "
+                "geometry); an injection-policy-served unknown model "
+                "supports forward() scoring only")
+        self.engine = engine
+        self.cfg = serving_config if serving_config is not None \
+            else engine.config.serving
+        self.n_slots = int(self.cfg.n_slots)
+        self.max_len = int(self.cfg.max_len) or int(engine.config.max_tokens)
+        if self.max_len > engine.config.max_tokens:
+            raise ConfigError(
+                f"serving.max_len {self.max_len} exceeds inference "
+                f"max_tokens {engine.config.max_tokens}")
+        self.clock = clock if clock is not None else (
+            VirtualClock() if self.cfg.virtual_clock else WallClock())
+        self.queue = RequestQueue(self.cfg.max_queue_depth)
+        self.scheduler = ServingScheduler(
+            self.queue, self.n_slots,
+            max_prefills_per_step=self.cfg.max_prefills_per_step,
+            policy=self.cfg.policy)
+        if monitor is None:
+            mc = engine.config
+            if (mc.tensorboard.enabled or mc.wandb.enabled
+                    or mc.csv_monitor.enabled):
+                from ..monitor.monitor import MonitorMaster
+
+                monitor = MonitorMaster(mc)
+        self.metrics = ServingMetrics(self.n_slots, self.clock,
+                                      monitor=monitor,
+                                      interval=self.cfg.monitor_interval)
+
+        self._slots = {}              # slot index -> running Request
+        self._free_slots = list(range(self.n_slots - 1, -1, -1))  # pop() -> 0 first
+        self._next_id = 0
+        self._prefill_programs = OrderedDict()   # padded_len -> jitted prefill
+        self._decode_jit = None
+        self._insert_jit = None
+        self._release_jit = None
+        self._sample_first_jit = None
+        # ONE sharding for the pool state, pinned as out_shardings on every
+        # pool program: kv heads over the model axis (TP), everything else
+        # replicated. Without the pin, insert and decode outputs would carry
+        # different inferred shardings and each insert<->decode alternation
+        # would recompile — the exact thing the slot pool exists to avoid.
+        mesh = engine.mesh
+        from ..parallel import MODEL_AXIS
+
+        kvh = engine.module.config.kv_heads
+        kv_axis = MODEL_AXIS if kvh % max(engine.mp_world_size, 1) == 0 \
+            else None
+        self._cache_sharding = NamedSharding(
+            mesh, P(None, None, None, kv_axis, None))
+        self._rep_sharding = NamedSharding(mesh, P())
+        self._state_shardings = {
+            name: self._cache_sharding if name in ("k", "v")
+            else self._rep_sharding
+            for name in ("k", "v", "pos", "tok", "active", "remaining",
+                         "rng", "temp", "top_k", "top_p", "eos")}
+        self._state = self._init_state()
+        log_dist(
+            f"ServingEngine: {self.n_slots} slots x {self.max_len} KV window, "
+            f"queue depth {self.cfg.max_queue_depth}, "
+            f"clock={'virtual' if isinstance(self.clock, VirtualClock) else 'wall'}",
+            ranks=[0])
+
+    # ------------------------------------------------------------------ state
+    def _init_state(self):
+        cfg = self.engine.module.config
+        cache = init_cache(cfg, self.n_slots, self.max_len, self.engine.dtype)
+        s = self.n_slots
+        state = {
+            "k": cache["k"], "v": cache["v"],
+            "pos": jnp.zeros((s,), jnp.int32),        # next KV write cursor
+            "tok": jnp.zeros((s,), jnp.int32),        # last sampled token
+            "active": jnp.zeros((s,), jnp.bool_),
+            "remaining": jnp.zeros((s,), jnp.int32),  # decode steps left
+            "rng": jnp.zeros((s, 2), jnp.uint32),     # per-slot PRNG keys
+            "temp": jnp.zeros((s,), jnp.float32),
+            "top_k": jnp.zeros((s,), jnp.int32),
+            "top_p": jnp.ones((s,), jnp.float32),
+            "eos": jnp.full((s,), -1, jnp.int32),     # -1 = no eos
+        }
+        return {name: jax.device_put(a, self._state_shardings[name])
+                for name, a in state.items()}
+
+    # -------------------------------------------------------------- programs
+    def _prefill_program(self, padded_len):
+        """One compiled prefill per prompt bucket (same LRU bound as the
+        engine's generate cache)."""
+        model, max_len, dtype = self.engine.module, self.max_len, self.engine.dtype
+
+        def build():
+            def prefill(params, ids, true_len):
+                c = init_cache(model.config, 1, max_len, dtype)
+                logits, c = forward_with_cache(model, params, ids, c, 0,
+                                               max_len, prefill=True)
+                last = jax.lax.dynamic_slice_in_dim(
+                    logits, true_len - 1, 1, axis=1)[:, 0]
+                return last, c
+
+            with self.engine.mesh:
+                return jax.jit(prefill, out_shardings=(
+                    self._rep_sharding,
+                    {"k": self._cache_sharding, "v": self._cache_sharding}))
+
+        return lru_compiled(self._prefill_programs, padded_len, build,
+                            int(self.engine.config.compile_cache_size or 0),
+                            "serving prefill")
+
+    def _build_pool_programs(self):
+        model, max_len = self.engine.module, self.max_len
+
+        def decode(params, state):
+            # one token for EVERY slot, each at its own cursor; inactive
+            # slots decode garbage into their own freed rows (overwritten
+            # whole-row by the next insert) and are masked below
+            split = jax.vmap(jax.random.split)(state["rng"])  # [S, 2, 2]
+            logits, cache = forward_with_cache(
+                model, params, state["tok"][:, None],
+                {"k": state["k"], "v": state["v"]}, state["pos"], max_len)
+            nxt = sample_token(logits[:, 0], split[:, 0],
+                               temperature=state["temp"],
+                               top_k=state["top_k"], top_p=state["top_p"])
+            active = state["active"]
+            nxt = jnp.where(active, nxt, state["tok"])
+            remaining = state["remaining"] - active.astype(jnp.int32)
+            hit_eos = (state["eos"] >= 0) & (nxt == state["eos"])
+            done_now = active & (hit_eos | (remaining <= 0))
+            new_state = {
+                "k": cache["k"], "v": cache["v"],
+                "pos": state["pos"] + active.astype(jnp.int32),
+                "tok": nxt,
+                "active": active & jnp.logical_not(done_now),
+                "remaining": remaining,
+                "rng": split[:, 1],
+                "temp": state["temp"], "top_k": state["top_k"],
+                "top_p": state["top_p"], "eos": state["eos"],
+            }
+            return (nxt, done_now), new_state
+
+        def insert(state, slot, k_slot, v_slot, tok, pos, remaining, rng,
+                   temp, top_k, top_p, eos):
+            # slot index is TRACED: one compiled insert covers every slot
+            kv = insert_slot_kv({"k": state["k"], "v": state["v"]},
+                                {"k": k_slot, "v": v_slot}, slot)
+            put = lambda a, v_: a.at[slot].set(v_)
+            return {
+                "k": kv["k"], "v": kv["v"],
+                "pos": put(state["pos"], pos),
+                "tok": put(state["tok"], tok),
+                "active": put(state["active"], True),
+                "remaining": put(state["remaining"], remaining),
+                "rng": state["rng"].at[slot].set(rng),
+                "temp": put(state["temp"], temp),
+                "top_k": put(state["top_k"], top_k),
+                "top_p": put(state["top_p"], top_p),
+                "eos": put(state["eos"], eos),
+            }
+
+        def release(state, slot):
+            # hygiene scrub (config.scrub_freed_slots): zero the freed KV
+            # rows; the causal mask + whole-row insert already guarantee no
+            # stale-KV leak without it
+            kv = reset_slot_kv({"k": state["k"], "v": state["v"]}, slot)
+            return dict(state, k=kv["k"], v=kv["v"],
+                        active=state["active"].at[slot].set(False))
+
+        def sample_first(logits, key, temp, top_k, top_p):
+            return sample_token(logits, key[None, :],
+                                temperature=jnp.reshape(temp, (1,)),
+                                top_k=jnp.reshape(top_k, (1,)),
+                                top_p=jnp.reshape(top_p, (1,)))
+
+        rep, st = self._rep_sharding, self._state_shardings
+        with self.engine.mesh:
+            self._decode_jit = jax.jit(decode, donate_argnums=(1,),
+                                       out_shardings=((rep, rep), st))
+            self._insert_jit = jax.jit(insert, donate_argnums=(0,),
+                                       out_shardings=st)
+            self._release_jit = jax.jit(release, donate_argnums=(0,),
+                                        out_shardings=st)
+            self._sample_first_jit = jax.jit(sample_first, out_shardings=rep)
+
+    def compile_counts(self):
+        """Compiled-program census, pinned by the tier-1 no-recompile test:
+        the decode step compiles exactly once per (model, slot-pool)
+        configuration no matter how requests join/leave mid-flight."""
+        size = lambda f: f._cache_size() if f is not None else 0
+        return {
+            "decode": size(self._decode_jit),
+            "insert": size(self._insert_jit),
+            "prefill_buckets": len(self._prefill_programs),
+        }
+
+    # ------------------------------------------------------------ submission
+    def submit(self, request, **kwargs):
+        """Admit a request into the bounded queue (or shed it with a reason).
+
+        ``request``: Request | dict | token array (kwargs become Request
+        fields for the array form). Returns the Request; check ``.state`` —
+        REJECTED means admission control shed it (``.reject_reason`` in
+        {queue_full, prompt_too_long, bad_request})."""
+        if kwargs and not isinstance(request, (Request, dict)):
+            req = Request(prompt=np.asarray(request), **kwargs)
+        else:
+            req = as_request(request)
+        if req.request_id is None:
+            req.request_id = self._next_id
+            self._next_id += 1
+        req.submit_time = self.clock.now()
+        if req.arrival_time is not None and not req.arrival_resolved:
+            # direct submit(): arrival_time is an offset from now (same
+            # contract as serve()); without this, ttft would subtract a raw
+            # offset from an absolute clock reading
+            req.arrival_time += req.submit_time
+            req.arrival_resolved = True
+        reason = self.queue.admit(req, self.max_len)
+        if reason is None:
+            self.metrics.record_submit()
+        else:
+            self.metrics.record_shed(reason)
+        return req
+
+    # ------------------------------------------------------------- the loop
+    def step(self):
+        """One scheduler iteration: admit queued requests into free slots
+        (prefill + splice), then run one decode step over the pool. Returns
+        the list of TokenEvents produced."""
+        events = []
+        admitted = self.scheduler.next_admissions(len(self._free_slots),
+                                                  self.clock.now())
+        for req in admitted:
+            self._start_request(req, events)
+        if self._slots:
+            self._decode_once(events)
+        elif not admitted and self.queue.depth:
+            # nothing running and the queue head hasn't arrived yet (direct
+            # submit with a future arrival offset): idle the clock forward to
+            # it, or a virtual-clock step() loop would spin forever
+            head = self.queue.peek()
+            if head.arrival_time is not None:
+                gap = head.arrival_time - self.clock.now()
+                if gap > 0:
+                    self.clock.sleep(gap)
+        self.metrics.observe_step(self.queue.depth, len(self._slots))
+        return events
+
+    def _request_key(self, req):
+        if req.sampling.seed is not None:
+            base = jax.random.PRNGKey(int(req.sampling.seed))
+        else:
+            base = jax.random.fold_in(self.engine._rng, req.request_id)
+        return jax.random.split(base)  # [2, 2]: (first-token key, slot chain)
+
+    def _start_request(self, req, events):
+        if self._decode_jit is None:
+            self._build_pool_programs()
+        # ceiling is the full slot window: pad rows past the cursor are
+        # causally masked and then overwritten one-by-one as decode advances
+        # (same scheme as generate()), so padding may overlap the generation
+        # region — one bucket serves every max_new_tokens
+        padded = self.engine._bucket_prompt_len(req.prompt_len, self.max_len)
+        ids = np.zeros((1, padded), np.int32)
+        ids[0, :req.prompt_len] = req.prompt
+        logits, cache = self._prefill_program(padded)(
+            self.engine.params, jnp.asarray(ids), np.int32(req.prompt_len))
+        self.clock.advance(padded * self.cfg.virtual_prefill_cost_per_token)
+
+        keys = self._request_key(req)
+        s = req.sampling
+        tok = self._sample_first_jit(logits, keys[0], np.float32(s.temperature),
+                                     np.int32(s.top_k), np.float32(s.top_p))
+        t = int(np.asarray(tok)[0])
+        now = self.clock.now()
+        req.state = RequestState.RUNNING
+        req.first_token_time = now
+        req.tokens.append(t)
+        self.metrics.record_tokens(1)
+        self.metrics.record_first_token(req)
+
+        eos = req.eos_token_id
+        if (eos is not None and t == eos) or t in req.stop_token_ids \
+                or req.max_new_tokens == 1:
+            if eos is not None and t == eos:
+                reason = FINISH_EOS
+            elif t in req.stop_token_ids:
+                reason = FINISH_STOP
+            else:
+                reason = FINISH_LENGTH
+            self._finish(req, reason, now)
+            events.append(TokenEvent(req.request_id, t, 0, True, reason, now))
+            return
+        slot = self._free_slots.pop()
+        self._slots[slot] = req
+        req.slot = slot
+        self._state = self._insert_jit(
+            self._state, np.int32(slot), cache["k"], cache["v"], tok[0],
+            np.int32(req.prompt_len), np.int32(req.max_new_tokens - 1),
+            keys[1], np.float32(s.temperature), np.int32(s.top_k),
+            np.float32(s.top_p), np.int32(-1 if eos is None else eos))
+        events.append(TokenEvent(req.request_id, t, 0, False, None, now))
+
+    def _decode_once(self, events):
+        (toks, done_now), self._state = self._decode_jit(self.engine.params,
+                                                         self._state)
+        self.clock.advance(self.cfg.virtual_decode_step_cost)
+        toks = np.asarray(toks)
+        done_now = np.asarray(done_now)
+        now = self.clock.now()
+        for slot in sorted(self._slots):
+            req = self._slots[slot]
+            t = int(toks[slot])
+            req.tokens.append(t)
+            self.metrics.record_tokens(1)
+            if bool(done_now[slot]):
+                reason = FINISH_EOS if (req.eos_token_id is not None
+                                        and t == req.eos_token_id) \
+                    else FINISH_LENGTH
+            elif t in req.stop_token_ids:
+                # stop sequences are host-side policy (a set, not the single
+                # device-tracked eos id): finish here and deactivate the slot
+                reason = FINISH_STOP
+            else:
+                events.append(TokenEvent(req.request_id, t,
+                                         len(req.tokens) - 1, False, None,
+                                         now))
+                continue
+            self._finish(req, reason, now, deactivate=(reason == FINISH_STOP))
+            events.append(TokenEvent(req.request_id, t, len(req.tokens) - 1,
+                                     True, reason, now))
+
+    def _finish(self, req, reason, now, deactivate=False):
+        """``deactivate``: the device doesn't know this slot finished (host-
+        side stop policy) — clear its active flag so decode stops advancing
+        it. EOS/length finishes already cleared it inside the decode step."""
+        req.state = RequestState.FINISHED
+        req.finish_reason = reason
+        req.finish_time = now
+        if req.slot is not None:
+            del self._slots[req.slot]
+            self._free_slots.append(req.slot)
+            if deactivate or self.cfg.scrub_freed_slots:
+                self._state = self._release_jit(self._state,
+                                                np.int32(req.slot))
+            req.slot = None
+        self.metrics.record_finish(req)
+
+    # ------------------------------------------------------------- frontends
+    def serve(self, requests=None, yield_rejections=True):
+        """Streaming frontend: feed ``requests`` (each optionally carrying an
+        ``arrival_time``) through the continuous-batching loop, yielding
+        ``TokenEvent``s as they are produced. Runs until every accepted
+        request finishes; shed requests surface as a single done event with
+        ``finish_reason="rejected:<reason>"`` (and ``token == -1``)."""
+        pending = sorted((as_request(r) for r in (requests or [])),
+                         key=lambda r: r.arrival_time or 0.0)
+        t0 = self.clock.now()
+        for r in pending:
+            # arrival offsets -> absolute clock times (TTFT counts queueing)
+            if not r.arrival_resolved:
+                r.arrival_time = t0 + (r.arrival_time or 0.0)
+                r.arrival_resolved = True
+            elif r.arrival_time is None:
+                r.arrival_time = t0
+        while pending or self.queue.depth or self._slots:
+            now = self.clock.now()
+            while pending and pending[0].arrival_time <= now:
+                req = self.submit(pending.pop(0))
+                if req.state is RequestState.REJECTED and yield_rejections:
+                    yield TokenEvent(req.request_id, -1, -1, True,
+                                     f"rejected:{req.reject_reason}", now)
+            if not self._slots and not self.queue.depth:
+                if not pending:
+                    break
+                # idle until the next arrival
+                self.clock.sleep(max(pending[0].arrival_time - now, 1e-4))
+                continue
+            for ev in self.step():
+                yield ev
+
+    def run(self, requests):
+        """Non-streaming convenience: serve ``requests`` to completion and
+        return ``(finished, rejected, metrics_snapshot)``."""
+        reqs = [as_request(r) for r in (requests or [])]
+        for _ in self.serve(reqs, yield_rejections=False):
+            pass
+        finished = [r for r in reqs if r.state is RequestState.FINISHED]
+        rejected = [r for r in reqs if r.state is RequestState.REJECTED]
+        return finished, rejected, self.metrics.snapshot()
+
+    def destroy(self):
+        """Drop the slot pool and compiled programs (cf. InferenceEngine
+        .destroy): the jitted closures capture self, which would otherwise
+        pin the KV pool in HBM."""
+        self._state = None
+        self._decode_jit = None
+        self._insert_jit = None
+        self._release_jit = None
+        self._sample_first_jit = None
+        self._prefill_programs = OrderedDict()
+        self._slots = {}
+        self._free_slots = list(range(self.n_slots - 1, -1, -1))
+        import gc
+
+        gc.collect()

@@ -1,0 +1,144 @@
+"""Serving metrics: TTFT/TPOT histograms, throughput, occupancy, shed rate.
+
+Mirrors the training engine's ``Comm/*_gb`` monitor pattern: the serving loop
+records samples host-side and periodically writes ``Serving/*`` scalar events
+through the existing ``monitor/`` fan-out (TensorBoard/W&B/CSV), gated on the
+same monitor config sections. ``snapshot()`` is the machine-readable rollup
+the load bench commits as its throughput–latency artifact.
+"""
+
+import collections
+
+
+def percentile(samples, q):
+    """Nearest-rank percentile (q in [0, 100]); None on no samples."""
+    if not samples:
+        return None
+    s = sorted(samples)
+    idx = min(len(s) - 1, max(0, int(round(q / 100.0 * (len(s) - 1)))))
+    return s[idx]
+
+
+class ServingMetrics:
+    def __init__(self, n_slots, clock, monitor=None, interval=32):
+        self.n_slots = n_slots
+        self.clock = clock
+        self.monitor = monitor
+        self.interval = int(interval)
+        self.start_time = clock.now()
+        self._started = False       # start_time re-pins at first activity
+        self._window_tokens = 0     # tokens since the last reset_window()
+        self.total_tokens = 0
+        self.submitted = 0
+        self.finished = 0
+        self.shed = collections.Counter()
+        self.ttft_samples = []     # seconds (or virtual units)
+        self.tpot_samples = []
+        self.steps = 0
+        self._queue_depth = 0
+        self._active_slots = 0
+
+    # -- recording ----------------------------------------------------------
+    def _mark_started(self):
+        # the throughput window opens at the FIRST request, not at engine
+        # construction — a server idle for an hour must not dilute tokens/s
+        if not self._started:
+            self.start_time = self.clock.now()
+            self._started = True
+
+    def reset_window(self):
+        """Re-open the throughput window (e.g. after a warmup run): tokens/s
+        reflects tokens since this call. Cumulative counters are kept."""
+        self.start_time = self.clock.now()
+        self._started = True
+        self._window_tokens = 0
+
+    def record_submit(self):
+        self._mark_started()
+        self.submitted += 1
+
+    def record_shed(self, reason):
+        self._mark_started()
+        self.shed[reason] += 1
+
+    def record_tokens(self, n):
+        self.total_tokens += int(n)
+        self._window_tokens += int(n)
+
+    def record_first_token(self, request):
+        if request.ttft is not None:
+            self.ttft_samples.append(request.ttft)
+
+    def record_finish(self, request):
+        self.finished += 1
+        if request.tpot is not None:
+            self.tpot_samples.append(request.tpot)
+
+    def observe_step(self, queue_depth, active_slots):
+        """Once per scheduler step; periodically flushes monitor events."""
+        self.steps += 1
+        self._queue_depth = queue_depth
+        self._active_slots = active_slots
+        if self.monitor is not None and getattr(self.monitor, "enabled", False) \
+                and self.interval > 0 and self.steps % self.interval == 0:
+            self.emit_events()
+
+    # -- rollups ------------------------------------------------------------
+    @property
+    def elapsed(self):
+        return max(self.clock.now() - self.start_time, 1e-9)
+
+    @property
+    def tokens_per_s(self):
+        return self._window_tokens / self.elapsed
+
+    @property
+    def shed_total(self):
+        return sum(self.shed.values())
+
+    @property
+    def shed_rate(self):
+        total = self.submitted + self.shed_total
+        return self.shed_total / total if total else 0.0
+
+    def snapshot(self):
+        to_ms = lambda v: None if v is None else v * 1e3
+        return {
+            "submitted": self.submitted,
+            "finished": self.finished,
+            "shed": dict(self.shed),
+            "shed_rate": round(self.shed_rate, 4),
+            "total_tokens": self.total_tokens,
+            "tokens_per_s": round(self.tokens_per_s, 2),
+            "ttft_ms": {
+                "p50": to_ms(percentile(self.ttft_samples, 50)),
+                "p99": to_ms(percentile(self.ttft_samples, 99)),
+            },
+            "tpot_ms": {
+                "p50": to_ms(percentile(self.tpot_samples, 50)),
+                "p99": to_ms(percentile(self.tpot_samples, 99)),
+            },
+            "steps": self.steps,
+            "queue_depth": self._queue_depth,
+            "slot_occupancy": self._active_slots / max(self.n_slots, 1),
+        }
+
+    def emit_events(self):
+        """Write Serving/* scalars through the monitor fan-out (rank 0 only,
+        same as Train/* and Comm/*)."""
+        if self.monitor is None:
+            return
+        events = [
+            ("Serving/queue_depth", float(self._queue_depth), self.steps),
+            ("Serving/slot_occupancy",
+             self._active_slots / max(self.n_slots, 1), self.steps),
+            ("Serving/tokens_per_s", self.tokens_per_s, self.steps),
+            ("Serving/shed_total", float(self.shed_total), self.steps),
+        ]
+        p50 = percentile(self.ttft_samples, 50)
+        if p50 is not None:
+            events.append(("Serving/ttft_ms", p50 * 1e3, self.steps))
+        p50t = percentile(self.tpot_samples, 50)
+        if p50t is not None:
+            events.append(("Serving/tpot_ms", p50t * 1e3, self.steps))
+        self.monitor.write_events(events)

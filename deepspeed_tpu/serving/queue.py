@@ -1,0 +1,54 @@
+"""Bounded FCFS request queue with load-aware admission control.
+
+The queue is the backpressure point: depth is bounded, and a request that
+would exceed the bound (or could never fit a slot's KV window) is shed at
+submit time with a machine-readable reason — the serving layer degrades by
+rejecting work, never by growing host/device memory until it falls over.
+"""
+
+import collections
+
+from .request import (REJECT_BAD_REQUEST, REJECT_PROMPT_TOO_LONG,
+                      REJECT_QUEUE_FULL, RequestState)
+
+
+class RequestQueue:
+    def __init__(self, max_depth):
+        self.max_depth = int(max_depth)
+        self._q = collections.deque()
+        self.shed_counts = collections.Counter()
+
+    def __len__(self):
+        return len(self._q)
+
+    @property
+    def depth(self):
+        return len(self._q)
+
+    def admit(self, request, max_total_len):
+        """Admission control: accept ``request`` into the queue or shed it.
+
+        Returns None on admission; on shed, marks the request REJECTED and
+        returns the reason string. ``max_total_len`` is the per-slot KV
+        window that prompt + generation must fit."""
+        reason = None
+        if request.prompt_len < 1 or request.max_new_tokens < 1:
+            reason = REJECT_BAD_REQUEST
+        elif request.prompt_len + request.max_new_tokens > max_total_len:
+            reason = REJECT_PROMPT_TOO_LONG
+        elif len(self._q) >= self.max_depth:
+            reason = REJECT_QUEUE_FULL
+        if reason is not None:
+            request.state = RequestState.REJECTED
+            request.reject_reason = reason
+            self.shed_counts[reason] += 1
+            return reason
+        request.state = RequestState.QUEUED
+        self._q.append(request)
+        return None
+
+    def pop(self):
+        return self._q.popleft()
+
+    def peek(self):
+        return self._q[0] if self._q else None

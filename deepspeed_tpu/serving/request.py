@@ -1,0 +1,122 @@
+"""Request & event types for the continuous-batching serving layer.
+
+A ``Request`` is the unit the scheduler moves through QUEUED -> RUNNING ->
+FINISHED (or straight to REJECTED at admission); ``TokenEvent`` is the unit
+the streaming API yields — one per generated token per request, tagged with
+``done`` + ``finish_reason`` on the last one.
+"""
+
+import dataclasses
+import enum
+import typing
+
+import numpy as np
+
+
+class RequestState(enum.Enum):
+    QUEUED = "queued"
+    RUNNING = "running"
+    FINISHED = "finished"
+    REJECTED = "rejected"
+
+
+# admission-control shed reasons (reject-with-reason instead of OOM)
+REJECT_QUEUE_FULL = "queue_full"
+REJECT_PROMPT_TOO_LONG = "prompt_too_long"
+REJECT_BAD_REQUEST = "bad_request"
+
+FINISH_EOS = "eos"
+FINISH_LENGTH = "length"
+FINISH_STOP = "stop"
+
+
+@dataclasses.dataclass
+class SamplingParams:
+    """Per-request sampling knobs, threaded through ``sample_token`` as traced
+    per-slot arrays — co-batched requests never share an rng stream or a
+    temperature. ``temperature <= 0`` means greedy."""
+
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 1.0
+    seed: typing.Optional[int] = None
+
+
+@dataclasses.dataclass
+class Request:
+    prompt: np.ndarray                      # [prompt_len] int32
+    max_new_tokens: int = 32
+    sampling: SamplingParams = None
+    eos_token_id: typing.Optional[int] = None
+    stop_token_ids: typing.Tuple[int, ...] = ()
+    request_id: typing.Optional[int] = None  # assigned at submit if None
+    # open-loop offered-load arrival, as an OFFSET from serve()/submit() time
+    # (resolved against the clock at intake); None = already arrived
+    arrival_time: typing.Optional[float] = None
+    # set once arrival_time has been converted to an absolute clock value —
+    # submit() must not re-shift a request serve() already resolved
+    arrival_resolved: bool = False
+
+    # -- scheduler-owned runtime fields -------------------------------------
+    state: RequestState = RequestState.QUEUED
+    reject_reason: typing.Optional[str] = None
+    finish_reason: typing.Optional[str] = None
+    tokens: list = dataclasses.field(default_factory=list)
+    slot: typing.Optional[int] = None
+    submit_time: typing.Optional[float] = None
+    first_token_time: typing.Optional[float] = None
+    finish_time: typing.Optional[float] = None
+
+    def __post_init__(self):
+        self.prompt = np.asarray(self.prompt, np.int32).reshape(-1)
+        if self.sampling is None:
+            self.sampling = SamplingParams()
+        elif isinstance(self.sampling, dict):
+            self.sampling = SamplingParams(**self.sampling)
+
+    @property
+    def prompt_len(self):
+        return int(self.prompt.shape[0])
+
+    @property
+    def ttft(self):
+        """Time from arrival (resolved by serve()) or submit to first token —
+        queueing delay counts, as a serving frontend's user would see it."""
+        if self.first_token_time is None:
+            return None
+        start = self.arrival_time if self.arrival_time is not None \
+            else self.submit_time
+        return self.first_token_time - start
+
+    @property
+    def tpot(self):
+        """Mean time per output token after the first."""
+        if self.finish_time is None or self.first_token_time is None \
+                or len(self.tokens) < 2:
+            return None
+        return (self.finish_time - self.first_token_time) / (len(self.tokens) - 1)
+
+
+@dataclasses.dataclass
+class TokenEvent:
+    """One streamed token: ``index`` is the 0-based position in the request's
+    generated stream; the final event carries ``done=True`` + a reason."""
+
+    request_id: int
+    token: int
+    index: int
+    done: bool = False
+    finish_reason: typing.Optional[str] = None
+    time: float = 0.0
+
+
+def as_request(obj, default_max_new_tokens=32):
+    """Coerce a user-supplied request (Request | dict | array prompt)."""
+    if isinstance(obj, Request):
+        return obj
+    if isinstance(obj, dict):
+        d = dict(obj)
+        d.setdefault("max_new_tokens", default_max_new_tokens)
+        return Request(**d)
+    return Request(prompt=np.asarray(obj),
+                   max_new_tokens=default_max_new_tokens)

@@ -460,6 +460,84 @@ def test_prefill_flash_matches_dense(kw):
                                    atol=1e-6)
 
 
+def test_prefill_cache_lru_bound_and_eviction_warning():
+    """The compiled-program cache is LRU-bounded: an adversarial prompt-length
+    mix (bucketing disabled) cannot grow compiled programs without bound, and
+    each eviction logs one warning line."""
+    import logging
+
+    from deepspeed_tpu.utils.logging import logger as ds_logger
+
+    cfg = cfg_variant()
+    model = CausalLM(cfg)
+    eng = deepspeed_tpu.init_inference(
+        model, dtype="float32", max_tokens=64, prompt_bucket_size=1,
+        compile_cache_size=2)
+    r = np.random.RandomState(11)
+    records = []
+    handler = logging.Handler()
+    handler.emit = lambda rec: records.append(rec)
+    ds_logger.addHandler(handler)
+    try:
+        for n in (4, 5, 6, 7):  # bucket size 1: every length is its own key
+            eng.generate(r.randint(0, 64, (1, n)).astype(np.int32),
+                         max_new_tokens=2, greedy=True)
+    finally:
+        ds_logger.removeHandler(handler)
+    assert len(eng._prefill_cache) == 2
+    evictions = [rec for rec in records
+                 if rec.levelno == logging.WARNING
+                 and "compile cache over cap" in rec.getMessage()]
+    assert len(evictions) == 2
+    # LRU order: the two newest keys survive
+    kept_lens = {k[1] for k in eng._prefill_cache}
+    assert kept_lens == {6, 7}
+
+
+def test_pow2_prompt_bucket_policy():
+    """Default pow2 policy: buckets are prompt_bucket_size doublings, so the
+    distinct-bucket count is logarithmic in max_tokens; 'multiple' keeps the
+    old every-multiple behavior."""
+    cfg = cfg_variant()
+    eng = deepspeed_tpu.init_inference(
+        CausalLM(cfg), dtype="float32", max_tokens=256,
+        prompt_bucket_size=16)
+    assert eng.config.prompt_bucket_policy == "pow2"
+    assert eng._bucket_prompt_len(5, 256) == 16
+    assert eng._bucket_prompt_len(20, 256) == 32
+    assert eng._bucket_prompt_len(40, 256) == 64
+    assert eng._bucket_prompt_len(130, 256) == 256
+    assert eng._bucket_prompt_len(100, 70) == 100  # clipped, then >= prompt
+
+    multiple = deepspeed_tpu.init_inference(
+        CausalLM(cfg), dtype="float32", max_tokens=256,
+        prompt_bucket_size=16, prompt_bucket_policy="multiple")
+    assert multiple._bucket_prompt_len(40, 256) == 48
+
+
+def test_generate_rng_folds_request_id():
+    """Two sampled calls with identical args draw DIFFERENT streams (the
+    engine folds a per-request id into its rng — co-scheduled identical
+    requests must not clone each other); an explicit rng reproduces."""
+    cfg = cfg_variant()
+    model = CausalLM(cfg)
+    eng = deepspeed_tpu.init_inference(model, dtype="float32", max_tokens=64)
+    r = np.random.RandomState(12)
+    prompt = r.randint(0, 64, (2, 6)).astype(np.int32)
+    a = np.asarray(eng.generate(prompt, max_new_tokens=8, greedy=False,
+                                temperature=1.0))
+    b = np.asarray(eng.generate(prompt, max_new_tokens=8, greedy=False,
+                                temperature=1.0))
+    assert not np.array_equal(a, b)
+
+    key = jax.random.PRNGKey(42)
+    c = np.asarray(eng.generate(prompt, max_new_tokens=8, greedy=False,
+                                temperature=1.0, rng=key))
+    d = np.asarray(eng.generate(prompt, max_new_tokens=8, greedy=False,
+                                temperature=1.0, rng=key))
+    np.testing.assert_array_equal(c, d)
+
+
 def test_warmup_precompiles_buckets():
     """engine.warmup compiles one program set per prompt bucket; live
     requests with the same sampling shape then reuse them (no new keys)."""

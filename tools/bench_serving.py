@@ -1,4 +1,6 @@
-"""Serving benchmark: p50 TTFT (prefill) + steady-state decode throughput.
+"""Serving benchmark: p50 TTFT (prefill) + steady-state decode throughput,
+plus an open-loop offered-load mode (``--qps``) for the continuous-batching
+serving subsystem.
 
 Matches the BASELINE.json serving metric ("init_inference p50 TTFT"; reference
 flow ``inference/engine.py:560`` — model load, kernel inject, generate). Loads a
@@ -14,6 +16,17 @@ registry model via ``deepspeed_tpu.init_inference`` and measures, per
 Usage (single chip):
     python tools/bench_serving.py --family gpt2 --sizes small,medium \
         --prompts 128,512,1000 --modes bf16,int8,int4 --new-tokens 64
+
+Open-loop offered load (continuous batching; ``serving/engine.py``):
+    python tools/bench_serving.py --qps 20 --num-requests 64 --family gpt2 \
+        --sizes tiny --slots 4 --queue-depth 8 --output serving_load.json
+
+``--qps`` drives seeded Poisson arrivals at the given rate through the
+slot-pool scheduler and emits ONE throughput–latency JSON artifact: p50/p99
+TTFT (queueing included), TPOT, aggregate tokens/s, and the shed rate —
+under overload, admission control rejects with a reason instead of OOMing,
+and the artifact records how much was shed. Tier-1 smokes this mode on the
+tiny preset under JAX_PLATFORMS=cpu.
 
 Emits one JSON line per row (machine-readable) then a summary table.
 BENCH_FORCE_CPU=1 runs the same pipeline on the host CPU (smoke/debug only;
@@ -137,6 +150,79 @@ def project_bloom_7b1(measured_hbm_util, peak_bw_gbs, prompt=512,
     }), flush=True)
 
 
+def run_open_loop(args):
+    """Open-loop offered-load bench: seeded Poisson arrivals at ``--qps``
+    through the continuous-batching serving engine; writes a throughput–
+    latency JSON artifact (p50/p99 TTFT, TPOT, tokens/s, shed rate)."""
+    import jax
+
+    from deepspeed_tpu.serving import Request, percentile
+
+    size = args.sizes.split(",")[0]
+    mode = args.modes.split(",")[0]
+    prompts = [int(p) for p in args.prompts.split(",")]
+    max_tokens = ((max(prompts) + args.new_tokens + 63) // 64) * 64
+    engine, n_params, _ = build_engine(args.family, size, mode, max_tokens)
+    engine._config.serving = engine._config.serving.replace(
+        n_slots=args.slots, max_queue_depth=args.queue_depth)
+
+    rng = np.random.RandomState(args.seed)
+    arrivals = np.cumsum(rng.exponential(1.0 / args.qps, args.num_requests))
+    vocab = engine.module.config.vocab_size
+    requests = []
+    for i in range(args.num_requests):
+        plen = int(rng.choice(prompts))
+        new = int(rng.randint(max(args.new_tokens // 2, 1),
+                              args.new_tokens + 1))
+        requests.append(Request(
+            prompt=rng.randint(0, vocab, (plen,)).astype(np.int32),
+            max_new_tokens=new, arrival_time=float(arrivals[i])))
+
+    # compile outside the measured window (the reference's capture-at-init):
+    # one prefill per prompt bucket + the decode/insert pool programs
+    engine.serving.run([Request(
+        prompt=rng.randint(0, vocab, (p,)).astype(np.int32),
+        max_new_tokens=2) for p in prompts])
+    engine.serving.metrics.reset_window()  # warmup out of the tokens/s window
+
+    t0 = time.perf_counter()
+    finished, rejected, _ = engine.serving.run(requests)
+    wall_s = time.perf_counter() - t0
+
+    ttfts = [r.ttft for r in finished if r.ttft is not None]
+    tpots = [r.tpot for r in finished if r.tpot is not None]
+    pct = lambda s, q: None if not s else round(percentile(s, q) * 1e3, 2)
+    total_tokens = sum(len(r.tokens) for r in finished)
+    artifact = {
+        "bench": "serving_open_loop",
+        "model": f"{args.family}-{size}", "mode": mode,
+        "platform": jax.devices()[0].platform,
+        "qps": args.qps, "num_requests": args.num_requests,
+        "slots": args.slots, "queue_depth": args.queue_depth,
+        "prompt_lens": prompts, "max_new_tokens": args.new_tokens,
+        "seed": args.seed,
+        "completed": len(finished), "shed": len(rejected),
+        "shed_rate": round(len(rejected) / max(args.num_requests, 1), 4),
+        "shed_reasons": {r.reject_reason: sum(
+            1 for x in rejected if x.reject_reason == r.reject_reason)
+            for r in rejected},
+        "total_tokens": total_tokens,
+        "tokens_per_s": round(total_tokens / wall_s, 2) if wall_s else None,
+        "wall_s": round(wall_s, 3),
+        "ttft_ms": {"p50": pct(ttfts, 50), "p99": pct(ttfts, 99)},
+        "tpot_ms": {"p50": pct(tpots, 50), "p99": pct(tpots, 99)},
+        "compile_counts": engine.serving.compile_counts(),
+        "n_params_m": round(n_params / 1e6, 1),
+    }
+    print(json.dumps(artifact), flush=True)
+    if args.output:
+        with open(args.output, "w") as f:
+            json.dump(artifact, f, indent=1)
+        print(f"artifact written to {args.output}", flush=True)
+    engine.destroy()
+    return 0
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--family", default="gpt2")
@@ -146,12 +232,24 @@ def main():
     ap.add_argument("--new-tokens", type=int, default=64)
     ap.add_argument("--batch", type=int, default=1)
     ap.add_argument("--repeats", type=int, default=10)
+    ap.add_argument("--qps", type=float, default=None,
+                    help="open-loop offered-load mode: Poisson arrival rate "
+                         "through the continuous-batching serving engine")
+    ap.add_argument("--num-requests", type=int, default=64)
+    ap.add_argument("--slots", type=int, default=4)
+    ap.add_argument("--queue-depth", type=int, default=8)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--output", default=None,
+                    help="write the open-loop JSON artifact here")
     args = ap.parse_args()
 
     from _common import maybe_force_cpu, peak_hbm_gbs
 
     maybe_force_cpu()
     import jax
+
+    if args.qps is not None:
+        return run_open_loop(args)
 
     platform = jax.devices()[0].platform
     peak_bw = peak_hbm_gbs(jax.devices()[0].device_kind)
