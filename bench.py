@@ -58,6 +58,29 @@ def _error_record(msg):
     }
 
 
+def _stamp(record, config=None):
+    """Provenance stamp (git SHA, config hash, backend) so a proxy run can
+    never be confused with an on-chip number (BENCH_r03–r05 lesson). Uses
+    ``tools/_common.run_stamp``; a best-effort fallback keeps this file's
+    driver contract standalone if tools/ is ever absent."""
+    try:
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "tools"))
+        from _common import stamp_record
+
+        return stamp_record(record, config)
+    except Exception:
+        try:
+            sha = subprocess.run(
+                ["git", "rev-parse", "--short=12", "HEAD"],
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+                capture_output=True, text=True, timeout=10).stdout.strip()
+        except (OSError, subprocess.SubprocessError):
+            sha = "unknown"
+        record["provenance"] = {"git_sha": sha or "unknown"}
+        return record
+
+
 def _run_subprocess(args, timeout_s, env=None):
     """Run argv in its own session; on timeout terminate the process group.
 
@@ -348,6 +371,7 @@ def run_benchmark():
     }
     if forced_cpu:
         result["forced_cpu"] = True
+    _stamp(result, config=dict(config, batch=batch_size, seq=seq_len))
     print(json.dumps(result))
     return 0
 
@@ -417,6 +441,7 @@ def run_cpu_proxy():
             "platform": jax.devices()[0].platform,
         },
     }
+    _stamp(result, config=dict(config, seq=seq_len))
     print(json.dumps(result))
     return 0
 

@@ -335,6 +335,31 @@ class ServingConfig(ConfigModel):
             raise ConfigError("serving.max_prefills_per_step must be >= 1")
 
 
+class TelemetryConfig(ConfigModel):
+    """Span-based step tracing (``telemetry/tracer.py``): nested host spans
+    over the engine's step phases (data/fwd/bwd/step/checkpoint), serving
+    request lifecycles, and checkpoint save/resume, emitted as Chrome-trace
+    JSON (Perfetto-loadable) + structured JSONL under
+    ``<output_path>/<job_name>/``. ``device_sync`` fences span ends (and the
+    wall-clock timers) with ``block_until_ready`` so timings measure device
+    execution rather than dispatch."""
+
+    enabled: bool = False
+    output_path: str = ""  # trace dir root; "" -> ./traces
+    job_name: str = "DeepSpeedJobName"
+    # fence sync=True spans + the fwd/bwd/step timers on the device
+    device_sync: bool = False
+    chrome_trace: bool = True  # write trace.json (chrome://tracing/Perfetto)
+    jsonl: bool = True         # write spans.jsonl (tools/trace_summary.py)
+    # in-memory event cap; past it new events are dropped (and counted)
+    max_events: int = 100_000
+
+    def _validate(self):
+        if self.max_events < 1:
+            raise ConfigError(
+                f"telemetry.max_events must be >= 1, got {self.max_events}")
+
+
 class FlopsProfilerConfig(ConfigModel):
     """Reference: ``profiling/config.py``."""
 
@@ -406,6 +431,7 @@ class DeepSpeedConfig(ConfigModel):
     tensorboard: TensorBoardConfig = TensorBoardConfig
     wandb: WandbConfig = WandbConfig
     csv_monitor: CSVConfig = CSVConfig
+    telemetry: TelemetryConfig = TelemetryConfig
     comms_logger: CommsLoggerConfig = CommsLoggerConfig
     flops_profiler: FlopsProfilerConfig = FlopsProfilerConfig
     data_types: DataTypesConfig = DataTypesConfig

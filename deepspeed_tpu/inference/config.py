@@ -8,8 +8,8 @@ graph), kernel-injection flags disappear (XLA fuses the inference kernels).
 import typing
 
 from ..config.base import ConfigModel
-from ..config.config import (CSVConfig, ServingConfig, TensorBoardConfig,
-                             WandbConfig)
+from ..config.config import (CSVConfig, ServingConfig, TelemetryConfig,
+                             TensorBoardConfig, WandbConfig)
 
 
 class TensorParallelConfig(ConfigModel):
@@ -64,6 +64,9 @@ class DeepSpeedInferenceConfig(ConfigModel):
     tensorboard: TensorBoardConfig = None
     wandb: WandbConfig = None
     csv_monitor: CSVConfig = None
+    # span tracing of serving request lifecycles (queued -> prefill ->
+    # first token -> decode steps -> finish/shed); same block as training
+    telemetry: TelemetryConfig = None
     quant: QuantizationConfig = None
     moe: MoEInferenceConfig = None
     replace_with_kernel_inject: bool = False  # accepted for config compat; no-op
@@ -88,6 +91,8 @@ class DeepSpeedInferenceConfig(ConfigModel):
             self.wandb = WandbConfig()
         if self.csv_monitor is None:
             self.csv_monitor = CSVConfig()
+        if self.telemetry is None:
+            self.telemetry = TelemetryConfig()
         from ..config.base import ConfigError
 
         if self.dtype not in ("float16", "bfloat16", "float32"):

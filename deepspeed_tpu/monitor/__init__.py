@@ -1,3 +1,5 @@
-from .monitor import Monitor, MonitorMaster, TensorBoardMonitor, WandbMonitor, CSVMonitor
+from .monitor import (Monitor, MonitorMaster, TensorBoardMonitor,
+                      WandbMonitor, CSVMonitor, TraceFileMonitor)
 
-__all__ = ["Monitor", "MonitorMaster", "TensorBoardMonitor", "WandbMonitor", "CSVMonitor"]
+__all__ = ["Monitor", "MonitorMaster", "TensorBoardMonitor", "WandbMonitor",
+           "CSVMonitor", "TraceFileMonitor"]

@@ -7,7 +7,9 @@ backends; writes happen on process rank 0 only.
 """
 
 import csv
+import json
 import os
+import time
 
 from .. import comm as dist
 from ..utils.logging import logger
@@ -98,6 +100,38 @@ class CSVMonitor(Monitor):
                 w.writerow([step, value])
 
 
+class TraceFileMonitor(Monitor):
+    """Trace-file backend: appends scalar events as JSONL next to the span
+    trace (``<telemetry.output_path>/<job_name>/scalars.jsonl``), so the
+    same directory holds spans AND the scalars recorded against them —
+    ``tools/trace_summary.py`` joins both (e.g. flags steps whose
+    ``Comm/exposed_frac`` exceeds budget). Gated on the ``telemetry``
+    config block; rank 0 only."""
+
+    def __init__(self, config):
+        tel = getattr(config, "telemetry", None)
+        # duck-typed stand-in for a config section: enabled + job fields
+        self.config = tel
+        self.enabled = bool(tel is not None and tel.enabled)
+        self.path = None
+        if self.enabled and dist.get_rank() == 0:
+            base = tel.output_path or "./traces"
+            d = os.path.join(base, tel.job_name)
+            os.makedirs(d, exist_ok=True)
+            self.path = os.path.join(d, "scalars.jsonl")
+            # fresh run, fresh scalar stream (spans.jsonl does the same)
+            open(self.path, "w").close()
+
+    def write_events(self, event_list):
+        if self.path is None:
+            return
+        now = time.time()
+        with open(self.path, "a") as f:
+            for name, value, step in event_list:
+                f.write(json.dumps({"name": name, "value": float(value),
+                                    "step": int(step), "time": now}) + "\n")
+
+
 class MonitorMaster(Monitor):
     """Reference ``monitor/monitor.py:29``: fan out to all enabled backends."""
 
@@ -106,6 +140,7 @@ class MonitorMaster(Monitor):
             TensorBoardMonitor(config),
             WandbMonitor(config),
             CSVMonitor(config),
+            TraceFileMonitor(config),
         ]
         self.enabled = any(b.enabled for b in self.backends)
 

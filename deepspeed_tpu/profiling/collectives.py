@@ -140,6 +140,219 @@ def parse_collectives_by_dtype(hlo, n_devices, loop_trip_count=1):
     return stats
 
 
+# --------------------------------------------------------------------------
+# exposed-vs-overlappable schedule audit
+# --------------------------------------------------------------------------
+
+_OPCODE_RE = re.compile(
+    r"=\s*(?:\([^)]*\)|[a-z0-9]+\[[\d,]*\](?:\{[^}]*\})?)\s+([\w\-]+)\(")
+_NAME_RE = re.compile(r"^(?:ROOT\s+)?%?([\w.\-]+)\s*=")
+_COMPUTE_OPS = ("dot", "convolution")
+
+
+def _operand_names(line):
+    """Operand instruction names of an HLO instruction line. Handles both
+    text styles: signature form carries operand shapes
+    (``all-gather(bf16[128,64] %x)``), the pass-dump compact form carries
+    bare names (``all-gather(q.1), channel_id=1``)."""
+    m = _OPCODE_RE.search(line)
+    if not m:
+        return []
+    start = line.index("(", m.start(1))
+    depth, end = 0, len(line)
+    for i in range(start, len(line)):
+        if line[i] == "(":
+            depth += 1
+        elif line[i] == ")":
+            depth -= 1
+            if depth == 0:
+                end = i
+                break
+    names = []
+    for chunk in line[start + 1:end].split(","):
+        chunk = chunk.strip()
+        if not chunk:
+            continue
+        tok = chunk.split()[-1]  # "bf16[8,8] %x" -> "%x"; "q.1" -> "q.1"
+        tok = tok.lstrip("%")
+        # constants / literals ("true", "0.5", "{...}") aren't operands we
+        # can resolve; harmless to include — they just miss the symbol table
+        names.append(tok)
+    return names
+
+
+def _parse_computations(hlo):
+    """HLO text -> {computation: [instr, ...]} where each instr is
+    ``{"name", "opcode", "operands", "dtype", "dims"}`` in program order.
+    Same header heuristics as ``parse_collectives_by_dtype``."""
+    comps = {}
+    comp = "<entry>"
+    for line in hlo.splitlines():
+        s = line.strip()
+        if s.endswith("{") and "=" not in s and not s.startswith("ROOT"):
+            m = re.match(r"(?:ENTRY\s+)?%?([\w.\-]+)\s*[({]", s)
+            if m and m.group(1) not in ("if", "while", "true", "false"):
+                comp = m.group(1)
+            continue
+        nm = _NAME_RE.match(s)
+        op = _OPCODE_RE.search(s)
+        if not (nm and op):
+            continue
+        opcode = op.group(1)
+        shape = _result_shape(s, is_start=opcode.endswith("-start"))
+        comps.setdefault(comp, []).append({
+            "name": nm.group(1), "opcode": opcode,
+            "operands": _operand_names(s),
+            "dtype": shape[0] if shape else None,
+            "dims": shape[1] if shape else None,
+            "line": s,
+        })
+    return comps
+
+
+def _elems(dims):
+    n = 1
+    for d in (dims or "").split(","):
+        if d:
+            n *= int(d)
+    return n
+
+
+def _dot_flops(instr, by_name):
+    """Flops proxy for a dot/conv: ``2 * sqrt(|lhs| * |rhs| * |result|)``
+    — exact ``2*M*K*N`` for a plain matmul (overcounts batched dots by
+    ``sqrt(B)``, fine for an is-there-compute-to-hide-behind signal). Falls
+    back to ``2 * |result|`` when an operand's shape is unknown."""
+    res = _elems(instr["dims"])
+    ops = [by_name.get(o) for o in instr["operands"][:2]]
+    if len(ops) == 2 and all(o is not None and o["dims"] is not None
+                             for o in ops):
+        import math
+
+        return 2.0 * math.sqrt(
+            max(_elems(ops[0]["dims"]), 1) * max(_elems(ops[1]["dims"]), 1)
+            * max(res, 1))
+    return 2.0 * res
+
+
+def _reachable(start_names, adjacency):
+    """BFS closure over an adjacency dict name -> [names]."""
+    seen = set(start_names)
+    frontier = list(start_names)
+    while frontier:
+        nxt = []
+        for n in frontier:
+            for m in adjacency.get(n, ()):
+                if m not in seen:
+                    seen.add(m)
+                    nxt.append(m)
+        frontier = nxt
+    return seen
+
+
+def audit_schedule(hlo, n_devices, loop_trip_count=1):
+    """Classify every collective's wire bytes as *exposed* vs
+    *overlappable-behind-compute* by walking the post-SPMD HLO dependency
+    graph (ROADMAP item 4's instrument).
+
+    Per collective C (sync op, or an async ``-start``/``-done`` pair merged
+    into one node): compute ops (dot/convolution) in the same computation
+    that are neither ancestors of C's start nor descendants of C's done are
+    *independent* — the scheduler MAY run them concurrently with the wire
+    transfer. A collective with no independent compute is **exposed**: every
+    flop in its computation either feeds it or waits on it, so its wire time
+    lands on the critical path no matter how the backend schedules. This is
+    a dependence-structure bound, not a schedule simulation: "overlappable"
+    means the graph admits overlap (reported with the independent-flops
+    headroom), not that the backend achieved it.
+
+    Wire bytes per op use the same ring accounting (+ while-body trip
+    multiplication) as ``parse_collectives_by_dtype``.
+    """
+    body_names = set(re.findall(r"body=%?([\w.\-]+)", hlo))
+    comps = _parse_computations(hlo)
+    by_kind = {k: {"exposed_bytes": 0.0, "overlappable_bytes": 0.0,
+                   "exposed_count": 0, "overlappable_count": 0}
+               for k in KINDS}
+    ops = []
+    for comp, instrs in comps.items():
+        by_name = {i["name"]: i for i in instrs}
+        consumers = {}
+        for i in instrs:
+            for o in i["operands"]:
+                if o in by_name:
+                    consumers.setdefault(o, []).append(i["name"])
+        producers = {i["name"]: [o for o in i["operands"] if o in by_name]
+                     for i in instrs}
+        trip = loop_trip_count if comp in body_names else 1
+
+        for i in instrs:
+            kind = i["opcode"][:-6] if i["opcode"].endswith("-start") \
+                else i["opcode"]
+            if kind not in KINDS:
+                continue  # (-done ops land here too: accounted at -start)
+            if i["dtype"] is None:
+                continue
+            b = _nbytes(i["dtype"], i["dims"])
+            g = _group_size(i["line"], n_devices)
+            frac = (g - 1) / g if g > 1 else 1.0
+            if kind == "all-gather":
+                wire = b * frac
+            elif kind == "reduce-scatter":
+                wire = b * g * frac
+            elif kind == "all-reduce":
+                wire = 2 * b * frac
+            elif kind == "all-to-all":
+                wire = b * frac
+            else:
+                wire = b
+            wire *= trip
+
+            # merge an async start with its done: the overlap window is
+            # everything not upstream of the start nor downstream of the done
+            sinks = [i["name"]]
+            if i["opcode"].endswith("-start"):
+                for j in instrs:
+                    if j["opcode"].endswith("-done") \
+                            and i["name"] in j["operands"]:
+                        sinks.append(j["name"])
+                        break
+            ancestors = _reachable([i["name"]], producers)
+            descendants = _reachable(sinks, consumers)
+            blocked = ancestors | descendants
+            indep_flops = sum(
+                _dot_flops(j, by_name) * trip for j in instrs
+                if j["opcode"] in _COMPUTE_OPS and j["name"] not in blocked)
+            exposed = indep_flops <= 0.0
+            st = by_kind[kind]
+            if exposed:
+                st["exposed_bytes"] += wire
+                st["exposed_count"] += 1
+            else:
+                st["overlappable_bytes"] += wire
+                st["overlappable_count"] += 1
+            ops.append({
+                "name": i["name"], "computation": comp, "kind": kind,
+                "dtype": i["dtype"], "wire_bytes": wire,
+                "async": i["opcode"].endswith("-start"),
+                "exposed": exposed,
+                "independent_compute_flops": indep_flops,
+            })
+
+    exposed_total = sum(s["exposed_bytes"] for s in by_kind.values())
+    overlap_total = sum(s["overlappable_bytes"] for s in by_kind.values())
+    total = exposed_total + overlap_total
+    ops.sort(key=lambda o: (not o["exposed"], -o["wire_bytes"]))
+    return {
+        "by_kind": by_kind,
+        "exposed_bytes": exposed_total,
+        "overlappable_bytes": overlap_total,
+        "exposed_fraction": exposed_total / total if total else 0.0,
+        "top_exposed": [o for o in ops if o["exposed"]][:10],
+        "n_collectives": len(ops),
+    }
+
+
 def fp32_param_bytes(hlo):
     """Sum of f32 ENTRY-parameter bytes per chip (masters + optimizer
     moments + small replicated leaves). Proves the master-weight discipline:
@@ -209,9 +422,11 @@ def compile_with_partitioned_hlo(lowered):
 
 
 def audit_lowered(lowered, n_devices, loop_trip_count=1):
-    """Compile + parse: the full wire report for one lowered step program."""
+    """Compile + parse: the full wire report for one lowered step program,
+    including the exposed-vs-overlappable schedule split."""
     compiled, hlo = compile_with_partitioned_hlo(lowered)
     stats = parse_collectives_by_dtype(hlo, n_devices, loop_trip_count)
+    schedule = audit_schedule(hlo, n_devices, loop_trip_count)
     mem = compiled.memory_analysis()
     body_names = stats.pop("_loop_body_computations")
     total = sum(s["wire_bytes"] for s in stats.values())
@@ -221,6 +436,7 @@ def audit_lowered(lowered, n_devices, loop_trip_count=1):
             by_dtype[dt] = by_dtype.get(dt, 0.0) + b
     return {
         "collectives": stats,
+        "schedule": schedule,
         "total_wire_bytes": total,
         "total_by_dtype": by_dtype,
         "fp32_param_bytes_per_chip": fp32_param_bytes(hlo),
@@ -256,6 +472,20 @@ def check_budgets(report, budget, n_params=None, n_devices=None):
         v.append(f"total wire {report['total_wire_bytes'] / 1e9:.2f} "
                  f"GB/chip/step exceeds budget {budget['total_wire_gb_max']} "
                  f"GB")
+    sched = report.get("schedule")
+    if sched is not None:
+        if "exposed_gb_max" in budget and \
+                sched["exposed_bytes"] > budget["exposed_gb_max"] * 1e9:
+            v.append(f"exposed collective wire "
+                     f"{sched['exposed_bytes'] / 1e9:.2f} GB/chip/step "
+                     f"exceeds budget {budget['exposed_gb_max']} GB (an "
+                     f"overlap regression: bytes that used to hide behind "
+                     f"compute now sit on the critical path)")
+        if "exposed_fraction_max" in budget and \
+                sched["exposed_fraction"] > budget["exposed_fraction_max"]:
+            v.append(f"exposed fraction {sched['exposed_fraction']:.3f} of "
+                     f"collective wire exceeds budget "
+                     f"{budget['exposed_fraction_max']} (schedule audit)")
     if budget.get("masters_sharded_fp32") and n_params and n_devices:
         # sharded fp32 state (params + adam moments) ~= 3 x 4 x P / N;
         # 10% + 64 MB slack covers replicated small leaves

@@ -266,13 +266,26 @@ class DeepSpeedEngine:
         self._acc_grads = None
         self._cached = None  # (loss, grads) from the last forward
 
-        # -- counters / timers / monitor ---------------------------------------------
+        # -- counters / timers / monitor / telemetry ---------------------------------
         self.global_steps = 0
         self.micro_steps = 0
         self.skipped_steps = 0
-        self.timers = SynchronizedWallClockTimer()
+        tel = self._config.telemetry
+        from ..telemetry import SpanTracer
+
+        # device_sync arms a block_until_ready fence on BOTH the span ends
+        # and the fwd/bwd/step timers: unsynced host timers measure dispatch
+        # (jax's async enqueue), not execution
+        self._telemetry_sync = bool(tel.enabled and tel.device_sync)
+        sync_fn = self._device_fence if tel.device_sync else None
+        self.tracer = SpanTracer.from_config(
+            tel, sync_fn=self._device_fence,
+            meta={"process": "train", "mesh": dict(self.mesh.shape),
+                  "zero_stage": self.zero_stage})
+        self.timers = SynchronizedWallClockTimer(sync_fn=sync_fn)
         self.tput_timer = ThroughputTimer(
-            batch_size=self.train_batch_size_, steps_per_output=self._config.steps_per_print
+            batch_size=self.train_batch_size_, steps_per_output=self._config.steps_per_print,
+            sync_fn=sync_fn,
         )
         self._wall_clock_breakdown = self._config.wall_clock_breakdown
         from ..monitor.monitor import MonitorMaster
@@ -459,6 +472,18 @@ class DeepSpeedEngine:
     # ------------------------------------------------------------------------------
     # init helpers
     # ------------------------------------------------------------------------------
+    def _device_fence(self):
+        """Zero-arg device fence for spans/timers (``telemetry.device_sync``):
+        block on the freshest step output — the cached (loss, grads) right
+        after a forward, else the live params the step just rewrote. Abstract
+        engines (ShapeDtypeStruct trees) have nothing to block on; the guard
+        keeps tracing from ever taking a step down."""
+        try:
+            jax.block_until_ready(
+                self._cached if self._cached is not None else self.params)
+        except Exception:
+            pass
+
     def _resolve_gather_wire(self):
         """``zero3_gather_dtype`` -> (impl, wire-dtype name for the model).
 
@@ -1032,8 +1057,20 @@ class DeepSpeedEngine:
                     events.append(("Comm/total_wire_gb",
                                    ws["total_wire_bytes"] / 1e9,
                                    self.global_steps))
+                    sched = ws.get("schedule")
+                    if sched:
+                        # the exposed-vs-overlappable split of the same wire
+                        # bytes (schedule audit): trace_summary.py flags
+                        # steps whose exposed share exceeds budget
+                        events.append(("Comm/exposed_wire_gb",
+                                       sched["exposed_bytes"] / 1e9,
+                                       self.global_steps))
+                        events.append(("Comm/exposed_frac",
+                                       sched["exposed_fraction"],
+                                       self.global_steps))
             self.monitor.write_events(events)
             self._report_progress()
+            self.tracer.flush()
             if self._config.memory_breakdown:
                 # reference see_memory_usage role, via the accelerator seam
                 from ..accelerator import get_accelerator
@@ -1240,37 +1277,43 @@ class DeepSpeedEngine:
         the grads, ``backward`` accumulates them. Numerically identical, one less
         pass over the activations.
         """
-        if self._wall_clock_breakdown:
-            self.timers(FORWARD_GLOBAL_TIMER).start()
-        self._maybe_refresh_compression()
-        if self._fwd_bwd_fn is None:
-            self._build_fwd_bwd()
-        batch = self._shard_batch(self._apply_curriculum(batch))
-        self._rng, step_rng = jax.random.split(self._rng)
-        loss, grads = self._fwd_bwd_fn(self.params, batch, self._scale, step_rng)
-        self._cached = (loss, grads)
-        if self._wall_clock_breakdown:
-            self.timers(FORWARD_GLOBAL_TIMER).stop()
-        return loss
+        with self.tracer.span("fwd", cat="train", sync=self._telemetry_sync,
+                              step=self.global_steps + 1) as sp:
+            if self._wall_clock_breakdown:
+                self.timers(FORWARD_GLOBAL_TIMER).start()
+            self._maybe_refresh_compression()
+            if self._fwd_bwd_fn is None:
+                self._build_fwd_bwd()
+            batch = self._shard_batch(self._apply_curriculum(batch))
+            self._rng, step_rng = jax.random.split(self._rng)
+            loss, grads = self._fwd_bwd_fn(self.params, batch, self._scale, step_rng)
+            self._cached = (loss, grads)
+            sp.fence(self._cached)
+            if self._wall_clock_breakdown:
+                self.timers(FORWARD_GLOBAL_TIMER).stop()
+            return loss
 
     def backward(self, loss=None):
         """Accumulate the cached micro-batch grads (reference engine.backward)."""
         if self._cached is None:
             raise RuntimeError("backward() called before forward()")
-        if self._wall_clock_breakdown:
-            self.timers(BACKWARD_GLOBAL_TIMER).start()
-        _, grads = self._cached
-        self._cached = None
-        if self._acc_grads is None:
-            self._acc_grads = grads
-        else:
-            if self._accumulate_fn is None:
-                self._build_accumulate()
-            self._acc_grads = self._accumulate_fn(self._acc_grads, grads)
-        self.micro_steps += 1
-        if self._wall_clock_breakdown:
-            self.timers(BACKWARD_GLOBAL_TIMER).stop()
-        return loss
+        with self.tracer.span("bwd", cat="train", sync=self._telemetry_sync,
+                              step=self.global_steps + 1) as sp:
+            if self._wall_clock_breakdown:
+                self.timers(BACKWARD_GLOBAL_TIMER).start()
+            _, grads = self._cached
+            self._cached = None
+            if self._acc_grads is None:
+                self._acc_grads = grads
+            else:
+                if self._accumulate_fn is None:
+                    self._build_accumulate()
+                self._acc_grads = self._accumulate_fn(self._acc_grads, grads)
+            sp.fence(self._acc_grads)
+            self.micro_steps += 1
+            if self._wall_clock_breakdown:
+                self.timers(BACKWARD_GLOBAL_TIMER).stop()
+            return loss
 
     def is_gradient_accumulation_boundary(self):
         """Reference ``engine.py:1565``."""
@@ -1282,40 +1325,51 @@ class DeepSpeedEngine:
             return
         if self._acc_grads is None:
             raise RuntimeError("step() called with no accumulated gradients")
-        if self._wall_clock_breakdown:
-            self.timers(STEP_GLOBAL_TIMER).start()
-        if self._offloaded is not None:
-            return self._offloaded_step()
-        if self._apply_fn is None:
-            self._build_apply()
-        lr = self._current_lr()
-        (self.params, self.optimizer_state, self._scale,
-         self._good_steps, overflow, grad_norm) = self._apply_fn(
-            self.params, self.optimizer_state, self._acc_grads, self._scale,
-            self._good_steps, jnp.asarray(lr, jnp.float32),
-        )
-        self._acc_grads = None  # donated; re-seeded by the next backward()
-        self.global_steps += 1
-        if self.fp16_enabled and bool(overflow):
-            self.skipped_steps += 1
-            log_dist(
-                f"step {self.global_steps}: fp16 overflow, skipping update "
-                f"(loss scale -> {float(self._scale)})",
-                ranks=[0],
+        with self.tracer.span("step", cat="train", sync=self._telemetry_sync,
+                              step=self.global_steps + 1) as sp:
+            if self._wall_clock_breakdown:
+                self.timers(STEP_GLOBAL_TIMER).start()
+            if self._offloaded is not None:
+                return self._offloaded_step()
+            if self._apply_fn is None:
+                self._build_apply()
+            lr = self._current_lr()
+            (self.params, self.optimizer_state, self._scale,
+             self._good_steps, overflow, grad_norm) = self._apply_fn(
+                self.params, self.optimizer_state, self._acc_grads, self._scale,
+                self._good_steps, jnp.asarray(lr, jnp.float32),
             )
-        elif self.lr_scheduler is not None:
-            self.lr_scheduler.step()
-        if self._wall_clock_breakdown:
-            self.timers(STEP_GLOBAL_TIMER).stop()
-            self.timers.log(
-                [FORWARD_GLOBAL_TIMER, BACKWARD_GLOBAL_TIMER, STEP_GLOBAL_TIMER]
-            )
-        if self.global_steps % self._config.steps_per_print == 0:
-            self.monitor.write_events(
-                [("Train/lr", lr, self.global_steps),
-                 ("Train/grad_norm", float(grad_norm), self.global_steps)]
-            )
-        return grad_norm
+            self._acc_grads = None  # donated; re-seeded by the next backward()
+            sp.fence(self.params)
+            self.global_steps += 1
+            if self.fp16_enabled and bool(overflow):
+                self.skipped_steps += 1
+                log_dist(
+                    f"step {self.global_steps}: fp16 overflow, skipping update "
+                    f"(loss scale -> {float(self._scale)})",
+                    ranks=[0],
+                )
+            elif self.lr_scheduler is not None:
+                self.lr_scheduler.step()
+            if self._wall_clock_breakdown:
+                self.timers(STEP_GLOBAL_TIMER).stop()
+                # monitor events read WITHOUT reset so the log() line below
+                # still sees the same window (log resets)
+                self.timers.write_events(
+                    self.monitor,
+                    [FORWARD_GLOBAL_TIMER, BACKWARD_GLOBAL_TIMER,
+                     STEP_GLOBAL_TIMER],
+                    self.global_steps, reset=False)
+                self.timers.log(
+                    [FORWARD_GLOBAL_TIMER, BACKWARD_GLOBAL_TIMER, STEP_GLOBAL_TIMER]
+                )
+            if self.global_steps % self._config.steps_per_print == 0:
+                self.monitor.write_events(
+                    [("Train/lr", lr, self.global_steps),
+                     ("Train/grad_norm", float(grad_norm), self.global_steps)]
+                )
+                self.tracer.flush()
+            return grad_norm
 
     def _offloaded_step(self):
         """ZeRO-Offload step: grads -> host, host optimizer on fp32 masters,
@@ -1367,32 +1421,41 @@ class DeepSpeedEngine:
         step (as the reference's ``FP16_Optimizer.step`` does), which syncs;
         the pipelining guarantee holds for bf16/fp32.
         """
-        self.tput_timer.start()
-        self._maybe_refresh_compression()
-        micros = []
-        for _ in range(self.gradient_accumulation_steps_):
-            micro = batch if batch is not None else next(data_iter)
-            micros.append(self._apply_curriculum(micro))
-        if self._onebit_active:
-            mean_loss = self._onebit_train_batch(micros)
+        step_no = self.global_steps + 1
+        with self.tracer.span("train_batch", cat="train",
+                              sync=self._telemetry_sync, step=step_no):
+            self.tput_timer.start()
+            self._maybe_refresh_compression()
+            with self.tracer.span("data", cat="train", step=step_no):
+                micros = []
+                for _ in range(self.gradient_accumulation_steps_):
+                    micro = batch if batch is not None else next(data_iter)
+                    micros.append(self._apply_curriculum(micro))
+            if self._onebit_active:
+                with self.tracer.span("step", cat="train", step=step_no):
+                    mean_loss = self._onebit_train_batch(micros)
+                self.tput_timer.stop(global_step=True)
+                return mean_loss
+            if self._can_fuse_train_step():
+                # ONE device dispatch: fwd+bwd+apply (and the in-program
+                # ZeRO-3 gather schedule) are indistinguishable host-side —
+                # the schedule auditor attributes inside the program
+                with self.tracer.span("step", cat="train", step=step_no):
+                    mean_loss = self._fused_train_batch(micros)
+                self.tput_timer.stop(global_step=True)
+                return mean_loss
+            losses = []
+            for micro in micros:
+                loss = self.forward(micro)
+                self.backward(loss)
+                losses.append(loss)
+            self.step()
             self.tput_timer.stop(global_step=True)
+            mean_loss = jnp.mean(jnp.stack(losses)) if len(losses) > 1 else losses[0]
+            if self.global_steps % self._config.steps_per_print == 0:
+                self.monitor.write_events([("Train/loss", float(mean_loss), self.global_steps)])
+                self._report_progress()
             return mean_loss
-        if self._can_fuse_train_step():
-            mean_loss = self._fused_train_batch(micros)
-            self.tput_timer.stop(global_step=True)
-            return mean_loss
-        losses = []
-        for micro in micros:
-            loss = self.forward(micro)
-            self.backward(loss)
-            losses.append(loss)
-        self.step()
-        self.tput_timer.stop(global_step=True)
-        mean_loss = jnp.mean(jnp.stack(losses)) if len(losses) > 1 else losses[0]
-        if self.global_steps % self._config.steps_per_print == 0:
-            self.monitor.write_events([("Train/loss", float(mean_loss), self.global_steps)])
-            self._report_progress()
-        return mean_loss
 
     def eval_batch(self, batch):
         """Loss without grads. On pipe meshes this runs the PIPELINED forward
@@ -1479,6 +1542,7 @@ class DeepSpeedEngine:
             self._onebit_we = None   # error-feedback buffers (~params-sized)
             self._onebit_se = None
         self._offloaded = None
+        self.tracer.flush()  # don't lose the trace tail with the engine
         import gc
 
         # no jax.clear_caches(): that is process-global and would force every
@@ -1622,13 +1686,27 @@ class DeepSpeedEngine:
             "client_state": client_state or {},
         }
         path = os.path.join(save_dir, tag)
-        self.checkpoint_engine.save(state, path, meta=meta)
-        self.checkpoint_engine.commit(tag)
+        with self.tracer.span("checkpoint/save", cat="checkpoint", tag=tag,
+                              step=self.global_steps):
+            with self.tracer.span("checkpoint/write", cat="checkpoint",
+                                  step=self.global_steps):
+                self.checkpoint_engine.save(state, path, meta=meta)
+            with self.tracer.span("checkpoint/commit", cat="checkpoint",
+                                  step=self.global_steps):
+                self.checkpoint_engine.commit(tag)
+        self.tracer.flush()
         log_dist(f"Saved checkpoint {path}", ranks=[0])
         return path
 
     def load_checkpoint(self, load_dir, tag=None, load_optimizer_states=True,
                         verify=True):
+        with self.tracer.span("checkpoint/resume", cat="checkpoint",
+                              tag=tag) as _resume_span:
+            return self._load_checkpoint(load_dir, tag, load_optimizer_states,
+                                         verify, _resume_span)
+
+    def _load_checkpoint(self, load_dir, tag, load_optimizer_states, verify,
+                         span):
         if tag is None:
             from ..checkpoint import atomic as ckpt_atomic
 
@@ -1676,5 +1754,6 @@ class DeepSpeedEngine:
         self._good_steps = jnp.asarray(meta["good_steps"], jnp.int32)
         if self.lr_scheduler is not None and meta.get("lr_scheduler"):
             self.lr_scheduler.load_state_dict(meta["lr_scheduler"])
+        span.set(tag=tag, step=self.global_steps)
         log_dist(f"Loaded checkpoint {path} at step {self.global_steps}", ranks=[0])
         return path, meta.get("client_state", {})

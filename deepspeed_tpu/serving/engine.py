@@ -40,7 +40,8 @@ from .scheduler import ServingScheduler
 class ServingEngine:
     """Slot-pool continuous batching over an ``InferenceEngine``'s weights."""
 
-    def __init__(self, engine, serving_config=None, clock=None, monitor=None):
+    def __init__(self, engine, serving_config=None, clock=None, monitor=None,
+                 tracer=None):
         if not hasattr(engine.module, "config"):
             raise ConfigError(
                 "serving needs a zoo-style model (config with kv cache "
@@ -65,13 +66,24 @@ class ServingEngine:
         if monitor is None:
             mc = engine.config
             if (mc.tensorboard.enabled or mc.wandb.enabled
-                    or mc.csv_monitor.enabled):
+                    or mc.csv_monitor.enabled
+                    or getattr(mc, "telemetry", None) is not None
+                    and mc.telemetry.enabled):
                 from ..monitor.monitor import MonitorMaster
 
                 monitor = MonitorMaster(mc)
         self.metrics = ServingMetrics(self.n_slots, self.clock,
                                       monitor=monitor,
                                       interval=self.cfg.monitor_interval)
+        # request-lifecycle tracing AGAINST THE SCHEDULER CLOCK: under a
+        # virtual clock the trace timestamps are virtual time, which is what
+        # makes trace-derived TTFT/TPOT bit-identical to ServingMetrics
+        from ..telemetry import SpanTracer
+
+        self.tracer = tracer if tracer is not None else SpanTracer.from_config(
+            getattr(engine.config, "telemetry", None), clock=self.clock.now,
+            meta={"process": "serving", "n_slots": self.n_slots,
+                  "max_len": self.max_len})
 
         self._slots = {}              # slot index -> running Request
         self._free_slots = list(range(self.n_slots - 1, -1, -1))  # pop() -> 0 first
@@ -261,8 +273,17 @@ class ServingEngine:
         reason = self.queue.admit(req, self.max_len)
         if reason is None:
             self.metrics.record_submit()
+            self.tracer.instant(
+                "request/queued", cat="serving", request_id=req.request_id,
+                prompt_len=req.prompt_len,
+                # TTFT's zero point, exactly as Request.ttft defines it:
+                # resolved arrival if the request carried one, else submit
+                start=req.arrival_time if req.arrival_time is not None
+                else req.submit_time)
         else:
             self.metrics.record_shed(reason)
+            self.tracer.instant("request/shed", cat="serving",
+                                request_id=req.request_id, reason=reason)
         return req
 
     # ------------------------------------------------------------- the loop
@@ -304,11 +325,13 @@ class ServingEngine:
         # (same scheme as generate()), so padding may overlap the generation
         # region — one bucket serves every max_new_tokens
         padded = self.engine._bucket_prompt_len(req.prompt_len, self.max_len)
-        ids = np.zeros((1, padded), np.int32)
-        ids[0, :req.prompt_len] = req.prompt
-        logits, cache = self._prefill_program(padded)(
-            self.engine.params, jnp.asarray(ids), np.int32(req.prompt_len))
-        self.clock.advance(padded * self.cfg.virtual_prefill_cost_per_token)
+        with self.tracer.span("prefill", cat="serving",
+                              request_id=req.request_id, padded_len=padded):
+            ids = np.zeros((1, padded), np.int32)
+            ids[0, :req.prompt_len] = req.prompt
+            logits, cache = self._prefill_program(padded)(
+                self.engine.params, jnp.asarray(ids), np.int32(req.prompt_len))
+            self.clock.advance(padded * self.cfg.virtual_prefill_cost_per_token)
 
         keys = self._request_key(req)
         s = req.sampling
@@ -321,6 +344,8 @@ class ServingEngine:
         req.tokens.append(t)
         self.metrics.record_tokens(1)
         self.metrics.record_first_token(req)
+        self.tracer.instant("request/first_token", cat="serving", ts=now,
+                            request_id=req.request_id)
 
         eos = req.eos_token_id
         if (eos is not None and t == eos) or t in req.stop_token_ids \
@@ -345,9 +370,11 @@ class ServingEngine:
         events.append(TokenEvent(req.request_id, t, 0, False, None, now))
 
     def _decode_once(self, events):
-        (toks, done_now), self._state = self._decode_jit(self.engine.params,
-                                                         self._state)
-        self.clock.advance(self.cfg.virtual_decode_step_cost)
+        with self.tracer.span("decode_step", cat="serving",
+                              active=len(self._slots)):
+            (toks, done_now), self._state = self._decode_jit(self.engine.params,
+                                                             self._state)
+            self.clock.advance(self.cfg.virtual_decode_step_cost)
         toks = np.asarray(toks)
         done_now = np.asarray(done_now)
         now = self.clock.now()
@@ -388,6 +415,9 @@ class ServingEngine:
                                                 np.int32(req.slot))
             req.slot = None
         self.metrics.record_finish(req)
+        self.tracer.instant("request/finish", cat="serving", ts=now,
+                            request_id=req.request_id, reason=reason,
+                            n_tokens=len(req.tokens))
 
     # ------------------------------------------------------------- frontends
     def serve(self, requests=None, yield_rejections=True):
@@ -406,21 +436,27 @@ class ServingEngine:
                 r.arrival_resolved = True
             elif r.arrival_time is None:
                 r.arrival_time = t0
-        while pending or self.queue.depth or self._slots:
-            now = self.clock.now()
-            while pending and pending[0].arrival_time <= now:
-                req = self.submit(pending.pop(0))
-                if req.state is RequestState.REJECTED and yield_rejections:
-                    yield TokenEvent(req.request_id, -1, -1, True,
-                                     f"rejected:{req.reject_reason}", now)
-            if not self._slots and not self.queue.depth:
-                if not pending:
-                    break
-                # idle until the next arrival
-                self.clock.sleep(max(pending[0].arrival_time - now, 1e-4))
-                continue
-            for ev in self.step():
-                yield ev
+        try:
+            while pending or self.queue.depth or self._slots:
+                now = self.clock.now()
+                while pending and pending[0].arrival_time <= now:
+                    req = self.submit(pending.pop(0))
+                    if req.state is RequestState.REJECTED and yield_rejections:
+                        yield TokenEvent(req.request_id, -1, -1, True,
+                                         f"rejected:{req.reject_reason}", now)
+                if not self._slots and not self.queue.depth:
+                    if not pending:
+                        break
+                    # idle until the next arrival
+                    self.clock.sleep(max(pending[0].arrival_time - now, 1e-4))
+                    continue
+                for ev in self.step():
+                    yield ev
+        finally:
+            # a consumer that breaks mid-stream (GeneratorExit) or a step()
+            # exception must still land the lifecycle events on disk — this
+            # is the only flush on the streaming path before destroy()
+            self.tracer.flush()
 
     def run(self, requests):
         """Non-streaming convenience: serve ``requests`` to completion and
@@ -444,6 +480,7 @@ class ServingEngine:
         self._prefill_programs = OrderedDict()
         self._slots = {}
         self._free_slots = list(range(self.n_slots - 1, -1, -1))
+        self.tracer.flush()
         import gc
 
         gc.collect()

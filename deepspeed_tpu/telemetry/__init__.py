@@ -1,0 +1,20 @@
+"""Unified step-trace telemetry.
+
+``SpanTracer`` records nested host spans (optionally device-fenced with
+``block_until_ready``) against a pluggable clock and emits Chrome-trace
+JSON (Perfetto-loadable) plus structured JSONL. Wired into the training
+engine's step phases, the serving engine's request lifecycles, and
+checkpoint save/resume; analyzed by ``tools/trace_summary.py``.
+"""
+
+from .analysis import (counters_by_step, load_jsonl, phase_table,
+                       request_metrics)
+from .tracer import SpanTracer
+
+__all__ = [
+    "SpanTracer",
+    "load_jsonl",
+    "request_metrics",
+    "phase_table",
+    "counters_by_step",
+]

@@ -4,11 +4,15 @@ TPU-native equivalent of the reference's ``deepspeed/utils/timer.py``:
 ``SynchronizedWallClockTimer`` (reference :33) and ``ThroughputTimer`` (reference :137).
 On TPU, device synchronization is a ``block_until_ready`` on a dispatched token rather
 than a CUDA event pair; timers deliberately avoid forcing synchronization unless asked.
+Pass ``sync_fn`` (a zero-arg device fence, e.g. the engine's
+``block_until_ready`` hook armed by ``telemetry.device_sync``) to make
+``stop()`` measure device *execution* instead of host *dispatch* — under
+jax's async dispatch an unsynced fwd/bwd timer mostly measures enqueue.
 """
 
 import time
 
-from .logging import log_dist
+from .logging import log_dist, logger
 
 FORWARD_MICRO_TIMER = "fwd_microstep"
 FORWARD_GLOBAL_TIMER = "fwd"
@@ -18,12 +22,22 @@ STEP_MICRO_TIMER = "step_microstep"
 STEP_GLOBAL_TIMER = "step"
 
 
+# one-time nudge when dispatch-only timings reach the monitor (set back to
+# False only by a fresh process)
+_UNSYNCED_MONITOR_WARNED = False
+
+
 class SynchronizedWallClockTimer:
-    """Group of named timers (reference ``utils/timer.py:33``)."""
+    """Group of named timers (reference ``utils/timer.py:33``).
+
+    ``sync_fn``: optional zero-arg device fence run at every ``stop()``
+    (opt-in device-sync mode — ``telemetry.device_sync``). Without it the
+    timers time dispatch, which is the historical behavior."""
 
     class Timer:
-        def __init__(self, name):
+        def __init__(self, name, sync_fn=None):
             self.name_ = name
+            self.sync_fn = sync_fn
             self.started_ = False
             self.start_time = 0.0
             self.elapsed_ = 0.0
@@ -36,6 +50,8 @@ class SynchronizedWallClockTimer:
 
         def stop(self, reset=False):
             assert self.started_, f"timer {self.name_} is not started"
+            if self.sync_fn is not None:
+                self.sync_fn()
             elapsed = time.perf_counter() - self.start_time
             if reset:
                 self.elapsed_ = elapsed
@@ -63,12 +79,13 @@ class SynchronizedWallClockTimer:
         def mean(self):
             return self.elapsed_ / max(self.count, 1)
 
-    def __init__(self):
+    def __init__(self, sync_fn=None):
         self.timers = {}
+        self.sync_fn = sync_fn
 
     def __call__(self, name):
         if name not in self.timers:
-            self.timers[name] = self.Timer(name)
+            self.timers[name] = self.Timer(name, sync_fn=self.sync_fn)
         return self.timers[name]
 
     def get_timers(self):
@@ -91,11 +108,36 @@ class SynchronizedWallClockTimer:
             if name in self.timers
         }
 
+    def write_events(self, monitor, names, step, normalizer=1.0, reset=True):
+        """Emit ``Time/<name>_ms`` monitor events. Warns ONCE per process
+        when the timers are unsynced: a dispatch-only fwd/bwd number on a
+        dashboard reads like an execution time and mis-attributes the step
+        (enable ``telemetry.device_sync`` to fence on the device)."""
+        global _UNSYNCED_MONITOR_WARNED
+        if monitor is None:
+            return
+        if self.sync_fn is None and not _UNSYNCED_MONITOR_WARNED:
+            _UNSYNCED_MONITOR_WARNED = True
+            logger.warning(
+                "writing UNSYNCED wall-clock timings to the monitor: these "
+                "measure host dispatch, not device execution (jax dispatch "
+                "is async). Set telemetry.device_sync=true to fence "
+                "timers/spans with block_until_ready.")
+        events = []
+        for name in names:
+            if name in self.timers:
+                ms = self.timers[name].elapsed(reset=reset) * 1000.0 / normalizer
+                events.append((f"Time/{name}_ms", ms, step))
+        if events:
+            monitor.write_events(events)
+
 
 class ThroughputTimer:
     """Samples/sec tracker (reference ``utils/timer.py:137``)."""
 
-    def __init__(self, batch_size, start_step=2, steps_per_output=50, monitor_memory=False, logging_fn=None):
+    def __init__(self, batch_size, start_step=2, steps_per_output=50, monitor_memory=False, logging_fn=None,
+                 sync_fn=None):
+        self.sync_fn = sync_fn
         self.start_time = 0.0
         self.end_time = 0.0
         self.started = False
@@ -131,6 +173,8 @@ class ThroughputTimer:
         if global_step:
             self.global_step_count += 1
         if self.start_time > 0:
+            if self.sync_fn is not None:
+                self.sync_fn()  # samples/sec over executed steps, not queued
             self.end_time = time.perf_counter()
             duration = self.end_time - self.start_time
             self.total_elapsed_time += duration

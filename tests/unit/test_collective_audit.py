@@ -25,6 +25,7 @@ sys.path.insert(0, os.path.join(
     "tools"))
 
 from deepspeed_tpu.profiling.collectives import (  # noqa: E402
+    audit_schedule,
     check_budgets,
     fp32_param_bytes,
     parse_collectives_by_dtype,
@@ -141,6 +142,105 @@ def test_fp32_param_bytes_sums_entry_only():
     assert got == (50 * 64 + 1000 * 64) * 4  # both ENTRY params, not body p.1
 
 
+# ---------------------------------------------------------------------------
+# exposed-vs-overlappable schedule audit (dependency-graph walk)
+# ---------------------------------------------------------------------------
+
+HLO_SCHEDULE = """
+HloModule test
+
+%body.1 (arg: f32[8]) -> f32[8] {
+  %p = f32[8] parameter(0)
+  %w1 = bf16[256,64] all-gather(bf16[32,64] %s1), dimensions={0}
+  %h = bf16[16,64] dot(bf16[16,256] %x0, bf16[256,64] %w1), lhs_contracting_dims={1}, rhs_contracting_dims={0}
+  %w2 = bf16[64,256] all-gather(bf16[8,256] %s2), dimensions={0}
+  %o = bf16[16,256] dot(bf16[16,64] %h, bf16[64,256] %w2), lhs_contracting_dims={1}, rhs_contracting_dims={0}
+  ROOT %r = f32[8] add(%p, %p)
+}
+
+ENTRY %main (a: f32[128,64]) -> f32[1024,64] {
+  %a = f32[128,64] parameter(0)
+  %w = f32[8] while(f32[8] %init), condition=%cond.1, body=%body.1
+  %lone = f32[1024,64] all-gather(f32[128,64] %a), dimensions={0}
+  ROOT %out = f32[1024,64] copy(%lone)
+}
+"""
+
+
+def test_schedule_audit_classifies_exposed_vs_overlappable():
+    """The canonical per-layer shape: gather w1 -> dot(h) -> gather w2 ->
+    dot(o). w1's gather has NO independent compute (both dots are its
+    descendants) -> exposed; w2's gather is independent of the first dot
+    (dot h neither feeds nor consumes it) -> overlappable. The entry's lone
+    gather with no compute at all -> exposed."""
+    s = audit_schedule(HLO_SCHEDULE, 8, loop_trip_count=24)
+    ag = s["by_kind"]["all-gather"]
+    assert ag["exposed_count"] == 2      # w1 (in-body) + lone (entry)
+    assert ag["overlappable_count"] == 1  # w2 hides behind dot h
+    frac = 7 / 8
+    w1 = 256 * 64 * 2 * frac * 24        # while body: x24 trips
+    w2 = 64 * 256 * 2 * frac * 24
+    lone = 1024 * 64 * 4 * frac
+    assert abs(ag["exposed_bytes"] - (w1 + lone)) < 1.0
+    assert abs(ag["overlappable_bytes"] - w2) < 1.0
+    assert s["exposed_fraction"] == pytest.approx(
+        (w1 + lone) / (w1 + w2 + lone))
+    # the top-exposed list names the biggest offender with its computation
+    top = s["top_exposed"][0]
+    assert top["kind"] == "all-gather" and top["exposed"]
+    assert top["computation"] in ("body.1", "main")
+    # overlappable ops carry their independent-flops headroom
+    assert all(o["independent_compute_flops"] > 0
+               for o in [op for op in s["top_exposed"]] if not o["exposed"])
+
+
+def test_schedule_audit_async_pair_overlap_window():
+    """An async start/done pair is ONE collective; compute that is neither
+    an ancestor of the start nor a descendant of the done is its overlap
+    window. A dot consuming the -done result does not count."""
+    hlo = """
+HloModule test
+
+ENTRY %main (a: f32[128,64]) -> f32[64,64] {
+  %a = f32[128,64] parameter(0)
+  %ags = (f32[128,64], f32[1024,64]) all-gather-start(f32[128,64] %a), dimensions={0}
+  %indep = f32[64,64] dot(f32[64,128] %b1, f32[128,64] %b2), lhs_contracting_dims={1}, rhs_contracting_dims={0}
+  %agd = f32[1024,64] all-gather-done((f32[128,64], f32[1024,64]) %ags)
+  %dep = f32[64,64] dot(f32[64,1024] %c1, f32[1024,64] %agd), lhs_contracting_dims={1}, rhs_contracting_dims={0}
+  ROOT %out = f32[64,64] copy(%dep)
+}
+"""
+    s = audit_schedule(hlo, 8)
+    ag = s["by_kind"]["all-gather"]
+    assert ag["overlappable_count"] == 1 and ag["exposed_count"] == 0
+    # counted once (start+done merged), at the gathered-result size
+    assert abs(ag["overlappable_bytes"] - 1024 * 64 * 4 * (7 / 8)) < 1.0
+    # without the independent dot the same pair is exposed
+    s2 = audit_schedule(hlo.replace(
+        "  %indep = f32[64,64] dot(f32[64,128] %b1, f32[128,64] %b2), lhs_contracting_dims={1}, rhs_contracting_dims={0}\n",
+        ""), 8)
+    assert s2["by_kind"]["all-gather"]["exposed_count"] == 1
+
+
+def test_check_budgets_flags_exposed_regression():
+    report = {
+        "collectives": {"all-gather": {"wire_bytes": 2e9, "by_dtype": {}}},
+        "total_wire_bytes": 2e9,
+        "fp32_param_bytes_per_chip": 0.0,
+        "schedule": {"exposed_bytes": 1.2e9, "overlappable_bytes": 0.8e9,
+                     "exposed_fraction": 0.6},
+    }
+    v = check_budgets(report, {"exposed_gb_max": 1.0})
+    assert len(v) == 1 and "exposed" in v[0] and "overlap regression" in v[0]
+    v = check_budgets(report, {"exposed_fraction_max": 0.5})
+    assert len(v) == 1 and "exposed fraction" in v[0]
+    assert not check_budgets(report, {"exposed_gb_max": 1.5,
+                                      "exposed_fraction_max": 0.7})
+    # reports predating the schedule audit stay checkable
+    del report["schedule"]
+    assert not check_budgets(report, {"exposed_gb_max": 1.0})
+
+
 def test_check_budgets_flags_fp32_regression():
     report = {
         "collectives": {
@@ -189,6 +289,19 @@ def test_bf16_gather_audit_within_budget(devices8):
     # master-weight discipline: fp32 args stay ~3 x 4 x P / N
     assert report["fp32_param_bytes_per_chip"] < \
         3 * 4 * report["n_params"] / 8 * 1.10 + 64e6
+    # the schedule audit ran on the real program and its exposed-bytes
+    # budget is part of the check_budgets() gate above (tiny-test/8/bf16
+    # carries exposed_gb_max + exposed_fraction_max); sanity-pin its shape
+    sched = report["schedule"]
+    assert sched["n_collectives"] > 0
+    assert 0.0 < sched["exposed_fraction"] < 1.0
+    assert sched["exposed_bytes"] + sched["overlappable_bytes"] == \
+        pytest.approx(sum(v["exposed_bytes"] + v["overlappable_bytes"]
+                          for v in sched["by_kind"].values()))
+    # today's per-layer schedule: the grad reduce-scatters all have backward
+    # compute to hide behind — a regression that serializes them flips this
+    rs = sched["by_kind"]["reduce-scatter"]
+    assert rs["exposed_bytes"] == 0.0 and rs["overlappable_count"] > 0
 
 
 def test_bf16_halves_block_gather_wire_vs_fp32(devices8):

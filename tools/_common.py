@@ -1,8 +1,69 @@
 """Shared helpers for the benchmark/profiling tools."""
 
+import hashlib
+import json
 import os
+import subprocess
+import time as _time
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _git_sha():
+    try:
+        out = subprocess.run(["git", "rev-parse", "--short=12", "HEAD"],
+                             cwd=_REPO, capture_output=True, text=True,
+                             timeout=10)
+        if out.returncode == 0:
+            sha = out.stdout.strip()
+            dirty = subprocess.run(["git", "status", "--porcelain"],
+                                   cwd=_REPO, capture_output=True, text=True,
+                                   timeout=10)
+            if dirty.returncode == 0 and dirty.stdout.strip():
+                sha += "-dirty"
+            return sha
+    except (OSError, subprocess.SubprocessError):
+        pass
+    return "unknown"
+
+
+def run_stamp(config=None):
+    """Provenance stamp for every bench/audit JSON artifact: git SHA,
+    config hash, and the backend that produced the numbers.
+
+    A proxy run (CPU smoke, wedged-tunnel fallback) and an on-chip run of
+    the same tool produce byte-similar artifacts; BENCH_r03–r05 proved that
+    without an embedded backend/SHA they get confused later. ``config`` is
+    any JSON-able object describing the run's knobs; its sha256 prefix pins
+    "same code, same config" across artifacts.
+    """
+    stamp = {
+        "git_sha": _git_sha(),
+        "stamp_time": _time.strftime("%Y-%m-%dT%H:%M:%S%z"),
+    }
+    if config is not None:
+        blob = json.dumps(config, sort_keys=True, default=str)
+        stamp["config_hash"] = hashlib.sha256(blob.encode()).hexdigest()[:12]
+    try:
+        import jax
+
+        dev = jax.devices()[0]
+        stamp["backend"] = {
+            "platform": dev.platform,
+            "device_kind": dev.device_kind,
+            "n_devices": jax.device_count(),
+            "jax": jax.__version__,
+            "forced_cpu": os.environ.get("BENCH_FORCE_CPU") == "1",
+        }
+    except Exception:  # stamping must never sink the tool
+        stamp["backend"] = {"platform": "unknown"}
+    return stamp
+
+
+def stamp_record(record, config=None):
+    """Attach ``run_stamp`` under ``record["provenance"]`` (in place)."""
+    record["provenance"] = run_stamp(config)
+    return record
 
 
 def setup_compile_cache():

@@ -214,6 +214,13 @@ def run_open_loop(args):
         "compile_counts": engine.serving.compile_counts(),
         "n_params_m": round(n_params / 1e6, 1),
     }
+    from _common import stamp_record
+
+    stamp_record(artifact, config={
+        "family": args.family, "size": size, "mode": mode, "qps": args.qps,
+        "num_requests": args.num_requests, "slots": args.slots,
+        "queue_depth": args.queue_depth, "prompts": prompts,
+        "new_tokens": args.new_tokens, "seed": args.seed})
     print(json.dumps(artifact), flush=True)
     if args.output:
         with open(args.output, "w") as f:

@@ -121,21 +121,40 @@ def build_and_audit(preset_name, n_devices, micro, gather_dtype,
     return report
 
 
-def print_report(report):
+def print_report(report, top_exposed=0):
     print(f"\n## collective audit: {report['preset']} x "
           f"{report['devices']} devices, micro={report['micro_per_chip']}, "
           f"gather_dtype={report['gather_dtype']}, "
           f"grad_reduce_dtype={report['grad_reduce_dtype']}\n")
+    sched = report.get("schedule", {})
+    by_kind = sched.get("by_kind", {})
     for kind, s in report["collectives"].items():
         if s["count"]:
             dt = ", ".join(f"{k}: {v / 1e9:.2f} GB"
                            for k, v in sorted(s["by_dtype"].items()))
-            print(f"- {kind}: {s['count']} ops, "
-                  f"{s['wire_bytes'] / 1e9:.2f} GB wire/chip/step ({dt})")
+            line = (f"- {kind}: {s['count']} ops, "
+                    f"{s['wire_bytes'] / 1e9:.2f} GB wire/chip/step ({dt})")
+            sk = by_kind.get(kind)
+            if sk and (sk["exposed_count"] or sk["overlappable_count"]):
+                line += (f" | exposed {sk['exposed_bytes'] / 1e9:.2f} GB "
+                         f"({sk['exposed_count']} ops), overlappable "
+                         f"{sk['overlappable_bytes'] / 1e9:.2f} GB "
+                         f"({sk['overlappable_count']} ops)")
+            print(line)
     print(f"- TOTAL: {report['total_wire_bytes'] / 1e9:.2f} GB/chip/step; "
           f"by dtype: "
           + ", ".join(f"{k}: {v / 1e9:.2f} GB"
                       for k, v in sorted(report["total_by_dtype"].items())))
+    if sched:
+        print(f"- SCHEDULE: exposed {sched['exposed_bytes'] / 1e9:.2f} GB "
+              f"({sched['exposed_fraction']:.1%} of wire) vs overlappable "
+              f"{sched['overlappable_bytes'] / 1e9:.2f} GB — dependence-graph "
+              f"bound: 'overlappable' means independent compute exists to "
+              f"hide behind, not that the backend achieved it")
+        for o in sched.get("top_exposed", [])[:top_exposed]:
+            print(f"  exposed: {o['kind']} {o['dtype']} "
+                  f"{o['wire_bytes'] / 1e9:.3f} GB in {o['computation']}"
+                  + (" (async)" if o.get("async") else ""))
     print(f"- fp32 argument (master/opt-state) bytes/chip: "
           f"{report['fp32_param_bytes_per_chip'] / 1e9:.3f} GB "
           f"(sharded fp32 state ~ 3 x 4 x P / N = "
@@ -145,7 +164,7 @@ def print_report(report):
 def child(args):
     os.environ.setdefault("BENCH_FORCE_CPU", "1")
     sys.path.insert(0, os.path.join(REPO, "tools"))
-    from _common import maybe_force_cpu
+    from _common import maybe_force_cpu, stamp_record
 
     maybe_force_cpu()
     t0 = time.time()
@@ -153,6 +172,10 @@ def child(args):
                              args.gather_dtype, args.grad_reduce_dtype,
                              gather_impl=args.gather_impl)
     report["audit_seconds"] = round(time.time() - t0, 1)
+    stamp_record(report, config={
+        "preset": args.preset, "devices": args.devices, "micro": args.micro,
+        "gather_dtype": args.gather_dtype, "gather_impl": args.gather_impl,
+        "grad_reduce_dtype": args.grad_reduce_dtype})
     print(json.dumps(report))
     return 0
 
@@ -175,6 +198,10 @@ def main():
     ap.add_argument("--timeout", type=float, default=3600.0)
     ap.add_argument("--child", action="store_true")
     ap.add_argument("--out", default=None)
+    ap.add_argument("--top-exposed", type=int, default=5,
+                    help="list the N largest EXPOSED collectives (ops whose "
+                         "computation has no independent compute to hide "
+                         "their wire time behind)")
     args = ap.parse_args()
     if args.child:
         return child(args)
@@ -212,7 +239,7 @@ def main():
         print(f"child failed rc={proc.returncode}", file=sys.stderr)
         return 1
 
-    print_report(report)
+    print_report(report, top_exposed=args.top_exposed)
     if args.out:
         with open(args.out, "w") as f:
             json.dump(report, f, indent=1)

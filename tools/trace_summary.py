@@ -1,0 +1,187 @@
+"""Trace summary CLI: per-phase step-time table + exposed-share flagging.
+
+Reads the structured JSONL a ``SpanTracer`` writes (``spans.jsonl``, plus
+the ``TraceFileMonitor``'s ``scalars.jsonl`` when pointed at a trace dir)
+and prints:
+
+- a per-step table of phase durations (data / fwd / bwd / step /
+  train_batch / checkpoint spans carrying a ``step`` arg, same-named spans
+  within a step summed);
+- each step's ``Comm/exposed_frac`` (the schedule audit's exposed share of
+  collective wire, emitted by the engine under ``comms_logger.enabled``),
+  FLAGGED when it exceeds the budget — ``--max-exposed-frac`` directly, or
+  ``--budget <key>``'s ``exposed_fraction_max`` from
+  ``tools/collective_budgets.json``;
+- a serving rollup (request count, p50/p99 TTFT/TPOT) when the trace holds
+  ``request/*`` lifecycle events.
+
+Exit code 3 when any step is flagged and ``--fail-on-flag`` is set (the CI
+teeth: an overlap regression shows up as a step whose exposed share jumped).
+
+    python tools/trace_summary.py traces/MyJob
+    python tools/trace_summary.py traces/MyJob --budget tiny-test/8/bf16 \
+        --fail-on-flag --json trace_summary.json
+"""
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from deepspeed_tpu.telemetry import (counters_by_step, load_jsonl,  # noqa: E402
+                                     phase_table, request_metrics)
+
+
+def percentile(samples, q):
+    if not samples:
+        return None
+    s = sorted(samples)
+    return s[min(len(s) - 1, max(0, int(round(q / 100.0 * (len(s) - 1)))))]
+
+
+def load_trace(path, scalars_path=None):
+    """(span_events, scalar_rows) from a trace dir or a spans.jsonl file."""
+    if os.path.isdir(path):
+        spans_file = os.path.join(path, "spans.jsonl")
+        if scalars_path is None:
+            cand = os.path.join(path, "scalars.jsonl")
+            scalars_path = cand if os.path.exists(cand) else None
+    else:
+        spans_file = path
+    if not os.path.exists(spans_file):
+        raise FileNotFoundError(f"no spans.jsonl at {spans_file}")
+    events = load_jsonl(spans_file)
+    scalars = load_jsonl(scalars_path) if scalars_path else []
+    return events, scalars
+
+
+def summarize(events, scalars, max_exposed_frac=None):
+    """The machine-readable rollup the table is printed from."""
+    steps, phases = phase_table(events)
+    exposed = counters_by_step(scalars, "Comm/exposed_frac") if scalars else {}
+    rows = []
+    for step, durs in steps.items():
+        frac = exposed.get(step)
+        flagged = (max_exposed_frac is not None and frac is not None
+                   and frac > max_exposed_frac)
+        rows.append({"step": step,
+                     "phases_ms": {p: durs[p] * 1e3 for p in durs},
+                     "exposed_frac": frac, "flagged": flagged})
+    summary = {
+        "phases": phases,
+        "steps": rows,
+        "p50_ms": {p: percentile(
+            [r["phases_ms"][p] for r in rows if p in r["phases_ms"]], 50)
+            for p in phases},
+        "flagged_steps": [r["step"] for r in rows if r["flagged"]],
+        "max_exposed_frac": max_exposed_frac,
+    }
+    reqs = request_metrics(events)
+    if reqs:
+        ttfts = [r["ttft"] for r in reqs.values() if r["ttft"] is not None]
+        tpots = [r["tpot"] for r in reqs.values() if r["tpot"] is not None]
+        shed = sum(1 for r in reqs.values() if r["shed_reason"])
+        summary["serving"] = {
+            "requests": len(reqs), "shed": shed,
+            "ttft_ms": {"p50": percentile(ttfts, 50),
+                        "p99": percentile(ttfts, 99)},
+            "tpot_ms": {"p50": percentile(tpots, 50),
+                        "p99": percentile(tpots, 99)},
+        }
+        for blk in (summary["serving"]["ttft_ms"],
+                    summary["serving"]["tpot_ms"]):
+            for k, v in blk.items():
+                blk[k] = None if v is None else round(v * 1e3, 3)
+    return summary
+
+
+def print_summary(summary):
+    phases = summary["phases"]
+    if summary["steps"]:
+        header = "| step | " + " | ".join(f"{p} ms" for p in phases)
+        if any(r["exposed_frac"] is not None for r in summary["steps"]):
+            header += " | exposed_frac |"
+        else:
+            header += " |"
+        print(header)
+        print("|" + "---|" * (header.count("|") - 1))
+        for r in summary["steps"]:
+            cells = [str(r["step"])]
+            for p in phases:
+                ms = r["phases_ms"].get(p)
+                cells.append("-" if ms is None else f"{ms:.2f}")
+            if any(x["exposed_frac"] is not None for x in summary["steps"]):
+                frac = r["exposed_frac"]
+                cell = "-" if frac is None else f"{frac:.3f}"
+                if r["flagged"]:
+                    cell += " **OVER BUDGET**"
+                cells.append(cell)
+            print("| " + " | ".join(cells) + " |")
+        p50 = summary["p50_ms"]
+        print("| p50 | " + " | ".join(
+            "-" if p50.get(p) is None else f"{p50[p]:.2f}" for p in phases)
+            + (" | |" if any(r["exposed_frac"] is not None
+                             for r in summary["steps"]) else " |"))
+    if summary["flagged_steps"]:
+        print(f"\nFLAGGED: steps {summary['flagged_steps']} exceed the "
+              f"exposed-collective budget "
+              f"({summary['max_exposed_frac']}) — overlap regression?")
+    srv = summary.get("serving")
+    if srv:
+        print(f"\nserving: {srv['requests']} requests ({srv['shed']} shed), "
+              f"TTFT p50 {srv['ttft_ms']['p50']} ms / p99 "
+              f"{srv['ttft_ms']['p99']} ms, TPOT p50 {srv['tpot_ms']['p50']} "
+              f"ms / p99 {srv['tpot_ms']['p99']} ms (trace clock units x1e3 "
+              f"under a virtual clock)")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("trace", help="trace dir (spans.jsonl + scalars.jsonl) "
+                                  "or a spans.jsonl path")
+    ap.add_argument("--scalars", default=None,
+                    help="scalars.jsonl path (defaults to the trace dir's)")
+    ap.add_argument("--max-exposed-frac", type=float, default=None,
+                    help="flag steps whose Comm/exposed_frac exceeds this")
+    ap.add_argument("--budget", default=None,
+                    help="key into tools/collective_budgets.json; uses its "
+                         "exposed_fraction_max as the flag threshold")
+    ap.add_argument("--fail-on-flag", action="store_true",
+                    help="exit 3 if any step exceeds the exposed budget")
+    ap.add_argument("--json", default=None,
+                    help="also write the summary as a JSON artifact")
+    args = ap.parse_args(argv)
+
+    threshold = args.max_exposed_frac
+    if args.budget:
+        with open(os.path.join(REPO, "tools",
+                               "collective_budgets.json")) as f:
+            budgets = json.load(f)
+        if args.budget not in budgets:
+            print(f"no budget {args.budget!r}", file=sys.stderr)
+            return 1
+        threshold = budgets[args.budget].get("exposed_fraction_max",
+                                             threshold)
+
+    events, scalars = load_trace(args.trace, args.scalars)
+    summary = summarize(events, scalars, max_exposed_frac=threshold)
+    print_summary(summary)
+    if args.json:
+        sys.path.insert(0, os.path.join(REPO, "tools"))
+        from _common import stamp_record
+
+        stamp_record(summary, config={"trace": args.trace,
+                                      "threshold": threshold})
+        with open(args.json, "w") as f:
+            json.dump(summary, f, indent=1)
+        print(f"\nwrote {args.json}")
+    if summary["flagged_steps"] and args.fail_on_flag:
+        return 3
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
