@@ -475,6 +475,31 @@ class InferenceEngine:
             self._serving = ServingEngine(self)
         return self._serving
 
+    def decode_program_report(self, loop_trip_count=1):
+        """Static audit of the serving decode program: collective wire bytes,
+        schedule split, AND the program-sanitizer findings (dtype leaks,
+        donation coverage of the slot-pool state, host transfers, replicated
+        tensors, peak-HBM estimate) — the serving-side analogue of
+        ``DeepSpeedEngine.collective_wire_stats``. Triggers one audit
+        compile of the decode step (pass-dump pipeline, compilation cache
+        off for that compile)."""
+        from ..profiling.collectives import audit_lowered
+        from ..profiling.sanitizer import (ATTENTION_F32_ALLOW,
+                                           merge_reports, sanitize_jaxpr)
+
+        sv = self.serving
+        dtype = {jnp.bfloat16: "bf16", jnp.float16: "f16"}.get(
+            self.dtype, "f32")
+        cfg = {"compute_dtype": dtype, "allow": list(ATTENTION_F32_ALLOW)}
+        n = max(self.mesh.devices.size, 1)
+        lowered, jaxpr = sv.trace_decode()
+        report = audit_lowered(lowered, n, loop_trip_count=loop_trip_count,
+                               sanitizer_config=cfg)
+        if jaxpr is not None:
+            report["sanitizer"] = merge_reports(
+                report["sanitizer"], sanitize_jaxpr(jaxpr, config=cfg))
+        return report
+
     @property
     def config(self):
         return self._config

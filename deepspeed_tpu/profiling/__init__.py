@@ -3,7 +3,11 @@ from .collectives import (audit_lowered, check_budgets,
                           parse_collectives_by_dtype)
 from .flops_profiler import (FlopsProfiler, get_model_profile,
                              get_module_profile, transformer_train_flops)
+from .sanitizer import (sanitize_hlo, sanitize_jaxpr, sanitize_lowered,
+                        merge_reports, count_at_or_above)
 
 __all__ = ["FlopsProfiler", "get_model_profile", "get_module_profile",
            "transformer_train_flops", "parse_collectives_by_dtype",
-           "compile_with_partitioned_hlo", "audit_lowered", "check_budgets"]
+           "compile_with_partitioned_hlo", "audit_lowered", "check_budgets",
+           "sanitize_hlo", "sanitize_jaxpr", "sanitize_lowered",
+           "merge_reports", "count_at_or_above"]

@@ -421,12 +421,23 @@ def compile_with_partitioned_hlo(lowered):
     return compiled, text
 
 
-def audit_lowered(lowered, n_devices, loop_trip_count=1):
+def audit_lowered(lowered, n_devices, loop_trip_count=1,
+                  sanitizer_config=None):
     """Compile + parse: the full wire report for one lowered step program,
-    including the exposed-vs-overlappable schedule split."""
+    including the exposed-vs-overlappable schedule split. Pass
+    ``sanitizer_config`` (a dict of ``sanitizer.DEFAULTS`` overrides — at
+    minimum ``{"compute_dtype": ...}``) to also run the static program
+    sanitizer over the same snapshot and attach its report as a
+    ``sanitizer`` section."""
     compiled, hlo = compile_with_partitioned_hlo(lowered)
     stats = parse_collectives_by_dtype(hlo, n_devices, loop_trip_count)
     schedule = audit_schedule(hlo, n_devices, loop_trip_count)
+    sanitizer = None
+    if sanitizer_config is not None:
+        from .sanitizer import sanitize_hlo
+
+        sanitizer = sanitize_hlo(hlo, sanitizer_config, n_devices,
+                                 loop_trip_count)
     mem = compiled.memory_analysis()
     body_names = stats.pop("_loop_body_computations")
     total = sum(s["wire_bytes"] for s in stats.values())
@@ -434,7 +445,7 @@ def audit_lowered(lowered, n_devices, loop_trip_count=1):
     for s in stats.values():
         for dt, b in s["by_dtype"].items():
             by_dtype[dt] = by_dtype.get(dt, 0.0) + b
-    return {
+    report = {
         "collectives": stats,
         "schedule": schedule,
         "total_wire_bytes": total,
@@ -449,6 +460,9 @@ def audit_lowered(lowered, n_devices, loop_trip_count=1):
         },
         "hlo_bytes": len(hlo),
     }
+    if sanitizer is not None:
+        report["sanitizer"] = sanitizer
+    return report
 
 
 def check_budgets(report, budget, n_params=None, n_devices=None):
@@ -486,6 +500,11 @@ def check_budgets(report, budget, n_params=None, n_devices=None):
             v.append(f"exposed fraction {sched['exposed_fraction']:.3f} of "
                      f"collective wire exceeds budget "
                      f"{budget['exposed_fraction_max']} (schedule audit)")
+    if "sanitizer" in budget and report.get("sanitizer") is not None:
+        from .sanitizer import check_sanitizer_budgets
+
+        v.extend(check_sanitizer_budgets(report["sanitizer"],
+                                         budget["sanitizer"]))
     if budget.get("masters_sharded_fp32") and n_params and n_devices:
         # sharded fp32 state (params + adam moments) ~= 3 x 4 x P / N;
         # 10% + 64 MB slack covers replicated small leaves

@@ -886,15 +886,17 @@ class DeepSpeedEngine:
             return self._apply_body(params, opt_state, acc_grads, scale,
                                     good_steps, lr)
 
-        # Donate params + opt state only: grads (arg 2) have the same
-        # shapes/dtypes as the params but there are only len(outputs) buffers to
-        # alias (new_params + new_state), so donating them too makes XLA report
-        # one whole param-tree of "donated buffers were not usable" — the grads
-        # buffer is freed after the step either way (engine drops its reference).
+        # Donate params + opt state (NOT grads: arg 2 has the same
+        # shapes/dtypes as the params but there are only len(outputs) buffers
+        # to alias — new_params + new_state — so donating them too makes XLA
+        # report one whole param-tree of "donated buffers were not usable";
+        # the grads buffer is freed after the step either way, the engine
+        # drops its reference). scale/good_steps are engine-owned and have
+        # matching outputs, so they donate too (sanitizer donation rule).
         with self.mesh:
             self._apply_fn = jax.jit(
                 apply_step,
-                donate_argnums=(0, 1),
+                donate_argnums=(0, 1, 3, 4),
                 out_shardings=(
                     self.param_shardings,
                     self._opt_shardings,
@@ -979,10 +981,16 @@ class DeepSpeedEngine:
                     mean_loss, new_rng)
 
         rep = NamedSharding(self.mesh, P())
+        # Donate the engine-owned step state threaded through the program:
+        # params, opt state, AND the loss-scale/good-steps/rng scalars (each
+        # has a same-shape output to alias; the engine overwrites its
+        # references right after the call, so the stale inputs are dead
+        # either way — found by the program sanitizer's donation rule). lr
+        # and the batch are caller-owned and have no matching output.
         with self.mesh:
             self._train_step_fn = jax.jit(
                 train_step,
-                donate_argnums=(0, 1),
+                donate_argnums=(0, 1, 3, 4, 5),
                 out_shardings=(self.param_shardings, self._opt_shardings,
                                rep, rep, rep, rep, rep, rep),
             )
@@ -1588,10 +1596,16 @@ class DeepSpeedEngine:
             jnp.asarray(0.0, jnp.float32), jnp.asarray(1.0, jnp.float32))
         trip = getattr(self.module.config, "n_layers", 1) \
             if getattr(self.module.config, "scan_layers", False) else 1
+        from ..profiling.sanitizer import ATTENTION_F32_ALLOW
+
+        dtype = {jnp.bfloat16: "bf16", jnp.float16: "f16"}.get(
+            self.compute_dtype, "f32")
         self._wire_stats = audit_lowered(
             lowered, self.dp_world_size * self.mp_world_size
             * self.pipe_stages * self.seq_parallel_size,
-            loop_trip_count=trip)
+            loop_trip_count=trip,
+            sanitizer_config={"compute_dtype": dtype,
+                              "allow": list(ATTENTION_F32_ALLOW)})
         return self._wire_stats
 
     def _report_progress(self):

@@ -237,6 +237,25 @@ class ServingEngine:
                                         out_shardings=st)
             self._sample_first_jit = jax.jit(sample_first, out_shardings=rep)
 
+    def trace_decode(self):
+        """``(lowered, jaxpr-or-None)`` of the decode program over the live
+        slot pool — the entry point for the static sanitizer /
+        ``tools/program_lint.py``. ONE trace serves both views (tracing only
+        builds avals: nothing executes, and the donation annotations ride
+        along for the audit); jax versions without ``jit(...).trace`` fall
+        back to ``lower()`` and a None jaxpr."""
+        if self._decode_jit is None:
+            self._build_pool_programs()
+        trace = getattr(self._decode_jit, "trace", None)
+        if trace is not None:
+            t = trace(self.engine.params, self._state)
+            return t.lower(), t.jaxpr
+        return self._decode_jit.lower(self.engine.params, self._state), None
+
+    def lower_decode(self):
+        """The lowered (uncompiled) decode program (see ``trace_decode``)."""
+        return self.trace_decode()[0]
+
     def compile_counts(self):
         """Compiled-program census, pinned by the tier-1 no-recompile test:
         the decode step compiles exactly once per (model, slot-pool)

@@ -302,6 +302,22 @@ def test_bf16_gather_audit_within_budget(devices8):
     # compute to hide behind — a regression that serializes them flips this
     rs = sched["by_kind"]["reduce-scatter"]
     assert rs["exposed_bytes"] == 0.0 and rs["overlappable_count"] > 0
+    # the SANITIZER section rode the same snapshot and its per-rule budgets
+    # (tiny-test/8/bf16 carries a "sanitizer" sub-dict) are part of the
+    # check_budgets() gate above; pin the structural facts it proves:
+    san = report["sanitizer"]
+    assert san["summary"]["counts"]["error"] == 0
+    assert san["summary"]["transfer_count"] == 0
+    # donation discipline: params + opt state + scale/good_steps/rng all
+    # alias outputs (64 inputs; pre-PR-5-donation-fix this was 61) — only
+    # the caller-owned lr and the batch ride undonated
+    assert san["summary"]["n_aliased_params"] == 64
+    assert san["summary"]["undonated_candidate_bytes"] == 0
+    # the QK attention einsum is the ALLOWLISTED f32 island; everything else
+    # f32 among dots is the known backward/CE set, fenced by the frac budget
+    assert any(f.get("allowed") and "bqhd,bkhd->bhqk" in (f.get("op_name") or "")
+               for f in san["findings"])
+    assert 0 < san["peak_hbm"]["estimate_bytes"] < 16e6
 
 
 def test_bf16_halves_block_gather_wire_vs_fp32(devices8):

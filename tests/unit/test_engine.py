@@ -45,8 +45,24 @@ def run_steps(config, n=3, model_fn=tiny_lm, seed=0):
 
 
 def test_engine_basic_training_loss_decreases():
-    engine, losses = run_steps(base_config(), n=5)
-    assert losses[-1] < losses[0]
+    """Train on ONE fixed batch so the loss decrease is deterministic.
+
+    The old form drew a fresh random batch per step and compared per-batch
+    losses — at 5 steps / lr 1e-3 the inter-batch loss variance exceeds the
+    optimization signal, so the assertion flipped with the environment's rng
+    stream (observed 4.8567 vs 4.8503 on this box, identical at the parent
+    commit — a seed flake, not a regression). Memorizing a fixed batch must
+    reduce that batch's loss regardless of rng details."""
+    engine, _, _, _ = deepspeed_tpu.initialize(model=tiny_lm(),
+                                               config=base_config())
+    batch = lm_batch(seed=0)
+    losses = []
+    for _ in range(5):
+        loss = engine.forward(batch)
+        engine.backward(loss)
+        engine.step()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0], losses
     assert engine.global_steps == 5
 
 

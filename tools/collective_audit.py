@@ -46,7 +46,8 @@ def load_budget(key):
 
 
 def build_and_audit(preset_name, n_devices, micro, gather_dtype,
-                    grad_reduce_dtype, gather_impl="shard_map"):
+                    grad_reduce_dtype, gather_impl="shard_map",
+                    sanitize=True):
     """Abstract-init the engine, lower the fused ZeRO-3 per_layer train step,
     audit it. Importable: the tier-1 test calls this in-process with the
     conftest's 8 virtual devices."""
@@ -110,8 +111,35 @@ def build_and_audit(preset_name, n_devices, micro, gather_dtype,
         engine.params, engine.optimizer_state, batch, engine._scale,
         engine._good_steps, engine._rng, jnp.asarray(1e-4, jnp.float32),
         jnp.asarray(1.0, jnp.float32))
+    # the sanitizer rides the same post-SPMD snapshot: the train program is
+    # configured bf16 compute (fp32/int8 only change the GATHER wire dtype);
+    # the f32 attention-logits einsum is intentional numerics, not a leak
+    from deepspeed_tpu.profiling.sanitizer import ATTENTION_F32_ALLOW
+
+    sanitizer_config = {
+        "compute_dtype": "bf16",
+        "allow": list(ATTENTION_F32_ALLOW),
+    } if sanitize else None
     report = audit_lowered(lowered, n_devices,
-                           loop_trip_count=preset["n_layers"])
+                           loop_trip_count=preset["n_layers"],
+                           sanitizer_config=sanitizer_config)
+    if sanitize:
+        # jaxpr-level recompile hazards (baked constants, scalar args) merge
+        # into the same sanitizer section; old jax without jit(...).trace
+        # just skips this half
+        trace = getattr(engine._train_step_fn, "trace", None)
+        if trace is not None:
+            from deepspeed_tpu.profiling.sanitizer import (merge_reports,
+                                                           sanitize_jaxpr)
+
+            args = (engine.params, engine.optimizer_state, batch,
+                    engine._scale, engine._good_steps, engine._rng,
+                    jnp.asarray(1e-4, jnp.float32),
+                    jnp.asarray(1.0, jnp.float32))
+            report["sanitizer"] = merge_reports(
+                report["sanitizer"],
+                sanitize_jaxpr(trace(*args).jaxpr, example_args=args,
+                               config=sanitizer_config))
     report.update({
         "preset": preset_name, "devices": n_devices, "micro_per_chip": micro,
         "seq": seq, "n_params": engine.num_parameters,
@@ -159,6 +187,20 @@ def print_report(report, top_exposed=0):
           f"{report['fp32_param_bytes_per_chip'] / 1e9:.3f} GB "
           f"(sharded fp32 state ~ 3 x 4 x P / N = "
           f"{3 * 4 * report['n_params'] / report['devices'] / 1e9:.3f} GB)")
+    san = report.get("sanitizer")
+    if san:
+        s = san["summary"]
+        print(f"- SANITIZER: {s['counts']['error']} errors, "
+              f"{s['counts']['warning']} warnings, {s['counts']['info']} "
+              f"info | f32 dot flops {s['f32_dot_flops_frac']:.1%}, "
+              f"undonated candidates "
+              f"{s['undonated_candidate_bytes'] / 1e6:.2f} MB, "
+              f"host transfers {s['transfer_count']}, replicated "
+              f"{s['replicated_bytes'] / 1e6:.1f} MB; est peak HBM "
+              f"{san['peak_hbm']['estimate_bytes'] / 1e9:.3f} GB/chip "
+              f"(XLA temp+args "
+              f"{(report['memory_per_chip']['temp'] + report['memory_per_chip']['arguments']) / 1e9:.3f} GB) "
+              f"— see tools/program_lint.py for the finding list")
 
 
 def child(args):
@@ -240,10 +282,7 @@ def main():
         return 1
 
     print_report(report, top_exposed=args.top_exposed)
-    if args.out:
-        with open(args.out, "w") as f:
-            json.dump(report, f, indent=1)
-        print(f"- wrote {args.out}")
+    violations = None
     if args.budget:
         sys.path.insert(0, REPO)
         from deepspeed_tpu.profiling.collectives import check_budgets
@@ -252,10 +291,21 @@ def main():
         violations = check_budgets(report, budget,
                                    n_params=report["n_params"],
                                    n_devices=report["devices"])
+        # the artifact records its own gate result: a committed report that
+        # says budget_pass=true was actually checked, not just generated
+        report["budget"] = args.budget
+        report["budget_pass"] = not violations
         if violations:
-            for msg in violations:
-                print(f"BUDGET VIOLATION: {msg}", file=sys.stderr)
-            return 2
+            report["budget_violations"] = violations
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(report, f, indent=1)
+        print(f"- wrote {args.out}")
+    if violations:
+        for msg in violations:
+            print(f"BUDGET VIOLATION: {msg}", file=sys.stderr)
+        return 2
+    if args.budget:
         print(f"- budget {args.budget!r}: PASS")
     return 0
 

@@ -1,0 +1,275 @@
+"""Repo-level JAX-pitfall lint: a Python-AST pass over ``deepspeed_tpu/``.
+
+The program sanitizer (``tools/program_lint.py``) reads compiled programs;
+this tool reads the SOURCE for the bug class that never survives to an HLO
+dump because it silently bakes at trace time:
+
+- ``time.time()`` / ``time.perf_counter()`` / ``datetime.now()`` inside a
+  traced function — the trace-time value is frozen into the compiled
+  program; every subsequent step reuses it.
+- ``np.random.*`` inside a traced function — trace-time randomness, frozen:
+  every step replays the same "random" numbers (use ``jax.random`` with a
+  threaded key).
+- ``.item()`` / ``float()`` / ``int()`` on a traced value — a concretization
+  point: TracerError at best, a silent host sync at worst. Only ``.item()``
+  is flagged (``float``/``int`` calls are too common on genuine Python
+  scalars to lint without types).
+
+"Traced" is computed statically: a function is traced when it is passed to
+``jax.jit`` / ``vmap`` / ``pmap`` / ``grad`` / ``value_and_grad`` /
+``checkpoint`` / ``remat`` / ``shard_map`` / ``lax.scan`` / ``while_loop`` /
+``cond`` / ``fori_loop`` / ``custom_vjp`` (by name, lambda, or inline def),
+is decorated with one of those, or is DEFINED INSIDE a traced function
+(closures trace with their parent); calls from a traced function to another
+function defined in the same module propagate one module-local transitive
+closure. This over-approximates (a helper also called from host code is
+linted in full) and under-approximates (cross-module calls are not
+followed) — both are the right trade for a lint.
+
+Known-clean sites live in the inline ALLOWLIST below (file:function, with a
+reason). ``tests/unit/test_repo_lint.py`` runs this as a tier-1 gate:
+zero un-allowlisted findings in ``deepspeed_tpu/``.
+
+    python tools/repo_lint.py                 # lint the package, exit 1 on findings
+    python tools/repo_lint.py --list-traced   # show what the pass considers traced
+"""
+
+import argparse
+import ast
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PACKAGE = os.path.join(REPO, "deepspeed_tpu")
+
+# call targets whose function-valued arguments trace (attribute tail match:
+# jax.jit, jax.lax.scan, jax.experimental.shard_map.shard_map, ...)
+TRACING_CALLS = {
+    "jit", "vmap", "pmap", "grad", "value_and_grad", "checkpoint", "remat",
+    "shard_map", "scan", "while_loop", "cond", "fori_loop", "switch",
+    "custom_vjp", "custom_jvp", "associative_scan", "eval_shape", "vjp",
+    "linearize", "make_jaxpr",
+}
+
+# file:qualname -> reason; findings here are reported as allowed (exit 0)
+ALLOWLIST = {
+    # host-side RNG used to BUILD example inputs, not inside the traced fn
+}
+
+PITFALLS = {
+    "time.time": "trace-time timestamp frozen into the compiled program",
+    "time.perf_counter": "trace-time timestamp frozen into the program",
+    "datetime.now": "trace-time timestamp frozen into the program",
+    "datetime.datetime.now": "trace-time timestamp frozen into the program",
+    "datetime.utcnow": "trace-time timestamp frozen into the program",
+    "np.random": "trace-time randomness frozen: every step replays the same "
+                 "draws (thread a jax.random key instead)",
+    "numpy.random": "trace-time randomness frozen (thread a jax.random key)",
+    ".item": "concretizes a traced value: TracerError, or a silent host "
+             "sync if it slips through on a concrete intermediate",
+}
+
+
+def _attr_chain(node):
+    """Dotted name of a Name/Attribute chain: ``jax.lax.scan`` -> that
+    string; unknown shapes -> ''. """
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    return ".".join(reversed(parts))
+
+
+class _ModuleLint:
+    def __init__(self, path, tree):
+        self.path = path
+        self.rel = os.path.relpath(path, REPO)
+        self.tree = tree
+        # qualname -> FunctionDef; parent links for nesting
+        self.funcs = {}
+        self.parent = {}
+        self._index(tree, prefix="", parent=None)
+        self.traced = set()
+
+    def _index(self, node, prefix, parent):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                q = f"{prefix}{child.name}"
+                self.funcs[q] = child
+                self.parent[q] = parent
+                self._index(child, prefix=q + ".", parent=q)
+            elif isinstance(child, ast.ClassDef):
+                self._index(child, prefix=f"{prefix}{child.name}.",
+                            parent=parent)
+            else:
+                self._index(child, prefix=prefix, parent=parent)
+
+    # ------------------------------------------------- traced-set discovery
+    def _qual_of_name(self, name, scope):
+        """Resolve a bare function name used at ``scope`` to a qualname:
+        innermost enclosing definition wins (closures shadow module scope)."""
+        while True:
+            cand = f"{scope}.{name}" if scope else name
+            if cand in self.funcs:
+                return cand
+            if scope is None:
+                return None
+            scope = self.parent.get(scope)
+
+    def discover_traced(self):
+        """Seed: decorator or call-argument positions of TRACING_CALLS;
+        grow: nested defs inside traced functions, plus module-local calls
+        FROM traced functions (one transitive closure to fixpoint)."""
+        seeds = set()
+
+        for q, fn in self.funcs.items():
+            for dec in fn.decorator_list:
+                target = dec.func if isinstance(dec, ast.Call) else dec
+                tail = _attr_chain(target).rsplit(".", 1)[-1]
+                if tail in TRACING_CALLS:
+                    seeds.add(q)
+
+        class CallScan(ast.NodeVisitor):
+            def __init__(self, outer, scope):
+                self.outer, self.scope = outer, scope
+
+            def visit_Call(self, node):
+                tail = _attr_chain(node.func).rsplit(".", 1)[-1]
+                if tail in TRACING_CALLS:
+                    for arg in list(node.args) + [k.value
+                                                  for k in node.keywords]:
+                        if isinstance(arg, ast.Name):
+                            q = self.outer._qual_of_name(arg.id, self.scope)
+                            if q:
+                                seeds.add(q)
+                self.generic_visit(node)
+
+        for q, fn in self.funcs.items():
+            CallScan(self, q).generic_visit(fn)
+        CallScan(self, None).visit(self.tree)
+
+        # nested defs inside traced functions trace too
+        def add_with_children(q):
+            if q in self.traced:
+                return
+            self.traced.add(q)
+            for other, par in self.parent.items():
+                if par == q:
+                    add_with_children(other)
+
+        for q in seeds:
+            add_with_children(q)
+
+        # module-local transitive closure: calls FROM traced fns
+        changed = True
+        while changed:
+            changed = False
+            for q in list(self.traced):
+                fn = self.funcs[q]
+                for node in ast.walk(fn):
+                    if isinstance(node, ast.Call) and \
+                            isinstance(node.func, ast.Name):
+                        callee = self._qual_of_name(node.func.id, q)
+                        if callee and callee not in self.traced:
+                            add_with_children(callee)
+                            changed = True
+        return self.traced
+
+    # ----------------------------------------------------------- pitfalls
+    def findings(self):
+        self.discover_traced()
+        out = []
+        for q in sorted(self.traced):
+            fn = self.funcs[q]
+            # don't descend into nested defs (at any depth — inside if/for/
+            # with blocks too): they are linted as their own traced entries,
+            # so descending here would double-report under the parent's name
+            # and break per-function allowlisting
+            nested = set()
+            for node in ast.walk(fn):
+                if node is not fn and isinstance(
+                        node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    nested.update(id(sub) for sub in ast.walk(node))
+            for node in ast.walk(fn):
+                if id(node) in nested or not isinstance(node, ast.Call):
+                    continue
+                chain = _attr_chain(node.func)
+                hit = reason = None
+                for pat, why in PITFALLS.items():
+                    if pat == ".item":
+                        if isinstance(node.func, ast.Attribute) and \
+                                node.func.attr == "item":
+                            hit, reason = ".item()", why
+                    elif pat.endswith(".random"):
+                        if chain.startswith(pat + ".") or chain == pat:
+                            hit, reason = chain, why
+                    elif chain == pat or chain.endswith("." + pat):
+                        hit, reason = chain, why
+                    if hit:
+                        break
+                if hit:
+                    key = f"{self.rel}:{q}"
+                    out.append({
+                        "file": self.rel, "line": node.lineno,
+                        "function": q, "pattern": hit, "reason": reason,
+                        "allowed": key in ALLOWLIST,
+                        "allow_reason": ALLOWLIST.get(key),
+                    })
+        return out
+
+
+def lint_paths(root=PACKAGE):
+    findings, traced = [], {}
+    for dirpath, _, files in os.walk(root):
+        for name in sorted(files):
+            if not name.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, name)
+            try:
+                tree = ast.parse(open(path).read(), filename=path)
+            except SyntaxError as e:  # lint must not crash on one bad file
+                findings.append({"file": os.path.relpath(path, REPO),
+                                 "line": e.lineno or 0, "function": "<parse>",
+                                 "pattern": "syntax-error", "reason": str(e),
+                                 "allowed": False, "allow_reason": None})
+                continue
+            mod = _ModuleLint(path, tree)
+            findings.extend(mod.findings())
+            if mod.traced:
+                traced[mod.rel] = sorted(mod.traced)
+    return findings, traced
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--root", default=PACKAGE)
+    ap.add_argument("--list-traced", action="store_true")
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args()
+    findings, traced = lint_paths(args.root)
+    if args.list_traced:
+        for rel, fns in sorted(traced.items()):
+            print(f"{rel}: {', '.join(fns)}")
+        return 0
+    if args.json:
+        print(json.dumps({"findings": findings}, indent=1))
+    else:
+        for f in findings:
+            tag = " (allowlisted)" if f["allowed"] else ""
+            print(f"{f['file']}:{f['line']} [{f['function']}] "
+                  f"{f['pattern']} — {f['reason']}{tag}")
+    bad = [f for f in findings if not f["allowed"]]
+    if bad:
+        print(f"{len(bad)} JAX-pitfall findings "
+              f"({len(findings) - len(bad)} allowlisted)", file=sys.stderr)
+        return 1
+    print(f"repo lint clean: {sum(len(v) for v in traced.values())} traced "
+          f"functions across {len(traced)} modules, 0 findings")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
