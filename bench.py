@@ -356,6 +356,14 @@ def run_benchmark():
         # A forced-CPU debug run must never read as a real TPU datum at the
         # top level: vs_baseline is zeroed and the mode is marked.
         "vs_baseline": 0.0 if forced_cpu else round(mfu / 0.40, 4),
+        # numerics self-incrimination next to the run stamp: a "fast" run
+        # that silently skipped half its steps (or tripped the health
+        # watchdog) says so in its own artifact
+        "numerics": {
+            "skipped_steps": engine.skipped_steps,
+            "final_loss_scale": float(engine.loss_scale),
+            "health_anomalies": engine.health.anomaly_count,
+        },
         "extra": {
             "mfu": round(mfu, 4),
             "achieved_tflops": round(achieved_tflops, 2),
@@ -429,6 +437,11 @@ def run_cpu_proxy():
         "unit": UNIT,
         "vs_baseline": 0.0,  # a host-CPU proxy can never claim MFU progress
         "backend": "cpu_proxy",
+        "numerics": {
+            "skipped_steps": engine.skipped_steps,
+            "final_loss_scale": float(engine.loss_scale),
+            "health_anomalies": engine.health.anomaly_count,
+        },
         "extra": {
             "note": "TPU tunnel unavailable; CPU-mesh proxy on a scaled-down "
                     "model (n_layers=4, d_model=256, seq=256) through the "

@@ -360,6 +360,70 @@ class TelemetryConfig(ConfigModel):
                 f"telemetry.max_events must be >= 1, got {self.max_events}")
 
 
+class HealthConfig(ConfigModel):
+    """Numerics flight recorder (``telemetry/health.py``): per-param-group
+    health stats computed inside the jitted step (always traced as a small
+    side output), a host-side ring buffer + anomaly watchdog (this block
+    arms it), and atomically-committed black-box dumps on detector fire /
+    SIGTERM / unhandled train_batch exceptions. Detector actions:
+    ``off | warn | skip_step | dump | halt`` — ``skip_step`` is realized
+    in-graph (the fp16 overflow-skip generalized to any-dtype non-finite
+    grads) and only applies to the nonfinite detector; ``halt`` dumps and
+    raises ``HealthHalted``. On the serving side, ``enabled`` arms the
+    nonfinite-logit watchdog (``Serving/health_*`` events + the
+    ``unhealthy_slot`` shed)."""
+
+    enabled: bool = False
+    # ring buffer length (steps kept for the black-box dump) and the
+    # observe cadence (1 = every step; observing syncs the step's stats)
+    window: int = 256
+    check_interval: int = 1
+    # write Health/* scalar events through the monitor fan-out per observe
+    emit_events: bool = True
+    # detector: any non-finite grad/param element, naming the param group
+    nonfinite_action: str = "dump"
+    # detector: z-score spike of loss / grad_norm over a trailing window
+    spike_zscore: float = 6.0
+    spike_window: int = 32
+    spike_min_steps: int = 8
+    spike_action: str = "warn"
+    # detector: per-group update/param ratio ceiling (0 disables)
+    update_ratio_max: float = 0.0
+    update_ratio_action: str = "warn"
+    # black-box dump root ("" -> ./health_dumps), dump triggers, and the
+    # per-run dump cap (a flapping detector must not fill the disk)
+    dump_dir: str = ""
+    max_dumps: int = 8
+    dump_on_exception: bool = True
+    dump_on_signal: bool = True
+
+    def _validate(self):
+        from ..telemetry.health import ACTIONS
+
+        for field in ("nonfinite_action", "spike_action",
+                      "update_ratio_action"):
+            v = getattr(self, field)
+            if v not in ACTIONS:
+                raise ConfigError(
+                    f"health.{field} must be one of {'|'.join(ACTIONS)}, "
+                    f"got {v!r}")
+        if self.window < 8:
+            raise ConfigError(
+                f"health.window must be >= 8 (detectors need history), "
+                f"got {self.window}")
+        if self.check_interval < 1:
+            raise ConfigError(
+                f"health.check_interval must be >= 1, got "
+                f"{self.check_interval}")
+        if self.spike_window < 1 or self.spike_min_steps < 1:
+            raise ConfigError(
+                f"health.spike_window and health.spike_min_steps must be "
+                f">= 1, got {self.spike_window}/{self.spike_min_steps}")
+        if self.max_dumps < 1:
+            raise ConfigError(
+                f"health.max_dumps must be >= 1, got {self.max_dumps}")
+
+
 class FlopsProfilerConfig(ConfigModel):
     """Reference: ``profiling/config.py``."""
 
@@ -432,6 +496,7 @@ class DeepSpeedConfig(ConfigModel):
     wandb: WandbConfig = WandbConfig
     csv_monitor: CSVConfig = CSVConfig
     telemetry: TelemetryConfig = TelemetryConfig
+    health: HealthConfig = HealthConfig
     comms_logger: CommsLoggerConfig = CommsLoggerConfig
     flops_profiler: FlopsProfilerConfig = FlopsProfilerConfig
     data_types: DataTypesConfig = DataTypesConfig

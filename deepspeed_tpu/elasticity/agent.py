@@ -59,6 +59,14 @@ class ElasticAgent:
         log_dist(f"ElasticAgent: received signal {signum}; will checkpoint "
                  f"and stop after the current step", ranks=[0])
         self._preempted = True
+        # Numerics black box: preemption is exactly the moment post-mortem
+        # data vanishes — publish the health ring buffer NOW (atomic commit,
+        # host data only, cheap) rather than hoping the final checkpoint
+        # lands inside the grace window. dump() never raises.
+        health = getattr(self.engine, "health", None)
+        if (health is not None and health.enabled
+                and getattr(health.cfg, "dump_on_signal", True)):
+            health.dump(f"signal{signum}")
 
     # -- checkpoint plumbing ------------------------------------------------
     def _tag(self):

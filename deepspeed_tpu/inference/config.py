@@ -8,8 +8,8 @@ graph), kernel-injection flags disappear (XLA fuses the inference kernels).
 import typing
 
 from ..config.base import ConfigModel
-from ..config.config import (CSVConfig, ServingConfig, TelemetryConfig,
-                             TensorBoardConfig, WandbConfig)
+from ..config.config import (CSVConfig, HealthConfig, ServingConfig,
+                             TelemetryConfig, TensorBoardConfig, WandbConfig)
 
 
 class TensorParallelConfig(ConfigModel):
@@ -67,6 +67,10 @@ class DeepSpeedInferenceConfig(ConfigModel):
     # span tracing of serving request lifecycles (queued -> prefill ->
     # first token -> decode steps -> finish/shed); same block as training
     telemetry: TelemetryConfig = None
+    # numerics watchdog for the serving loop: enabled arms the in-graph
+    # nonfinite-logit count's consumers (Serving/health_* events + the
+    # unhealthy_slot shed); same block shape as training
+    health: HealthConfig = None
     quant: QuantizationConfig = None
     moe: MoEInferenceConfig = None
     replace_with_kernel_inject: bool = False  # accepted for config compat; no-op
@@ -93,6 +97,8 @@ class DeepSpeedInferenceConfig(ConfigModel):
             self.csv_monitor = CSVConfig()
         if self.telemetry is None:
             self.telemetry = TelemetryConfig()
+        if self.health is None:
+            self.health = HealthConfig()
         from ..config.base import ConfigError
 
         if self.dtype not in ("float16", "bfloat16", "float32"):

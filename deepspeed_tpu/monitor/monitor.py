@@ -133,7 +133,14 @@ class TraceFileMonitor(Monitor):
 
 
 class MonitorMaster(Monitor):
-    """Reference ``monitor/monitor.py:29``: fan out to all enabled backends."""
+    """Reference ``monitor/monitor.py:29``: fan out to all enabled backends.
+
+    One failing backend (a TensorBoard/W&B import-or-IO error mid-run, a
+    full disk under the CSV dir) must cost its own events, not the training
+    step: each backend's write is isolated, and the first failure logs one
+    warning naming the backend — later failures of the same backend are
+    silent (a wedged writer at ``steps_per_print`` cadence would otherwise
+    flood the log)."""
 
     def __init__(self, config):
         self.backends = [
@@ -143,10 +150,21 @@ class MonitorMaster(Monitor):
             TraceFileMonitor(config),
         ]
         self.enabled = any(b.enabled for b in self.backends)
+        self._failed = set()
 
     def write_events(self, event_list):
         if not event_list or dist.get_rank() != 0:
             return
         for b in self.backends:
-            if b.enabled:
+            if not b.enabled:
+                continue
+            try:
                 b.write_events(event_list)
+            except Exception as e:
+                name = type(b).__name__
+                if name not in self._failed:
+                    self._failed.add(name)
+                    logger.warning(
+                        "monitor backend %s failed to write events (%s); "
+                        "training continues, further %s failures are "
+                        "suppressed", name, e, name)

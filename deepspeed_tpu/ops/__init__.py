@@ -10,6 +10,7 @@ from .lr_schedules import (
 from .loss_scaler import (
     make_scaler_state,
     check_overflow,
+    count_nonfinite,
     update_scale,
     scale_loss,
     unscale_grads,
@@ -33,6 +34,7 @@ __all__ = [
     "SCHEDULES",
     "make_scaler_state",
     "check_overflow",
+    "count_nonfinite",
     "update_scale",
     "scale_loss",
     "unscale_grads",

@@ -48,6 +48,13 @@ def check_overflow(grads):
     return overflow
 
 
+def count_nonfinite(x):
+    """Number of non-finite elements in one array, as an f32 scalar — the
+    counting form of ``check_overflow`` (the health side output wants *how
+    many and where*, not just a flag). Pure; safe inside jit."""
+    return jnp.sum(jnp.logical_not(jnp.isfinite(x))).astype(jnp.float32)
+
+
 def update_scale(scale, good_steps, overflow, loss_scale_window=1000, hysteresis=2,
                  min_scale=1.0, max_scale=2.0 ** 32):
     """Dynamic scale update (reference ``DynamicLossScaler.update_scale``):

@@ -292,6 +292,28 @@ class DeepSpeedEngine:
 
         self.monitor = MonitorMaster(self._config)
 
+        # -- numerics flight recorder (telemetry/health.py) ----------------------
+        # Group definitions derive from the param pytree; the in-graph stats
+        # are ALWAYS a side output of the compiled step (so the sanitizer/
+        # budget gates audit the real program), but the host-side monitor
+        # only reads them — one sync per observed step — when enabled.
+        from ..telemetry.health import HealthMonitor, derive_group_names
+
+        self._health_groups = derive_group_names(
+            self._shapes, is_leaf=lambda x: isinstance(x, tuple))
+        self.health = HealthMonitor(
+            self._config.health, self._health_groups, monitor=self.monitor,
+            meta={"process": "train", "mesh": dict(self.mesh.shape),
+                  "zero_stage": self.zero_stage})
+        # skip_step is the one action realized IN-GRAPH: generalize the fp16
+        # overflow-skip to any-dtype non-finite grads (pre-update, so the
+        # poisoned step never touches params/optimizer state)
+        self._health_skip = bool(
+            self._config.health.enabled
+            and self._config.health.nonfinite_action == "skip_step")
+        self._health_fn = None  # lazy jitted stats for the offloaded path
+        self._health_rng = None  # key that SEEDED the current step's window
+
         # -- explicit ZeRO-3 gather schedule (per-layer constraint in the scan) ------
         if (self.zero_stage >= 3
                 and self._config.zero_optimization.zero3_gather_mode == "per_layer"
@@ -454,6 +476,7 @@ class DeepSpeedEngine:
         # per-step collective wire stats (comms_logger / collective_wire_stats)
         self._wire_stats = None
         self._last_batch_struct = None
+        self._last_loss = None  # unfused path: forward()'s loss for health
 
         log_dist(
             f"DeepSpeedEngine: mesh={dict(self.mesh.shape)} zero_stage={self.zero_stage} "
@@ -646,6 +669,11 @@ class DeepSpeedEngine:
                 "abstract_init does not support 1-bit optimizers (their "
                 "error-feedback buffers are materialized at construction)")
         if self._onebit_active:
+            if self._config.health.enabled:
+                logger.warning(
+                    "health.enabled has no effect on the 1-bit optimizer "
+                    "step path (no in-graph health side output, no "
+                    "skip_step/detectors); the flight recorder stays empty")
             dp = self.mesh.shape[DATA_AXIS]
             L = self.num_parameters
             self._onebit_lpad = -(-L // dp) * dp
@@ -850,7 +878,15 @@ class DeepSpeedEngine:
 
     def _apply_body(self, params, opt_state, acc_grads, scale, good_steps, lr):
         """Unscale -> overflow check -> clip -> optimizer update -> loss-scale
-        update. Shared by the standalone apply program and the fused train step."""
+        update. Shared by the standalone apply program and the fused train step.
+
+        Also computes the per-param-group health side output (tiny f32[G]
+        vectors — see ``telemetry/health.py``) and, when the health config's
+        nonfinite detector is armed with ``skip_step``, generalizes the fp16
+        overflow-skip to any-dtype non-finite grads. The returned flag is the
+        *skip* decision (== overflow for plain fp16)."""
+        from ..telemetry.health import group_health_stats
+
         clip = self._config.gradient_clipping
         fp16 = self.fp16_enabled
         window = self._config.fp16.loss_scale_window
@@ -859,6 +895,7 @@ class DeepSpeedEngine:
 
         inv = (1.0 / scale).astype(jnp.float32)
         grads = jax.tree_util.tree_map(lambda g: g.astype(jnp.float32) * inv, acc_grads)
+        raw_grads = grads  # pre-clip: the health stats price true magnitudes
         overflow = check_overflow(grads) if fp16 else jnp.asarray(False)
         norm = global_grad_norm(grads)
         if clip > 0:
@@ -866,20 +903,26 @@ class DeepSpeedEngine:
         new_params, new_state = self.optimizer.update(
             grads, opt_state, params, lr=lr, wd_mask=self._wd_mask
         )
-        if fp16:
-            # skip the update on overflow (reference FP16_Optimizer.step)
+        skip = overflow
+        if self._health_skip and not fp16:
+            skip = check_overflow(grads)
+        if fp16 or self._health_skip:
+            # skip the update on overflow (reference FP16_Optimizer.step) /
+            # on non-finite grads when the health skip is armed
             new_params = jax.tree_util.tree_map(
-                lambda old, new: jnp.where(overflow, old, new), params, new_params
+                lambda old, new: jnp.where(skip, old, new), params, new_params
             )
             new_state = jax.tree_util.tree_map(
-                lambda old, new: jnp.where(overflow, old, new), opt_state, new_state
+                lambda old, new: jnp.where(skip, old, new), opt_state, new_state
             )
-            if dynamic:
-                scale, good_steps = update_scale(
-                    scale, good_steps, overflow, loss_scale_window=window,
-                    min_scale=min_scale,
-                )
-        return new_params, new_state, scale, good_steps, overflow, norm
+        if fp16 and dynamic:
+            scale, good_steps = update_scale(
+                scale, good_steps, overflow, loss_scale_window=window,
+                min_scale=min_scale,
+            )
+        health = group_health_stats(raw_grads, params, new_params,
+                                    self._health_groups)
+        return new_params, new_state, scale, good_steps, skip, norm, health
 
     def _build_apply(self):
         def apply_step(params, opt_state, acc_grads, scale, good_steps, lr):
@@ -893,6 +936,9 @@ class DeepSpeedEngine:
         # the grads buffer is freed after the step either way, the engine
         # drops its reference). scale/good_steps are engine-owned and have
         # matching outputs, so they donate too (sanitizer donation rule).
+        from ..telemetry.health import HEALTH_STAT_KEYS
+
+        rep = NamedSharding(self.mesh, P())
         with self.mesh:
             self._apply_fn = jax.jit(
                 apply_step,
@@ -900,10 +946,8 @@ class DeepSpeedEngine:
                 out_shardings=(
                     self.param_shardings,
                     self._opt_shardings,
-                    NamedSharding(self.mesh, P()),
-                    NamedSharding(self.mesh, P()),
-                    NamedSharding(self.mesh, P()),
-                    NamedSharding(self.mesh, P()),
+                    rep, rep, rep, rep,
+                    {k: rep for k in HEALTH_STAT_KEYS},
                 ),
             )
 
@@ -975,10 +1019,13 @@ class DeepSpeedEngine:
             grads = constrain(grads)
 
             (new_params, new_state, scale, good_steps,
-             overflow, norm) = self._apply_body(params, opt_state, grads, scale,
-                                                good_steps, lr)
+             overflow, norm, health) = self._apply_body(params, opt_state,
+                                                        grads, scale,
+                                                        good_steps, lr)
             return (new_params, new_state, scale, good_steps, overflow, norm,
-                    mean_loss, new_rng)
+                    mean_loss, new_rng, health)
+
+        from ..telemetry.health import HEALTH_STAT_KEYS
 
         rep = NamedSharding(self.mesh, P())
         # Donate the engine-owned step state threaded through the program:
@@ -992,7 +1039,8 @@ class DeepSpeedEngine:
                 train_step,
                 donate_argnums=(0, 1, 3, 4, 5),
                 out_shardings=(self.param_shardings, self._opt_shardings,
-                               rep, rep, rep, rep, rep, rep),
+                               rep, rep, rep, rep, rep, rep,
+                               {k: rep for k in HEALTH_STAT_KEYS}),
             )
 
     def _can_fuse_train_step(self):
@@ -1033,27 +1081,40 @@ class DeepSpeedEngine:
         self._last_batch_struct = jax.tree_util.tree_map(
             lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype,
                                            sharding=a.sharding), batches)
+        if self.health is not None and self.health.enabled:
+            # the record must pin the key that SEEDS this step (the step fn
+            # donates + replaces self._rng); host copy before the dispatch
+            self._health_rng = np.asarray(self._rng).tolist()
         (self.params, self.optimizer_state, self._scale, self._good_steps,
-         overflow, grad_norm, mean_loss, self._rng) = self._train_step_fn(
+         skip, grad_norm, mean_loss, self._rng, health) = self._train_step_fn(
             self.params, self.optimizer_state, batches, self._scale,
             self._good_steps, self._rng, jnp.asarray(lr, jnp.float32),
             pld_theta,
         )
         self.micro_steps += gas
         self.global_steps += 1
-        if self.fp16_enabled and bool(overflow):
+        skipped = (self.fp16_enabled or self._health_skip) and bool(skip)
+        if skipped:
             self.skipped_steps += 1
             log_dist(
-                f"step {self.global_steps}: fp16 overflow, skipping update "
-                f"(loss scale -> {float(self._scale)})",
+                f"step {self.global_steps}: "
+                + ("fp16 overflow" if self.fp16_enabled
+                   else "non-finite grads (health skip_step)")
+                + f", skipping update (loss scale -> {float(self._scale)})",
                 ranks=[0],
             )
         elif self.lr_scheduler is not None:
             self.lr_scheduler.step()
+        self._observe_health(health, loss=mean_loss, grad_norm=grad_norm,
+                             skipped=skipped, lr=lr, batch=micros)
         if self.global_steps % self._config.steps_per_print == 0:
             events = [("Train/lr", lr, self.global_steps),
                       ("Train/grad_norm", float(grad_norm), self.global_steps),
-                      ("Train/loss", float(mean_loss), self.global_steps)]
+                      ("Train/loss", float(mean_loss), self.global_steps),
+                      ("Train/loss_scale", float(self._scale),
+                       self.global_steps),
+                      ("Train/skipped_steps", float(self.skipped_steps),
+                       self.global_steps)]
             if self._config.comms_logger.enabled:
                 ws = self.collective_wire_stats()
                 if ws:
@@ -1088,6 +1149,36 @@ class DeepSpeedEngine:
                     f"memory: {a.memory_allocated() / 2**30:.2f} GiB in use / "
                     f"{a.total_memory() / 2**30:.2f} GiB", ranks=[0])
         return mean_loss
+
+    def _observe_health(self, stats, loss=None, grad_norm=None, skipped=False,
+                        lr=None, batch=None):
+        """Feed one step's in-graph health side output to the flight
+        recorder (no-op unless ``health.enabled``; the host conversion is
+        the one sync the health path pays). Raises ``HealthHalted`` when a
+        halt-action detector fires — after its black-box dump published."""
+        hm = self.health
+        if hm is None or not hm.enabled or stats is None:
+            return None
+        if self.global_steps % self._config.health.check_interval:
+            return None
+        from ..telemetry.health import (HealthHalted, batch_fingerprint,
+                                        record_from_stats)
+
+        rec = record_from_stats(
+            self.global_steps, self._health_groups, stats,
+            loss=None if loss is None else float(loss),
+            loss_scale=float(self._scale), skipped=bool(skipped),
+            grad_norm=None if grad_norm is None else float(grad_norm),
+            lr=None if lr is None else float(lr),
+            rng=self._health_rng,
+            fingerprint=batch_fingerprint(batch))
+        anomalies = hm.observe(rec)
+        halt = [a for a in anomalies if a.action == "halt"]
+        if halt:
+            raise HealthHalted(
+                f"health detector halt at step {self.global_steps}: "
+                + "; ".join(a.message for a in halt))
+        return anomalies
 
     def _apply_curriculum(self, batch):
         """Truncate sequence-dim leaves to the scheduled difficulty (seqlen
@@ -1293,9 +1384,15 @@ class DeepSpeedEngine:
             if self._fwd_bwd_fn is None:
                 self._build_fwd_bwd()
             batch = self._shard_batch(self._apply_curriculum(batch))
+            if (self.health is not None and self.health.enabled
+                    and self.is_gradient_accumulation_boundary()):
+                # first micro-batch of the window: this key deterministically
+                # seeds every micro-step split the window consumes
+                self._health_rng = np.asarray(self._rng).tolist()
             self._rng, step_rng = jax.random.split(self._rng)
             loss, grads = self._fwd_bwd_fn(self.params, batch, self._scale, step_rng)
             self._cached = (loss, grads)
+            self._last_loss = loss
             sp.fence(self._cached)
             if self._wall_clock_breakdown:
                 self.timers(FORWARD_GLOBAL_TIMER).stop()
@@ -1343,22 +1440,27 @@ class DeepSpeedEngine:
                 self._build_apply()
             lr = self._current_lr()
             (self.params, self.optimizer_state, self._scale,
-             self._good_steps, overflow, grad_norm) = self._apply_fn(
+             self._good_steps, skip, grad_norm, health) = self._apply_fn(
                 self.params, self.optimizer_state, self._acc_grads, self._scale,
                 self._good_steps, jnp.asarray(lr, jnp.float32),
             )
             self._acc_grads = None  # donated; re-seeded by the next backward()
             sp.fence(self.params)
             self.global_steps += 1
-            if self.fp16_enabled and bool(overflow):
+            skipped = (self.fp16_enabled or self._health_skip) and bool(skip)
+            if skipped:
                 self.skipped_steps += 1
                 log_dist(
-                    f"step {self.global_steps}: fp16 overflow, skipping update "
-                    f"(loss scale -> {float(self._scale)})",
+                    f"step {self.global_steps}: "
+                    + ("fp16 overflow" if self.fp16_enabled
+                       else "non-finite grads (health skip_step)")
+                    + f", skipping update (loss scale -> {float(self._scale)})",
                     ranks=[0],
                 )
             elif self.lr_scheduler is not None:
                 self.lr_scheduler.step()
+            self._observe_health(health, loss=self._last_loss,
+                                 grad_norm=grad_norm, skipped=skipped, lr=lr)
             if self._wall_clock_breakdown:
                 self.timers(STEP_GLOBAL_TIMER).stop()
                 # monitor events read WITHOUT reset so the log() line below
@@ -1374,7 +1476,11 @@ class DeepSpeedEngine:
             if self.global_steps % self._config.steps_per_print == 0:
                 self.monitor.write_events(
                     [("Train/lr", lr, self.global_steps),
-                     ("Train/grad_norm", float(grad_norm), self.global_steps)]
+                     ("Train/grad_norm", float(grad_norm), self.global_steps),
+                     ("Train/loss_scale", float(self._scale),
+                      self.global_steps),
+                     ("Train/skipped_steps", float(self.skipped_steps),
+                      self.global_steps)]
                 )
                 self.tracer.flush()
             return grad_norm
@@ -1387,6 +1493,12 @@ class DeepSpeedEngine:
 
         lr = self._current_lr()
         scale_inv = 1.0 / float(self._scale)
+        # only the health path needs the step's inputs held alive (to price
+        # the applied update); otherwise release them on schedule — the
+        # offload path exists for tight device memory
+        grads = old_params = None
+        if self.health is not None and self.health.enabled:
+            grads, old_params = self._acc_grads, self.params
         self.params, grad_norm, overflow = self._offloaded.step(
             self._acc_grads, lr, scale_inv)
         self._acc_grads = None
@@ -1413,10 +1525,31 @@ class DeepSpeedEngine:
             self.timers.log(
                 [FORWARD_GLOBAL_TIMER, BACKWARD_GLOBAL_TIMER, STEP_GLOBAL_TIMER]
             )
+        if self.health is not None and self.health.enabled:
+            # device-side stats for the host-stepped path: one small jitted
+            # program over (grads, old, new) — still no callbacks in-step
+            if self._health_fn is None:
+                from ..telemetry.health import group_health_stats
+
+                groups = self._health_groups
+                with self.mesh:
+                    self._health_fn = jax.jit(
+                        lambda g, old, new, inv: group_health_stats(
+                            jax.tree_util.tree_map(
+                                lambda a: a.astype(jnp.float32) * inv, g),
+                            old, new, groups))
+            stats = self._health_fn(grads, old_params, self.params,
+                                    jnp.asarray(scale_inv, jnp.float32))
+            self._observe_health(stats, loss=self._last_loss,
+                                 grad_norm=grad_norm, skipped=bool(overflow),
+                                 lr=lr)
         if self.global_steps % self._config.steps_per_print == 0:
             self.monitor.write_events(
                 [("Train/lr", lr, self.global_steps),
-                 ("Train/grad_norm", float(grad_norm), self.global_steps)]
+                 ("Train/grad_norm", float(grad_norm), self.global_steps),
+                 ("Train/loss_scale", float(self._scale), self.global_steps),
+                 ("Train/skipped_steps", float(self.skipped_steps),
+                  self.global_steps)]
             )
         return grad_norm
 
@@ -1427,8 +1560,26 @@ class DeepSpeedEngine:
         loss is a device scalar — not synced — so back-to-back calls pipeline.
         Exception: fp16's dynamic loss scaling must read the overflow flag each
         step (as the reference's ``FP16_Optimizer.step`` does), which syncs;
-        the pipelining guarantee holds for bf16/fp32.
+        the pipelining guarantee holds for bf16/fp32 (and for the health
+        monitor's per-step observe when ``health.enabled``, which also syncs).
         """
+        try:
+            return self._train_batch_impl(data_iter=data_iter, batch=batch)
+        except Exception as e:
+            # black-box on the way down: an unhandled step exception
+            # publishes the ring buffer before propagating. HealthHalted
+            # already dumped (the halt action fires dump first).
+            from ..telemetry.health import HealthHalted
+
+            if (self.health is not None and self.health.enabled
+                    and self._config.health.dump_on_exception
+                    and not isinstance(e, HealthHalted)):
+                self.health.dump("exception",
+                                 extra={"exception": repr(e),
+                                        "step": self.global_steps})
+            raise
+
+    def _train_batch_impl(self, data_iter=None, batch=None):
         step_no = self.global_steps + 1
         with self.tracer.span("train_batch", cat="train",
                               sync=self._telemetry_sync, step=step_no):

@@ -10,9 +10,9 @@ from .clock import VirtualClock, WallClock
 from .engine import ServingEngine
 from .metrics import ServingMetrics, percentile
 from .queue import RequestQueue
-from .request import (FINISH_EOS, FINISH_LENGTH, REJECT_PROMPT_TOO_LONG,
-                      REJECT_QUEUE_FULL, Request, RequestState,
-                      SamplingParams, TokenEvent, as_request)
+from .request import (FINISH_EOS, FINISH_LENGTH, FINISH_UNHEALTHY,
+                      REJECT_PROMPT_TOO_LONG, REJECT_QUEUE_FULL, Request,
+                      RequestState, SamplingParams, TokenEvent, as_request)
 from .scheduler import ServingScheduler, simulate_static_batching
 
 __all__ = [
@@ -31,6 +31,7 @@ __all__ = [
     "simulate_static_batching",
     "FINISH_EOS",
     "FINISH_LENGTH",
+    "FINISH_UNHEALTHY",
     "REJECT_QUEUE_FULL",
     "REJECT_PROMPT_TOO_LONG",
 ]

@@ -32,8 +32,9 @@ from ..utils.logging import log_dist
 from .clock import VirtualClock, WallClock
 from .metrics import ServingMetrics
 from .queue import RequestQueue
-from .request import (FINISH_EOS, FINISH_LENGTH, FINISH_STOP, Request,
-                      RequestState, TokenEvent, as_request)
+from .request import (FINISH_EOS, FINISH_LENGTH, FINISH_STOP,
+                      FINISH_UNHEALTHY, Request, RequestState, TokenEvent,
+                      as_request)
 from .scheduler import ServingScheduler
 
 
@@ -75,6 +76,13 @@ class ServingEngine:
         self.metrics = ServingMetrics(self.n_slots, self.clock,
                                       monitor=monitor,
                                       interval=self.cfg.monitor_interval)
+        # numerics watchdog (the serving leg of telemetry/health.py): the
+        # decode program ALWAYS emits the per-slot nonfinite-logit count
+        # (so the sanitizer budget audits the real program); the shed hook
+        # and Serving/health_* consumers arm on the inference config's
+        # health block
+        hcfg = getattr(engine.config, "health", None)
+        self._health_shed = bool(hcfg is not None and hcfg.enabled)
         # request-lifecycle tracing AGAINST THE SCHEDULER CLOCK: under a
         # virtual clock the trace timestamps are virtual time, which is what
         # makes trace-derived TTFT/TPOT bit-identical to ServingMetrics
@@ -174,6 +182,12 @@ class ServingEngine:
             logits, cache = forward_with_cache(
                 model, params, state["tok"][:, None],
                 {"k": state["k"], "v": state["v"]}, state["pos"], max_len)
+            # in-graph health: per-slot nonfinite-logit count (the serving
+            # leg of the numerics flight recorder — one tiny i32[S] side
+            # output, no host callback; the sanitizer budget audits it)
+            nonfinite = jnp.sum(
+                jnp.logical_not(jnp.isfinite(logits[:, 0])),
+                axis=-1).astype(jnp.int32)
             nxt = sample_token(logits[:, 0], split[:, 0],
                                temperature=state["temp"],
                                top_k=state["top_k"], top_p=state["top_p"])
@@ -192,7 +206,7 @@ class ServingEngine:
                 "temp": state["temp"], "top_k": state["top_k"],
                 "top_p": state["top_p"], "eos": state["eos"],
             }
-            return (nxt, done_now), new_state
+            return (nxt, done_now, nonfinite), new_state
 
         def insert(state, slot, k_slot, v_slot, tok, pos, remaining, rng,
                    temp, top_k, top_p, eos):
@@ -222,20 +236,26 @@ class ServingEngine:
                         active=state["active"].at[slot].set(False))
 
         def sample_first(logits, key, temp, top_k, top_p):
-            return sample_token(logits, key[None, :],
-                                temperature=jnp.reshape(temp, (1,)),
-                                top_k=jnp.reshape(top_k, (1,)),
-                                top_p=jnp.reshape(top_p, (1,)))
+            # same in-graph guard as decode: the first token samples from
+            # prefill logits, which must never stream unchecked
+            nonfinite = jnp.sum(
+                jnp.logical_not(jnp.isfinite(logits))).astype(jnp.int32)
+            tok = sample_token(logits, key[None, :],
+                               temperature=jnp.reshape(temp, (1,)),
+                               top_k=jnp.reshape(top_k, (1,)),
+                               top_p=jnp.reshape(top_p, (1,)))
+            return tok, nonfinite
 
         rep, st = self._rep_sharding, self._state_shardings
         with self.engine.mesh:
             self._decode_jit = jax.jit(decode, donate_argnums=(1,),
-                                       out_shardings=((rep, rep), st))
+                                       out_shardings=((rep, rep, rep), st))
             self._insert_jit = jax.jit(insert, donate_argnums=(0,),
                                        out_shardings=st)
             self._release_jit = jax.jit(release, donate_argnums=(0,),
                                         out_shardings=st)
-            self._sample_first_jit = jax.jit(sample_first, out_shardings=rep)
+            self._sample_first_jit = jax.jit(sample_first,
+                                             out_shardings=(rep, rep))
 
     def trace_decode(self):
         """``(lowered, jaxpr-or-None)`` of the decode program over the live
@@ -354,10 +374,28 @@ class ServingEngine:
 
         keys = self._request_key(req)
         s = req.sampling
-        tok = self._sample_first_jit(logits, keys[0], np.float32(s.temperature),
-                                     np.int32(s.top_k), np.float32(s.top_p))
-        t = int(np.asarray(tok)[0])
+        tok, nf = self._sample_first_jit(
+            logits, keys[0], np.float32(s.temperature),
+            np.int32(s.top_k), np.float32(s.top_p))
         now = self.clock.now()
+        nf = int(nf)
+        if nf:
+            # symmetric with decode: the counter reports whether or not the
+            # shed hook is armed
+            self.metrics.record_health_step(1)
+        if self._health_shed and nf:
+            # poisoned prefill: the first token is garbage — shed BEFORE
+            # streaming anything (the request never takes a slot)
+            self.metrics.record_shed("unhealthy_slot")
+            self.metrics.record_unhealthy()
+            self.tracer.instant("request/unhealthy", cat="serving", ts=now,
+                                request_id=req.request_id,
+                                nonfinite_logits=int(nf))
+            self._finish(req, FINISH_UNHEALTHY, now)
+            events.append(TokenEvent(req.request_id, -1, 0, True,
+                                     FINISH_UNHEALTHY, now))
+            return
+        t = int(np.asarray(tok)[0])
         req.state = RequestState.RUNNING
         req.first_token_time = now
         req.tokens.append(t)
@@ -391,15 +429,34 @@ class ServingEngine:
     def _decode_once(self, events):
         with self.tracer.span("decode_step", cat="serving",
                               active=len(self._slots)):
-            (toks, done_now), self._state = self._decode_jit(self.engine.params,
-                                                             self._state)
+            ((toks, done_now, nonfinite),
+             self._state) = self._decode_jit(self.engine.params, self._state)
             self.clock.advance(self.cfg.virtual_decode_step_cost)
         toks = np.asarray(toks)
         done_now = np.asarray(done_now)
+        nonfinite = np.asarray(nonfinite)
         now = self.clock.now()
+        self.metrics.record_health_step(
+            sum(1 for s in self._slots if nonfinite[s] > 0))
         for slot in sorted(self._slots):
             req = self._slots[slot]
             t = int(toks[slot])
+            if self._health_shed and nonfinite[slot] > 0:
+                # the unhealthy_slot hook: this slot's logits went
+                # non-finite — its sampled token is poison, its KV rows are
+                # suspect. Shed the request with a reason (the admission-
+                # control discipline: fail loudly, never stream garbage) and
+                # free + deactivate the slot.
+                self.metrics.record_shed("unhealthy_slot")
+                self.metrics.record_unhealthy()
+                self.tracer.instant(
+                    "request/unhealthy", cat="serving", ts=now,
+                    request_id=req.request_id,
+                    nonfinite_logits=int(nonfinite[slot]))
+                self._finish(req, FINISH_UNHEALTHY, now, deactivate=True)
+                events.append(TokenEvent(req.request_id, -1, len(req.tokens),
+                                         True, FINISH_UNHEALTHY, now))
+                continue
             req.tokens.append(t)
             self.metrics.record_tokens(1)
             if bool(done_now[slot]):

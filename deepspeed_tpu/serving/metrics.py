@@ -9,6 +9,8 @@ the load bench commits as its throughput–latency artifact.
 
 import collections
 
+from .request import FINISH_UNHEALTHY
+
 
 def percentile(samples, q):
     """Nearest-rank percentile (q in [0, 100]); None on no samples."""
@@ -37,6 +39,10 @@ class ServingMetrics:
         self.steps = 0
         self._queue_depth = 0
         self._active_slots = 0
+        # numerics health (fed by the decode program's in-graph
+        # nonfinite-logit count; see serving/engine.py _decode_once)
+        self.nonfinite_logit_steps = 0  # decode steps with >=1 bad active slot
+        self.unhealthy_slots = 0        # requests shed via unhealthy_slot
 
     # -- recording ----------------------------------------------------------
     def _mark_started(self):
@@ -70,9 +76,30 @@ class ServingMetrics:
             self.ttft_samples.append(request.ttft)
 
     def record_finish(self, request):
+        if request.finish_reason == FINISH_UNHEALTHY:
+            # accounted under shed["unhealthy_slot"]: it must not also count
+            # as finished (the shed/finished split partitions offered
+            # requests) and its latency samples are poison — including the
+            # TTFT recorded at first-token time, before the poisoning showed
+            if request.ttft is not None:
+                try:
+                    self.ttft_samples.remove(request.ttft)
+                except ValueError:
+                    pass
+            return
         self.finished += 1
         if request.tpot is not None:
             self.tpot_samples.append(request.tpot)
+
+    def record_health_step(self, n_bad_slots):
+        """Once per decode step (or poisoned prefill): how many ACTIVE
+        computations produced non-finite logits (freed slots decode garbage
+        by design and don't count)."""
+        if n_bad_slots:
+            self.nonfinite_logit_steps += 1
+
+    def record_unhealthy(self):
+        self.unhealthy_slots += 1
 
     def observe_step(self, queue_depth, active_slots):
         """Once per scheduler step; periodically flushes monitor events."""
@@ -98,7 +125,10 @@ class ServingMetrics:
 
     @property
     def shed_rate(self):
-        total = self.submitted + self.shed_total
+        # offered = admitted + admission-time sheds; unhealthy_slot sheds
+        # were ALREADY admitted (counted in submitted), so they move a
+        # request from finished to shed without growing the denominator
+        total = self.submitted + self.shed_total - self.unhealthy_slots
         return self.shed_total / total if total else 0.0
 
     def snapshot(self):
@@ -121,6 +151,10 @@ class ServingMetrics:
             "steps": self.steps,
             "queue_depth": self._queue_depth,
             "slot_occupancy": self._active_slots / max(self.n_slots, 1),
+            "health": {
+                "nonfinite_logit_steps": self.nonfinite_logit_steps,
+                "unhealthy_slots": self.unhealthy_slots,
+            },
         }
 
     def emit_events(self):
@@ -134,6 +168,10 @@ class ServingMetrics:
              self._active_slots / max(self.n_slots, 1), self.steps),
             ("Serving/tokens_per_s", self.tokens_per_s, self.steps),
             ("Serving/shed_total", float(self.shed_total), self.steps),
+            ("Serving/health_nonfinite_steps",
+             float(self.nonfinite_logit_steps), self.steps),
+            ("Serving/health_unhealthy_slots",
+             float(self.unhealthy_slots), self.steps),
         ]
         p50 = percentile(self.ttft_samples, 50)
         if p50 is not None:

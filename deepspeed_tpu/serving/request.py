@@ -28,6 +28,8 @@ REJECT_BAD_REQUEST = "bad_request"
 FINISH_EOS = "eos"
 FINISH_LENGTH = "length"
 FINISH_STOP = "stop"
+# health watchdog shed: the slot's logits went non-finite mid-decode
+FINISH_UNHEALTHY = "unhealthy_slot"
 
 
 @dataclasses.dataclass

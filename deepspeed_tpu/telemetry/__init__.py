@@ -9,6 +9,10 @@ checkpoint save/resume; analyzed by ``tools/trace_summary.py``.
 
 from .analysis import (counters_by_step, load_jsonl, phase_table,
                        request_metrics)
+from .health import (HEALTH_STAT_KEYS, HealthHalted, HealthMonitor,
+                     batch_fingerprint, derive_group_names,
+                     group_health_stats, load_dump, record_from_stats,
+                     replay_records)
 from .tracer import SpanTracer
 
 __all__ = [
@@ -17,4 +21,13 @@ __all__ = [
     "request_metrics",
     "phase_table",
     "counters_by_step",
+    "HEALTH_STAT_KEYS",
+    "HealthHalted",
+    "HealthMonitor",
+    "batch_fingerprint",
+    "derive_group_names",
+    "group_health_stats",
+    "load_dump",
+    "record_from_stats",
+    "replay_records",
 ]

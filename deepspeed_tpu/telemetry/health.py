@@ -1,0 +1,604 @@
+"""Numerics flight recorder: in-graph health stats + host-side watchdog.
+
+The third observability axis next to the span tracer (*time*, PR 4) and the
+program sanitizer (*program shape*, PR 5): **numerical health**. The fp16
+stack treats numerics as a binary overflow flag (``ops/loss_scaler.py``
+``check_overflow`` -> skip step); a run that merely *drifts* — a loss spike,
+one param group's grad norm exploding, quantization drift from the int8
+gather wire — is invisible until it is dead, and when it dies nothing is
+captured for post-mortem. This module closes both gaps:
+
+- :func:`group_health_stats` — per-parameter-group grad-norm, param-norm,
+  update-norm, max-abs and nonfinite counts, computed **inside** the jitted
+  train step as one small extra side output (a handful of ``[G]``-shaped
+  f32 vectors; no host callbacks, so the sanitizer's ``transfer`` rule and
+  the donation budgets stay green). Groups are derived from the param
+  pytree by :func:`derive_group_names` (embeddings / per-layer block
+  components / norms / head).
+
+- :class:`HealthMonitor` — a host-side ring buffer of the last N step
+  records (health stats + loss, loss_scale, skipped flag, rng key, batch
+  fingerprint) with pluggable detectors (nonfinite counts, z-score
+  loss/grad-norm spike over a trailing window, update/param-ratio ceiling)
+  and a configurable action per detector: ``warn | skip_step | dump |
+  halt``. ``skip_step`` is realized *in-graph* (the engine extends the
+  fp16 overflow-skip to any-dtype nonfinite grads); window-based detectors
+  cannot retroactively skip an applied update, so for them ``skip_step``
+  degrades to ``warn``.
+
+- **black-box dumps** — on detector fire, on SIGTERM (hooked through
+  ``ElasticAgent``), and on unhandled ``train_batch`` exceptions, the ring
+  buffer + provenance stamp is published through the
+  ``checkpoint/atomic.py`` commit protocol (stage -> fsync -> CRC marker
+  -> rename), so a crash cannot strand a half-written dump. The marker
+  ``kind="health_dump"`` keeps dumps out of the checkpoint resume chain.
+  ``tools/health_report.py`` renders the timeline and replays detectors.
+"""
+
+import collections
+import json
+import os
+import sys
+import time
+
+from ..utils.logging import logger
+
+#: The in-graph side output: one f32 vector of length ``n_groups`` per key.
+#: Keys are fixed so compiled-program out_shardings stay stable whether or
+#: not the host-side monitor is enabled.
+HEALTH_STAT_KEYS = (
+    "grad_norm",        # per-group L2 norm of the unscaled (pre-clip) grads
+    "grad_max_abs",     # per-group max |g|
+    "grad_nonfinite",   # per-group count of non-finite grad elements
+    "param_norm",       # per-group L2 norm of the (old) fp32 masters
+    "update_norm",      # per-group L2 norm of (new_params - params)
+    "param_nonfinite",  # per-group count of non-finite NEW param elements
+)
+
+ACTIONS = ("off", "warn", "skip_step", "dump", "halt")
+
+
+class HealthHalted(RuntimeError):
+    """Raised by the engine when a detector with ``action="halt"`` fires
+    (after the black-box dump is published)."""
+
+
+# ---------------------------------------------------------------------------
+# param grouping (derived from the pytree, not configured)
+# ---------------------------------------------------------------------------
+def _path_keys(path):
+    out = []
+    for k in path:
+        if hasattr(k, "key"):
+            out.append(str(k.key))
+        elif hasattr(k, "idx"):
+            out.append(str(k.idx))
+        elif hasattr(k, "name"):
+            out.append(str(k.name))
+        else:
+            out.append(str(k))
+    return out
+
+
+def classify_param_path(path):
+    """Map one param-leaf path to its health group.
+
+    The vocabulary mirrors how numerics actually fail: embeddings drift
+    differently from attention blocks, norms are tiny-but-critical, the
+    head sees the loss first. Stacked ``blocks`` split by component
+    (``blocks/attn``, ``blocks/mlp``, ...) — norms anywhere group as
+    ``norms``.
+    """
+    keys = [k.lower() for k in _path_keys(path)]
+    if any(k.startswith("ln") or "norm" in k for k in keys):
+        return "norms"
+    if any("head" in k for k in keys):
+        return "head"
+    if any("emb" in k or k in ("wte", "wpe") for k in keys):
+        return "embeddings"
+    if keys and keys[0] == "blocks":
+        return f"blocks/{keys[1]}" if len(keys) > 1 else "blocks"
+    return "other"
+
+
+def derive_group_names(tree, is_leaf=None):
+    """Stable, first-appearance-ordered group names for a param(-shaped)
+    pytree. The same function classifies leaves at trace time inside
+    :func:`group_health_stats`, so index order always agrees."""
+    import jax
+
+    paths, _ = jax.tree_util.tree_flatten_with_path(tree, is_leaf=is_leaf)
+    names = []
+    for path, _leaf in paths:
+        g = classify_param_path(path)
+        if g not in names:
+            names.append(g)
+    return tuple(names)
+
+
+# ---------------------------------------------------------------------------
+# in-graph stats (traced into the jitted step — no host callbacks)
+# ---------------------------------------------------------------------------
+def group_health_stats(grads, params, new_params, group_names):
+    """Per-group health statistics as ``{key: f32[G]}`` (see
+    :data:`HEALTH_STAT_KEYS`). Pure jnp — safe inside jit; the group
+    membership is resolved at trace time from the grads pytree's paths.
+
+    ``grads`` must be the *unscaled* gradients (the engine computes these
+    before clipping); ``params``/``new_params`` are the step's old and new
+    parameter trees (update_norm prices the applied update — zero on a
+    skipped step).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops.loss_scaler import count_nonfinite
+
+    names = list(group_names)
+    idx = {n: i for i, n in enumerate(names)}
+    G = len(names)
+    zero = jnp.zeros((), jnp.float32)
+    gsq = [zero] * G
+    gmax = [zero] * G
+    gnf = [zero] * G
+    psq = [zero] * G
+    usq = [zero] * G
+    pnf = [zero] * G
+
+    g_paths, _ = jax.tree_util.tree_flatten_with_path(grads)
+    p_leaves = jax.tree_util.tree_leaves(params)
+    n_leaves = jax.tree_util.tree_leaves(new_params)
+    assert len(g_paths) == len(p_leaves) == len(n_leaves), \
+        "grads/params/new_params trees disagree"
+    for (path, g), p, n in zip(g_paths, p_leaves, n_leaves):
+        i = idx[classify_param_path(path)]
+        g32 = g.astype(jnp.float32)
+        gsq[i] = gsq[i] + jnp.sum(g32 * g32)
+        gmax[i] = jnp.maximum(gmax[i], jnp.max(jnp.abs(g32)))
+        gnf[i] = gnf[i] + count_nonfinite(g)
+        p32 = p.astype(jnp.float32)
+        psq[i] = psq[i] + jnp.sum(p32 * p32)
+        d = n.astype(jnp.float32) - p32
+        usq[i] = usq[i] + jnp.sum(d * d)
+        pnf[i] = pnf[i] + count_nonfinite(n)
+    return {
+        "grad_norm": jnp.sqrt(jnp.stack(gsq)),
+        "grad_max_abs": jnp.stack(gmax),
+        "grad_nonfinite": jnp.stack(gnf),
+        "param_norm": jnp.sqrt(jnp.stack(psq)),
+        "update_norm": jnp.sqrt(jnp.stack(usq)),
+        "param_nonfinite": jnp.stack(pnf),
+    }
+
+
+def batch_fingerprint(batch):
+    """Cheap deterministic fingerprint of a host batch (CRC over leaf
+    bytes, key-sorted) — pins *which data* fed the step that went bad.
+    Accepts one micro-batch dict or a sequence of them (a gas>1 window:
+    every micro is chained into one CRC, so two windows differing in ANY
+    micro fingerprint differently)."""
+    import zlib
+
+    import numpy as np
+
+    if batch is None:
+        return None
+    micros = batch if isinstance(batch, (list, tuple)) else [batch]
+    h = 0
+    try:
+        for mb in micros:
+            for k in sorted(mb):
+                h = zlib.crc32(k.encode(), h)
+                h = zlib.crc32(
+                    np.ascontiguousarray(np.asarray(mb[k])).tobytes(), h)
+    except Exception:
+        return None
+    return f"{h & 0xFFFFFFFF:08x}"
+
+
+def record_from_stats(step, group_names, stats, loss=None, loss_scale=1.0,
+                      skipped=False, grad_norm=None, lr=None, rng=None,
+                      fingerprint=None, extra=None):
+    """Build the host-side JSON-able step record from the device stats
+    (this is the one host sync the health path pays per observed step)."""
+    import numpy as np
+
+    host = {k: np.asarray(v, dtype=np.float64) for k, v in stats.items()}
+    groups = {}
+    for i, name in enumerate(group_names):
+        pn = float(host["param_norm"][i])
+        un = float(host["update_norm"][i])
+        groups[name] = {
+            "grad_norm": float(host["grad_norm"][i]),
+            "grad_max_abs": float(host["grad_max_abs"][i]),
+            "grad_nonfinite": float(host["grad_nonfinite"][i]),
+            "param_norm": pn,
+            "update_norm": un,
+            "update_ratio": (un / pn) if pn > 0 else 0.0,
+            "param_nonfinite": float(host["param_nonfinite"][i]),
+        }
+    rec = {
+        "step": int(step),
+        "time": time.time(),
+        "loss": None if loss is None else float(loss),
+        "loss_scale": float(loss_scale),
+        "skipped": bool(skipped),
+        "grad_norm": None if grad_norm is None else float(grad_norm),
+        "lr": None if lr is None else float(lr),
+        "rng": None if rng is None else [int(x) for x in rng],
+        "batch_fingerprint": fingerprint,
+        "groups": groups,
+    }
+    if extra:
+        rec.update(extra)
+    return rec
+
+
+# ---------------------------------------------------------------------------
+# detectors
+# ---------------------------------------------------------------------------
+class Anomaly:
+    __slots__ = ("detector", "action", "step", "message", "groups")
+
+    def __init__(self, detector, action, step, message, groups=()):
+        self.detector = detector
+        self.action = action
+        self.step = step
+        self.message = message
+        self.groups = list(groups)
+
+    def to_dict(self):
+        return {"detector": self.detector, "action": self.action,
+                "step": self.step, "message": self.message,
+                "groups": self.groups}
+
+
+class NonfiniteDetector:
+    """Fires when any group reports non-finite grad or (new-)param
+    elements, naming the offending group(s) with their counts."""
+
+    name = "nonfinite"
+
+    def __init__(self, action):
+        self.action = action
+
+    def check(self, record, history):
+        bad = []
+        for g, s in record.get("groups", {}).items():
+            n = s.get("grad_nonfinite", 0.0) + s.get("param_nonfinite", 0.0)
+            if n and n == n:  # NaN counts can't happen; guard anyway
+                bad.append((g, n))
+        if not bad:
+            return None
+        bad.sort(key=lambda x: -x[1])
+        msg = ", ".join(f"{g} ({int(n)} elems)" for g, n in bad)
+        return Anomaly(self.name, self.action, record["step"],
+                       f"non-finite values in param group(s): {msg}",
+                       groups=[g for g, _ in bad])
+
+
+class SpikeDetector:
+    """Z-score spike on a scalar record field (``loss`` or ``grad_norm``)
+    over a trailing window. The std floor (2% of |mean|) keeps a flat
+    trailing window from firing on benign jitter."""
+
+    def __init__(self, metric, action, zscore=6.0, window=32, min_steps=8):
+        self.metric = metric
+        self.name = f"{metric}_spike"
+        self.action = action
+        self.zscore = float(zscore)
+        # clamp: window=0 would slice the FULL history, min_steps=0 would
+        # divide by zero on an empty prior (CLI overrides bypass config
+        # validation, so the detector defends itself)
+        self.window = max(1, int(window))
+        self.min_steps = max(1, int(min_steps))
+
+    def check(self, record, history):
+        x = record.get(self.metric)
+        if x is None or x != x:  # NaN is the nonfinite detector's job
+            return None
+        prior = [r[self.metric] for r in history
+                 if r.get(self.metric) is not None
+                 and r[self.metric] == r[self.metric]][-self.window:]
+        if len(prior) < self.min_steps:
+            return None
+        mean = sum(prior) / len(prior)
+        var = sum((v - mean) ** 2 for v in prior) / len(prior)
+        std = max(var ** 0.5, 0.02 * abs(mean), 1e-12)
+        z = (x - mean) / std
+        if z <= self.zscore:
+            return None
+        return Anomaly(self.name, self.action, record["step"],
+                       f"{self.metric} spike: {x:.6g} is {z:.1f} sigma above "
+                       f"the trailing-{len(prior)} mean {mean:.6g}")
+
+
+class UpdateRatioDetector:
+    """Fires when any group's update/param ratio exceeds the ceiling — the
+    classic sign of a step about to blow up (lr too high for that group,
+    or a poisoned grad that is still finite)."""
+
+    name = "update_ratio"
+
+    def __init__(self, action, ceiling):
+        self.action = action
+        self.ceiling = float(ceiling)
+
+    def check(self, record, history):
+        bad = [(g, s.get("update_ratio", 0.0))
+               for g, s in record.get("groups", {}).items()
+               if s.get("update_ratio", 0.0) > self.ceiling]
+        if not bad:
+            return None
+        bad.sort(key=lambda x: -x[1])
+        msg = ", ".join(f"{g} ({r:.3g})" for g, r in bad)
+        return Anomaly(self.name, self.action, record["step"],
+                       f"update/param ratio above {self.ceiling:g}: {msg}",
+                       groups=[g for g, _ in bad])
+
+
+def build_detectors(cfg):
+    """Detector set from a ``health`` config block (or any object with the
+    same fields). Window-based detectors degrade ``skip_step`` to ``warn``:
+    by the time a trailing-window statistic fires, the update is applied
+    and the old params are donated away — only the in-graph nonfinite skip
+    can act *before* the update lands."""
+    dets = []
+    if cfg.nonfinite_action != "off":
+        dets.append(NonfiniteDetector(cfg.nonfinite_action))
+    spike_action = cfg.spike_action
+    if spike_action == "skip_step":
+        logger.warning(
+            "health: spike_action=skip_step cannot retroactively skip an "
+            "applied update (trailing-window detector); degrading to warn")
+        spike_action = "warn"
+    if spike_action != "off" and cfg.spike_zscore > 0:
+        dets.append(SpikeDetector("loss", spike_action, cfg.spike_zscore,
+                                  cfg.spike_window, cfg.spike_min_steps))
+        dets.append(SpikeDetector("grad_norm", spike_action, cfg.spike_zscore,
+                                  cfg.spike_window, cfg.spike_min_steps))
+    ur_action = cfg.update_ratio_action
+    if ur_action == "skip_step":
+        logger.warning("health: update_ratio_action=skip_step is post-update "
+                       "by construction; degrading to warn")
+        ur_action = "warn"
+    if cfg.update_ratio_max > 0 and ur_action != "off":
+        dets.append(UpdateRatioDetector(ur_action, cfg.update_ratio_max))
+    return dets
+
+
+def replay_records(records, cfg):
+    """Re-run the detector set over a saved trajectory (the
+    ``health_report`` CLI path and its planted/clean self-test). Actions
+    are not executed — this returns the anomalies a live monitor with this
+    config would have fired."""
+    dets = build_detectors(cfg)
+    history = []
+    fired = []
+    for rec in records:
+        for d in dets:
+            a = d.check(rec, history)
+            if a is not None:
+                fired.append(a)
+        history.append(rec)
+    return fired
+
+
+# ---------------------------------------------------------------------------
+# the host-side monitor
+# ---------------------------------------------------------------------------
+def _provenance(config=None):
+    """The ``tools/_common.py`` run stamp (git SHA + config hash + backend),
+    used verbatim so dumps carry the same provenance as bench artifacts.
+    Degrades to a minimal stamp outside a repo checkout."""
+    tools = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))), "tools")
+    try:
+        if tools not in sys.path:
+            sys.path.insert(0, tools)
+        from _common import run_stamp
+
+        return run_stamp(config)
+    except Exception:
+        return {"git_sha": "unknown",
+                "stamp_time": time.strftime("%Y-%m-%dT%H:%M:%S%z")}
+
+
+class HealthMonitor:
+    """Ring buffer + detectors + black-box dump for one engine.
+
+    ``observe(record)`` runs every detector against the record and the
+    trailing history, executes each fired detector's action (``warn`` logs;
+    ``dump``/``halt`` publish the ring buffer atomically; ``skip_step`` is
+    the engine's in-graph job and logs here), emits ``Health/*`` scalar
+    events through the monitor fan-out, and returns the fired anomalies.
+    The caller decides whether a ``halt`` anomaly raises (the engine does).
+    """
+
+    def __init__(self, config, group_names, monitor=None, meta=None):
+        self.cfg = config
+        self.enabled = bool(config is not None
+                            and getattr(config, "enabled", False))
+        self.group_names = tuple(group_names)
+        self.monitor = monitor
+        self.meta = dict(meta or {})
+        self.records = collections.deque(
+            maxlen=int(getattr(config, "window", 256) or 256))
+        self.detectors = build_detectors(config) if self.enabled else []
+        self.anomalies = []
+        self.steps_observed = 0
+        self.last_step = 0
+        self._dump_count = 0
+        self._dump_cap_warned = False
+
+    @property
+    def anomaly_count(self):
+        return len(self.anomalies)
+
+    def snapshot(self):
+        """Machine-readable rollup (bench provenance rides this)."""
+        return {
+            "enabled": self.enabled,
+            "steps_observed": self.steps_observed,
+            "anomaly_count": self.anomaly_count,
+            "anomalies_by_detector": dict(collections.Counter(
+                a.detector for a in self.anomalies)),
+            "dumps_published": self._dump_count,
+            "last_step": self.last_step,
+        }
+
+    # -- the per-step path --------------------------------------------------
+    def observe(self, record):
+        if not self.enabled:
+            return []
+        history = list(self.records)
+        fired = []
+        for det in self.detectors:
+            a = det.check(record, history)
+            if a is not None:
+                fired.append(a)
+        record = dict(record, anomalies=[a.detector for a in fired])
+        self.records.append(record)
+        self.steps_observed += 1
+        self.last_step = record["step"]
+        for a in fired:
+            self.anomalies.append(a)
+            logger.warning("health[%s/%s] step %d: %s", a.detector, a.action,
+                           a.step, a.message)
+            if a.action in ("dump", "halt"):
+                self.dump(a.detector, extra={"anomaly": a.to_dict()})
+        self._emit_events(record)
+        return fired
+
+    def _emit_events(self, record):
+        if self.monitor is None or not getattr(self.monitor, "enabled", False) \
+                or not getattr(self.cfg, "emit_events", True):
+            return
+        step = record["step"]
+        groups = record.get("groups", {})
+        nonfinite = sum(s.get("grad_nonfinite", 0.0)
+                        + s.get("param_nonfinite", 0.0)
+                        for s in groups.values())
+        ur_max = max((s.get("update_ratio", 0.0) for s in groups.values()),
+                     default=0.0)
+        events = [
+            ("Health/grad_norm", record.get("grad_norm") or 0.0, step),
+            ("Health/loss_scale", record.get("loss_scale", 1.0), step),
+            ("Health/nonfinite", nonfinite, step),
+            ("Health/update_ratio_max", ur_max, step),
+            ("Health/anomalies", float(self.anomaly_count), step),
+        ]
+        if record.get("loss") is not None:
+            events.append(("Health/loss", record["loss"], step))
+        self.monitor.write_events(events)
+
+    # -- the black box ------------------------------------------------------
+    def dump(self, reason, extra=None):
+        """Publish the ring buffer as an atomically-committed dump dir.
+        Never raises — the flight recorder must not take down the flight.
+        Returns the published path (or None)."""
+        try:
+            return self._dump(reason, extra)
+        except Exception as e:
+            logger.warning("health: black-box dump (%s) failed: %s",
+                           reason, e)
+            return None
+
+    def _dump(self, reason, extra=None):
+        from .. import comm as dist
+        from ..checkpoint import atomic
+
+        if dist.get_rank() != 0:
+            return None
+        max_dumps = int(getattr(self.cfg, "max_dumps", 8) or 8)
+        if self._dump_count >= max_dumps:
+            if not self._dump_cap_warned:
+                self._dump_cap_warned = True
+                logger.warning(
+                    "health: dump cap reached (max_dumps=%d); suppressing "
+                    "further black-box dumps this run", max_dumps)
+            return None
+        base = getattr(self.cfg, "dump_dir", "") or "./health_dumps"
+        os.makedirs(base, exist_ok=True)
+        tag = f"health-step{self.last_step}-{reason}"
+        n = 0
+        while os.path.exists(os.path.join(base, tag)):
+            n += 1
+            tag = f"health-step{self.last_step}-{reason}.{n}"
+        path = os.path.join(base, tag)
+        stage = atomic.make_stage_dir(path)
+        blob = ("".join(json.dumps(r) + "\n" for r in self.records)).encode()
+        crcs = {"records.jsonl": atomic.write_bytes(
+            os.path.join(stage, "records.jsonl"), blob)}
+        meta = {
+            "reason": reason,
+            "step": self.last_step,
+            "group_names": list(self.group_names),
+            "meta": self.meta,
+            "anomalies": [a.to_dict() for a in self.anomalies[-100:]],
+            "config": self._config_dict(),
+            "extra": extra or {},
+            "provenance": _provenance(self._config_dict()),
+        }
+        crcs["meta.json"] = atomic.write_json(
+            os.path.join(stage, "meta.json"), meta)
+        atomic.write_marker(stage, tag, meta={"step": self.last_step},
+                            file_crcs=crcs, kind="health_dump")
+        atomic.publish_tag(path)
+        self._dump_count += 1
+        logger.warning("health: black-box dump published: %s (%d records)",
+                       path, len(self.records))
+        return path
+
+    def _config_dict(self):
+        to_dict = getattr(self.cfg, "to_dict", None)
+        if callable(to_dict):
+            try:
+                return to_dict()
+            except Exception:
+                pass
+        return {k: getattr(self.cfg, k) for k in (
+            "enabled", "window", "check_interval", "nonfinite_action",
+            "spike_zscore", "spike_window", "spike_min_steps", "spike_action",
+            "update_ratio_max", "update_ratio_action", "max_dumps")
+            if hasattr(self.cfg, k)}
+
+
+# ---------------------------------------------------------------------------
+# dump loading (shared with tools/health_report.py)
+# ---------------------------------------------------------------------------
+def load_dump(path, verify=True):
+    """Load a black-box dump dir (or a bare records JSONL file). Returns
+    ``(records, meta, verify_result)`` where ``verify_result`` is the
+    ``(ok, reason)`` pair from the atomic marker check (``(True, "jsonl")``
+    for bare files)."""
+    from ..checkpoint import atomic
+
+    if os.path.isfile(path):
+        records = _read_jsonl(path)
+        return records, {}, (True, "jsonl")
+    ok, reason = (True, "not verified")
+    if verify:
+        ok, reason = atomic.verify_checkpoint_dir(path)
+    try:
+        records = _read_jsonl(os.path.join(path, "records.jsonl"))
+    except (OSError, ValueError):
+        if ok:  # marker said good but the records don't parse: surface it
+            raise
+        records = []  # torn dump: the verdict is the verify failure
+    meta = {}
+    meta_path = os.path.join(path, "meta.json")
+    if os.path.exists(meta_path):
+        try:
+            with open(meta_path) as f:
+                meta = json.load(f)
+        except (OSError, ValueError):
+            if ok:
+                raise
+    return records, meta, (ok, reason)
+
+
+def _read_jsonl(path):
+    from .analysis import load_jsonl
+
+    return load_jsonl(path)

@@ -1,0 +1,508 @@
+"""Numerics flight recorder: in-graph health stats, anomaly watchdog,
+black-box dumps (``deepspeed_tpu/telemetry/health.py``).
+
+Four layers:
+
+1. Unit: param-group derivation from the pytree, detectors over planted
+   synthetic trajectories (NaN names its group, a 12x loss spike trips the
+   z-score, clean stays silent), dump atomicity under fault injection.
+2. Engine integration (the acceptance pins): a NaN planted in the
+   embeddings params fires the nonfinite detector NAMING that group and
+   publishes an atomically-committed dump ``health_report`` parses; a
+   clean run produces zero anomalies; ``skip_step`` keeps params bitwise
+   unchanged; Health/* scalars through the TraceFileMonitor equal the ring
+   buffer records for the same steps (trace-monitor coherence).
+3. The serving leg: non-finite logits shed the slot with reason
+   ``unhealthy_slot`` and surface in the Serving/* health counters.
+4. The CLI planted/clean self-test pair as a tier-1 gate (the
+   ``program_lint`` idiom: planted exits 3 under ``--fail-on``, clean 0).
+"""
+
+import glob
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+from deepspeed_tpu.checkpoint import atomic  # noqa: E402
+from deepspeed_tpu.config.config import HealthConfig  # noqa: E402
+from deepspeed_tpu.telemetry.health import (  # noqa: E402
+    HealthHalted,
+    HealthMonitor,
+    classify_param_path,
+    derive_group_names,
+    load_dump,
+    replay_records,
+)
+
+VOCAB, SEQ = 64, 16
+
+
+def _health_cfg(**kw):
+    return HealthConfig.from_dict(dict({"enabled": True}, **kw))
+
+
+def _mk_engine(tmp, **overrides):
+    import jax.numpy as jnp
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models import CausalLM, TransformerConfig
+
+    model = CausalLM(TransformerConfig(
+        vocab_size=VOCAB, max_seq_len=SEQ, n_layers=2, n_heads=2,
+        d_model=16, d_ff=32, compute_dtype=jnp.bfloat16))
+    config = {
+        "train_batch_size": 8,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+        "bf16": {"enabled": True},
+        "steps_per_print": 1,
+        "health": {"enabled": True, "dump_dir": str(tmp)},
+    }
+    for k, v in overrides.items():
+        if isinstance(v, dict) and isinstance(config.get(k), dict):
+            config[k].update(v)
+        else:
+            config[k] = v
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=config)
+    return engine
+
+
+def _batch(seed=0):
+    return {"input_ids": np.random.RandomState(seed).randint(
+        0, VOCAB, (8, SEQ)).astype(np.int32)}
+
+
+def _plant_nan(engine):
+    import jax.numpy as jnp
+
+    engine.params["wte"]["weight"] = \
+        engine.params["wte"]["weight"].at[0, 0].set(jnp.nan)
+
+
+# ---------------------------------------------------------------------------
+# 1. units: grouping, detectors, dump atomicity
+# ---------------------------------------------------------------------------
+def test_group_derivation_covers_every_leaf():
+    shapes = {
+        "wte": {"weight": (8, 4)}, "wpe": {"weight": (8, 4)},
+        "ln_f": {"scale": (4,), "bias": (4,)},
+        "lm_head": {"kernel": (4, 8)},
+        "blocks": {"attn": {"q": {"kernel": (2, 4, 4)}},
+                   "mlp": {"fc": {"kernel": (2, 4, 8)}},
+                   "ln_1": {"scale": (2, 4)}},
+        "extra": {"w": (3,)},
+    }
+    names = derive_group_names(shapes, is_leaf=lambda x: isinstance(x, tuple))
+    assert set(names) == {"embeddings", "norms", "head", "blocks/attn",
+                          "blocks/mlp", "other"}
+    # blocks-internal norms group as norms, not blocks/ln_1
+    assert "blocks/ln_1" not in names
+    assert classify_param_path(("blocks", "ln_1", "scale")) == "norms"
+    assert classify_param_path(("wte", "weight")) == "embeddings"
+    assert classify_param_path(("lm_head", "kernel")) == "head"
+
+
+def _clean_record(step, loss=5.0, gnorm=1.0,
+                  names=("embeddings", "blocks/attn")):
+    groups = {n: {"grad_norm": gnorm * 0.4, "grad_max_abs": 0.1,
+                  "grad_nonfinite": 0.0, "param_norm": 10.0,
+                  "update_norm": 0.01, "update_ratio": 0.001,
+                  "param_nonfinite": 0.0} for n in names}
+    return {"step": step, "loss": loss, "loss_scale": 1.0, "skipped": False,
+            "grad_norm": gnorm, "groups": groups}
+
+
+def test_nonfinite_detector_names_exact_group():
+    recs = [_clean_record(i) for i in range(1, 11)]
+    recs[7]["groups"]["blocks/attn"]["grad_nonfinite"] = 3.0
+    fired = replay_records(recs, _health_cfg())
+    assert len(fired) == 1
+    a = fired[0]
+    assert a.detector == "nonfinite" and a.step == 8
+    assert a.groups == ["blocks/attn"]
+    assert "blocks/attn" in a.message
+
+
+def test_spike_detector_zscore_and_clean_silence():
+    recs = [_clean_record(i, loss=5.0 + 0.05 * ((-1) ** i))
+            for i in range(1, 21)]
+    assert replay_records(recs, _health_cfg()) == []  # clean: zero anomalies
+    recs[15]["loss"] = 60.0  # 12x spike
+    fired = replay_records(recs, _health_cfg())
+    assert [a.detector for a in fired] == ["loss_spike"]
+    assert fired[0].step == 16
+
+
+def test_update_ratio_detector_ceiling():
+    recs = [_clean_record(i) for i in range(1, 4)]
+    recs[-1]["groups"]["embeddings"]["update_ratio"] = 0.5
+    fired = replay_records(recs, _health_cfg(update_ratio_max=0.1))
+    assert [a.detector for a in fired] == ["update_ratio"]
+    assert fired[0].groups == ["embeddings"]
+    # ceiling off (0) -> no detector at all
+    assert replay_records(recs, _health_cfg()) == []
+
+
+def test_spike_dump_pipeline_end_to_end(tmp_path):
+    """Planted loss spike -> z-score detector with action=dump -> an
+    atomically-committed dump that health_report parses (the acceptance's
+    spike half; the NaN half runs through the real engine below)."""
+    cfg = _health_cfg(spike_action="dump", dump_dir=str(tmp_path))
+    hm = HealthMonitor(cfg, ("embeddings", "blocks/attn"))
+    for i in range(1, 21):
+        hm.observe(_clean_record(i, loss=5.0 + 0.05 * ((-1) ** i)))
+    fired = hm.observe(_clean_record(21, loss=60.0))
+    assert [a.detector for a in fired] == ["loss_spike"]
+    dumps = glob.glob(str(tmp_path / "health-*"))
+    assert len(dumps) == 1 and dumps[0].endswith("loss_spike")
+    ok, reason = atomic.verify_checkpoint_dir(dumps[0])
+    assert ok, reason
+    records, meta, (ok, _) = load_dump(dumps[0])
+    assert ok and meta["reason"] == "loss_spike"
+    assert records[-1]["loss"] == 60.0
+    assert records[-1]["anomalies"] == ["loss_spike"]
+    assert meta["provenance"]["git_sha"]  # the tools/_common.py run stamp
+    # marker kind keeps dumps OUT of the checkpoint resume chain
+    assert atomic.read_marker(dumps[0])["kind"] == "health_dump"
+    assert atomic.list_tags(str(tmp_path)) == []
+
+
+def test_dump_is_atomic_under_write_fault(tmp_path):
+    """A crash mid-dump must strand a stage dir, never publish a torn dump
+    — and must not take the training step down with it."""
+    from deepspeed_tpu.testing.fault_injection import FaultInjector
+
+    hm = HealthMonitor(_health_cfg(dump_dir=str(tmp_path)), ("g",))
+    hm.observe(_clean_record(5, names=("g",)))
+    with FaultInjector() as fi:
+        fi.fail_write(match="records.jsonl", times=1)
+        assert hm.dump("crashtest") is None  # swallowed, logged
+    published = [d for d in os.listdir(tmp_path) if not d.endswith(".tmp")]
+    assert published == []
+    # the next attempt (fault cleared) publishes normally
+    path = hm.dump("crashtest")
+    assert path is not None and atomic.verify_checkpoint_dir(path)[0]
+
+
+def test_dump_cap(tmp_path):
+    hm = HealthMonitor(_health_cfg(dump_dir=str(tmp_path), max_dumps=2),
+                       ("g",))
+    hm.observe(_clean_record(1, names=("g",)))
+    assert hm.dump("a") and hm.dump("b")
+    assert hm.dump("c") is None  # capped
+    assert len(glob.glob(str(tmp_path / "health-*"))) == 2
+
+
+def test_monitor_master_survives_failing_backend(tmp_path, monkeypatch):
+    """Satellite: one raising backend costs its own events — never the
+    training step — and warns exactly once."""
+    from deepspeed_tpu.config import load_config
+    from deepspeed_tpu.monitor import monitor as monitor_mod
+
+    mm = monitor_mod.MonitorMaster(load_config({
+        "train_micro_batch_size_per_gpu": 1,
+        "csv_monitor": {"enabled": True, "output_path": str(tmp_path),
+                        "job_name": "ok"}}))
+
+    class BoomBackend:
+        enabled = True
+
+        def write_events(self, events):
+            raise OSError("disk full")
+
+    mm.backends.insert(0, BoomBackend())
+    warns = []
+    monkeypatch.setattr(monitor_mod.logger, "warning",
+                        lambda msg, *a: warns.append(msg % tuple(a)))
+    mm.write_events([("Train/loss", 1.0, 1)])
+    mm.write_events([("Train/loss", 2.0, 2)])
+    assert len(warns) == 1  # once per backend, not per write
+    assert "BoomBackend" in warns[0]
+    # the healthy CSV backend still received BOTH events
+    csv = tmp_path / "ok" / "Train_loss.csv"
+    assert csv.exists() and len(csv.read_text().strip().splitlines()) == 3
+
+
+# ---------------------------------------------------------------------------
+# 2. engine integration
+# ---------------------------------------------------------------------------
+@pytest.fixture(scope="module")
+def clean_run(devices8, tmp_path_factory):
+    """One tiny engine, 4 clean fused steps, telemetry + CSV armed — shared
+    by the clean-trajectory / coherence / monitor-event pins."""
+    tmp = tmp_path_factory.mktemp("health_clean")
+    engine = _mk_engine(
+        tmp / "dumps",
+        telemetry={"enabled": True, "output_path": str(tmp / "traces"),
+                   "job_name": "health"},
+        csv_monitor={"enabled": True, "output_path": str(tmp / "csv"),
+                     "job_name": "health"})
+    losses = [float(engine.train_batch(batch=_batch(i))) for i in range(4)]
+    yield engine, tmp, losses
+    engine.destroy()
+
+
+def test_clean_run_zero_anomalies_full_records(clean_run):
+    engine, _, losses = clean_run
+    hm = engine.health
+    assert hm.anomaly_count == 0
+    assert len(hm.records) == 4
+    rec = hm.records[-1]
+    assert rec["step"] == 4 and rec["loss"] == losses[-1]
+    assert rec["loss_scale"] == 1.0 and rec["skipped"] is False
+    assert rec["rng"] is not None and rec["batch_fingerprint"]
+    # per-group norms recompose to ~ the global grad norm (sqrt sum sq);
+    # the global norm carries a +eps inside the sqrt, hence the tolerance
+    groups = rec["groups"]
+    assert set(groups) == set(engine._health_groups)
+    recomposed = sum(s["grad_norm"] ** 2 for s in groups.values()) ** 0.5
+    assert recomposed == pytest.approx(rec["grad_norm"], rel=1e-3)
+    assert all(s["grad_nonfinite"] == 0 and s["param_nonfinite"] == 0
+               for s in groups.values())
+    assert all(s["update_ratio"] > 0 for s in groups.values())
+
+
+def test_trace_monitor_coherence(clean_run):
+    """Acceptance: Health/* scalars written through the TraceFileMonitor
+    equal the HealthMonitor ring-buffer records for the same steps (the
+    PR 4 trace==metrics discipline, numerics edition)."""
+    engine, tmp, _ = clean_run
+    scalars = {}
+    with open(tmp / "traces" / "health" / "scalars.jsonl") as f:
+        for line in f:
+            e = json.loads(line)
+            scalars[(e["name"], e["step"])] = e["value"]
+    assert any(n.startswith("Health/") for n, _ in scalars)
+    for rec in engine.health.records:
+        step = rec["step"]
+        assert scalars[("Health/loss", step)] == rec["loss"]
+        assert scalars[("Health/grad_norm", step)] == rec["grad_norm"]
+        assert scalars[("Health/loss_scale", step)] == rec["loss_scale"]
+        ur = max(s["update_ratio"] for s in rec["groups"].values())
+        assert scalars[("Health/update_ratio_max", step)] == ur
+        assert scalars[("Health/nonfinite", step)] == 0.0
+
+
+def test_scale_state_monitor_events(clean_run):
+    """Satellite: Train/loss_scale and cumulative Train/skipped_steps ride
+    every steps_per_print boundary next to Train/grad_norm."""
+    engine, tmp, _ = clean_run
+    for name in ("Train_loss_scale", "Train_skipped_steps",
+                 "Train_grad_norm"):
+        csv = tmp / "csv" / "health" / f"{name}.csv"
+        assert csv.exists(), f"missing {name} monitor stream"
+        rows = csv.read_text().strip().splitlines()
+        assert len(rows) == 5  # header + 4 steps at steps_per_print=1
+    assert (tmp / "csv" / "health" / "Train_skipped_steps.csv") \
+        .read_text().strip().splitlines()[-1].endswith("0.0")
+
+
+def test_planted_nan_fires_detector_and_dump(devices8, tmp_path):
+    """Acceptance: a NaN planted in one param group fires the nonfinite
+    detector naming that group and publishes an atomically-committed dump
+    that health_report parses. The same engine then proves the exception
+    trigger: an unhandled train_batch error publishes its own dump."""
+    engine = _mk_engine(tmp_path)
+    engine.train_batch(batch=_batch())       # one clean step
+    _plant_nan(engine)                       # poison the embeddings group
+    engine.train_batch(batch=_batch())
+    fired = [a for a in engine.health.anomalies if a.detector == "nonfinite"]
+    assert fired and "embeddings" in fired[0].groups
+    assert "embeddings" in fired[0].message
+    dumps = glob.glob(str(tmp_path / "health-step2-nonfinite*"))
+    assert len(dumps) == 1
+    ok, reason = atomic.verify_checkpoint_dir(dumps[0])
+    assert ok, reason
+    # the CLI parses it and flags the anomaly via exit code
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "health_report.py"),
+         dumps[0], "--json", "--fail-on", "nonfinite"],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 3, proc.stderr
+    report = json.loads(proc.stdout)
+    assert report["verified"] and report["records"] == 2
+    assert report["nonfinite_steps"] == 1
+    # exception trigger, same engine: 7 rows over an 8-wide data axis
+    with pytest.raises(Exception):
+        engine.train_batch(batch={"input_ids": np.zeros((7, SEQ), np.int32)})
+    exc_dumps = glob.glob(str(tmp_path / "health-*exception*"))
+    assert len(exc_dumps) == 1 and atomic.verify_checkpoint_dir(exc_dumps[0])[0]
+    _, meta, _ = load_dump(exc_dumps[0])
+    assert "ConfigError" in meta["extra"]["exception"]
+    engine.destroy()
+
+
+def test_skip_step_action_keeps_params_bitwise(devices8, tmp_path):
+    """The in-graph skip: nonfinite_action=skip_step generalizes the fp16
+    overflow-skip to bf16 — the poisoned step never touches params or
+    optimizer state, and the skip is accounted."""
+    engine = _mk_engine(tmp_path,
+                        health={"enabled": True,
+                                "nonfinite_action": "skip_step",
+                                "dump_dir": str(tmp_path)})
+    _plant_nan(engine)
+    before = np.asarray(engine.params["ln_f"]["scale"]).copy()
+    m_before = np.asarray(
+        engine.optimizer_state["exp_avg"]["ln_f"]["scale"]).copy() \
+        if "exp_avg" in engine.optimizer_state else None
+    engine.train_batch(batch=_batch())
+    engine.train_batch(batch=_batch())
+    assert engine.skipped_steps == 2
+    assert np.array_equal(before, np.asarray(engine.params["ln_f"]["scale"]))
+    if m_before is not None:
+        assert np.array_equal(m_before, np.asarray(
+            engine.optimizer_state["exp_avg"]["ln_f"]["scale"]))
+    # only the planted group shows param-nonfinite (update never applied)
+    rec = engine.health.records[-1]
+    bad = [g for g, s in rec["groups"].items() if s["param_nonfinite"] > 0]
+    assert bad == ["embeddings"]
+    assert rec["skipped"] is True
+    engine.destroy()
+
+
+def test_halt_action_raises_after_dump(devices8, tmp_path):
+    engine = _mk_engine(tmp_path,
+                        health={"enabled": True, "nonfinite_action": "halt",
+                                "dump_dir": str(tmp_path)})
+    _plant_nan(engine)
+    with pytest.raises(HealthHalted):
+        engine.train_batch(batch=_batch())
+    dumps = glob.glob(str(tmp_path / "health-*-nonfinite*"))
+    assert len(dumps) == 1 and atomic.verify_checkpoint_dir(dumps[0])[0]
+    # the exception hook must NOT double-dump on the way out
+    assert len(glob.glob(str(tmp_path / "health-*"))) == 1
+    engine.destroy()
+
+
+@pytest.mark.faults
+def test_sigterm_mid_training_publishes_dump(devices8, tmp_path):
+    """Fault-injection integration (acceptance): SIGTERM lands mid-run via
+    the ElasticAgent's signal machinery -> the black box publishes
+    atomically, passes fsck-style validation, and health_report reads it."""
+    from deepspeed_tpu.elasticity.agent import ElasticAgent
+    from deepspeed_tpu.testing.fault_injection import sigterm_data_iter
+
+    engine = _mk_engine(tmp_path / "dumps", steps_per_print=1000)
+    agent = ElasticAgent(engine, str(tmp_path / "ckpt"), save_interval=100)
+    it = sigterm_data_iter(iter([_batch(i) for i in range(10)]), at_step=3)
+    status, steps = agent.run(it, total_steps=8)
+    assert status == "preempted" and steps == 3
+    dumps = glob.glob(str(tmp_path / "dumps" / "health-*signal*"))
+    assert len(dumps) == 1
+    ok, reason = atomic.verify_checkpoint_dir(dumps[0], deep=True)
+    assert ok, reason
+    records, meta, (ok, _) = load_dump(dumps[0])
+    assert ok and meta["reason"].startswith("signal")
+    assert len(records) == 2  # the signal landed inside step 3
+    assert replay_records(records, _health_cfg()) == []  # clean trajectory
+    # the dump never shadows the real checkpoints in the resume chain
+    assert all("health" not in t
+               for t in atomic.list_tags(str(tmp_path / "ckpt")))
+    engine.destroy()
+
+
+# ---------------------------------------------------------------------------
+# 3. the serving leg
+# ---------------------------------------------------------------------------
+@pytest.fixture(scope="module")
+def serving_engine(devices8):
+    import jax.numpy as jnp
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models import CausalLM, TransformerConfig
+
+    model = CausalLM(TransformerConfig(
+        vocab_size=VOCAB, max_seq_len=32, n_layers=2, n_heads=2,
+        d_model=16, d_ff=32, compute_dtype=jnp.bfloat16))
+    engine = deepspeed_tpu.init_inference(model=model, config={
+        "dtype": "bfloat16", "max_tokens": 32,
+        "serving": {"n_slots": 2, "max_len": 32, "virtual_clock": True},
+        "health": {"enabled": True}})
+    yield engine
+    engine.destroy()
+
+
+def test_serving_unhealthy_slot_shed(serving_engine):
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.serving import FINISH_UNHEALTHY, Request
+
+    sv = serving_engine.serving
+    # healthy first: zero health counters, normal finishes
+    fin, rej, snap = sv.run([
+        Request(prompt=np.arange(4, dtype=np.int32), max_new_tokens=4),
+        Request(prompt=np.arange(6, dtype=np.int32), max_new_tokens=3)])
+    assert len(fin) == 2 and snap["health"] == {
+        "nonfinite_logit_steps": 0, "unhealthy_slots": 0}
+    # poison the final layernorm -> every decode logit goes NaN
+    serving_engine.params["ln_f"]["scale"] = \
+        serving_engine.params["ln_f"]["scale"] * jnp.nan
+    fin, rej, snap = sv.run([
+        Request(prompt=np.arange(4, dtype=np.int32), max_new_tokens=6)])
+    assert len(fin) == 1
+    req = fin[0]
+    assert req.finish_reason == FINISH_UNHEALTHY
+    assert snap["health"]["unhealthy_slots"] == 1
+    assert snap["health"]["nonfinite_logit_steps"] >= 1
+    assert sv.metrics.shed["unhealthy_slot"] == 1  # shed-with-reason
+    # the slot was freed + deactivated; the pool still compiles once
+    assert not sv._slots and len(sv._free_slots) == sv.n_slots
+    assert sv.compile_counts()["decode"] == 1
+
+
+def test_serving_health_events_emitted(serving_engine, tmp_path):
+    from deepspeed_tpu.monitor.monitor import MonitorMaster
+    from deepspeed_tpu.serving import Request
+
+    cfg = serving_engine.config
+    cfg.csv_monitor = cfg.csv_monitor.replace(
+        enabled=True, output_path=str(tmp_path), job_name="shealth")
+    sv = serving_engine.serving
+    sv.metrics.monitor = MonitorMaster(cfg)
+    sv.metrics.interval = 1
+    sv.run([Request(prompt=np.arange(4, dtype=np.int32), max_new_tokens=3)])
+    for name in ("Serving_health_nonfinite_steps",
+                 "Serving_health_unhealthy_slots"):
+        assert (tmp_path / "shealth" / f"{name}.csv").exists(), name
+
+
+# ---------------------------------------------------------------------------
+# 4. the CLI self-test pair (tier-1 CI gate, the program_lint idiom)
+# ---------------------------------------------------------------------------
+def test_health_report_selftest_pair():
+    cli = os.path.join(REPO, "tools", "health_report.py")
+    planted = subprocess.run(
+        [sys.executable, cli, "--selftest", "planted", "--fail-on",
+         "anomaly", "--json"],
+        capture_output=True, text=True, timeout=120)
+    assert planted.returncode == 3, planted.stderr
+    rep = json.loads(planted.stdout)
+    assert rep["anomalies_by_detector"].get("nonfinite") == 1
+    assert rep["anomalies_by_detector"].get("loss_spike") == 1
+    clean = subprocess.run(
+        [sys.executable, cli, "--selftest", "clean", "--fail-on", "anomaly"],
+        capture_output=True, text=True, timeout=120)
+    assert clean.returncode == 0, clean.stdout + clean.stderr
+
+
+def test_health_report_rejects_torn_dump(tmp_path):
+    """fsck discipline: a post-commit truncation is detected by the marker
+    CRCs and exits 2 — a torn black box must never read as evidence."""
+    from deepspeed_tpu.testing.fault_injection import truncate_file
+
+    hm = HealthMonitor(_health_cfg(dump_dir=str(tmp_path)), ("g",))
+    hm.observe(_clean_record(1, names=("g",)))
+    path = hm.dump("torntest")
+    truncate_file(os.path.join(path, "records.jsonl"), keep_bytes=10)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "health_report.py"),
+         path], capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 2
+    assert "VERIFICATION FAILED" in proc.stderr

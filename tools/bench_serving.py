@@ -186,11 +186,15 @@ def run_open_loop(args):
     engine.serving.metrics.reset_window()  # warmup out of the tokens/s window
 
     t0 = time.perf_counter()
-    finished, rejected, _ = engine.serving.run(requests)
+    finished, rejected, metrics_snap = engine.serving.run(requests)
     wall_s = time.perf_counter() - t0
 
-    ttfts = [r.ttft for r in finished if r.ttft is not None]
-    tpots = [r.tpot for r in finished if r.tpot is not None]
+    # unhealthy_slot sheds come back FINISHED too — keep their latencies
+    # out of the artifact, same partition ServingMetrics enforces
+    from deepspeed_tpu.serving import FINISH_UNHEALTHY
+    healthy = [r for r in finished if r.finish_reason != FINISH_UNHEALTHY]
+    ttfts = [r.ttft for r in healthy if r.ttft is not None]
+    tpots = [r.tpot for r in healthy if r.tpot is not None]
     pct = lambda s, q: None if not s else round(percentile(s, q) * 1e3, 2)
     total_tokens = sum(len(r.tokens) for r in finished)
     artifact = {
@@ -201,17 +205,28 @@ def run_open_loop(args):
         "slots": args.slots, "queue_depth": args.queue_depth,
         "prompt_lens": prompts, "max_new_tokens": args.new_tokens,
         "seed": args.seed,
-        "completed": len(finished), "shed": len(rejected),
-        "shed_rate": round(len(rejected) / max(args.num_requests, 1), 4),
-        "shed_reasons": {r.reject_reason: sum(
-            1 for x in rejected if x.reject_reason == r.reject_reason)
-            for r in rejected},
+        # unhealthy-shed requests come back FINISHED but count as shed, not
+        # completed — the headline counters keep the ServingMetrics partition
+        "completed": len(healthy),
+        "shed": len(rejected) + (len(finished) - len(healthy)),
+        "shed_rate": round((len(rejected) + len(finished) - len(healthy))
+                           / max(args.num_requests, 1), 4),
+        "shed_reasons": dict(
+            {r.reject_reason: sum(
+                1 for x in rejected if x.reject_reason == r.reject_reason)
+             for r in rejected},
+            **({"unhealthy_slot": len(finished) - len(healthy)}
+               if len(finished) > len(healthy) else {})),
         "total_tokens": total_tokens,
         "tokens_per_s": round(total_tokens / wall_s, 2) if wall_s else None,
         "wall_s": round(wall_s, 3),
         "ttft_ms": {"p50": pct(ttfts, 50), "p99": pct(ttfts, 99)},
         "tpot_ms": {"p50": pct(tpots, 50), "p99": pct(tpots, 99)},
         "compile_counts": engine.serving.compile_counts(),
+        # numerics self-incrimination next to the run stamp: a throughput
+        # number earned while slots were shedding non-finite logits (or
+        # steps were silently unhealthy) carries its own evidence
+        "numerics": metrics_snap.get("health", {}),
         "n_params_m": round(n_params / 1e6, 1),
     }
     from _common import stamp_record

@@ -1,0 +1,219 @@
+"""Read the numerics flight recorder: timeline tables + exit-code gates
+for black-box health dumps and live health JSONL streams.
+
+Input is either a black-box dump dir published by
+``deepspeed_tpu/telemetry/health.py`` (``records.jsonl`` + ``meta.json`` +
+the atomic ``COMMITTED`` marker — verified before anything is trusted) or a
+bare records JSONL file. The report re-runs the detector set over the
+loaded trajectory, so a dump produced with lax thresholds can be re-graded
+with strict ones.
+
+    # triage a dump (marker verified first; a torn dump exits 2):
+    python tools/health_report.py ./health_dumps/health-step42-nonfinite
+
+    # CI-shaped gate: any anomaly in the trajectory exits 3
+    python tools/health_report.py run/health.jsonl --fail-on anomaly
+
+    # the planted/clean self-test pair (mirrors program_lint's):
+    python tools/health_report.py --selftest planted --fail-on anomaly  # exit 3
+    python tools/health_report.py --selftest clean --fail-on anomaly    # exit 0
+
+Exit codes: 0 clean, 2 dump failed marker/CRC verification, 3 findings
+at/above ``--fail-on``, 1 infrastructure failure.
+"""
+
+import argparse
+import json
+import math
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+def _detector_config(args, meta=None):
+    """Detector knobs: CLI flags beat the dump's recorded config beat the
+    HealthConfig defaults."""
+    from deepspeed_tpu.config.config import HealthConfig
+
+    base = dict((meta or {}).get("config") or {})
+    base["enabled"] = True
+    # actions are irrelevant on replay; normalize so a dump recorded with
+    # action=halt doesn't trip validation paths
+    for k in ("nonfinite_action", "spike_action", "update_ratio_action"):
+        if base.get(k) not in (None, "off"):
+            base[k] = "warn"
+    base.setdefault("nonfinite_action", "warn")
+    if args.spike_zscore is not None:
+        base["spike_zscore"] = args.spike_zscore
+        base["spike_action"] = "warn"  # explicit re-grade beats a recorded "off"
+    if args.update_ratio_max is not None:
+        base["update_ratio_max"] = args.update_ratio_max
+        base["update_ratio_action"] = "warn"
+    # drop keys HealthConfig doesn't know (forward-compat dumps)
+    known = set(HealthConfig().to_dict())
+    return HealthConfig.from_dict({k: v for k, v in base.items()
+                                   if k in known})
+
+
+def _fmt(v, width=10):
+    if v is None:
+        return " " * (width - 1) + "-"
+    if isinstance(v, float):
+        if math.isnan(v):
+            return " " * (width - 3) + "nan"
+        return f"{v:{width}.4g}"
+    return f"{v!s:>{width}}"
+
+
+def print_timeline(records, anomalies, limit=40):
+    by_step = {}
+    for a in anomalies:
+        by_step.setdefault(a.step, []).append(a)
+    print(f"\n{'step':>6} {'loss':>10} {'scale':>8} {'grad_norm':>10} "
+          f"{'upd_ratio':>10} {'nonfinite':>10} {'skip':>5}  anomalies")
+    shown = records[-limit:] if limit else records
+    if len(shown) < len(records):
+        print(f"  ... {len(records) - len(shown)} earlier records "
+              f"(raise --limit)")
+    for r in shown:
+        groups = r.get("groups", {})
+        nf = sum(s.get("grad_nonfinite", 0.0) + s.get("param_nonfinite", 0.0)
+                 for s in groups.values())
+        ur = max((s.get("update_ratio", 0.0) for s in groups.values()),
+                 default=0.0)
+        marks = "; ".join(f"{a.detector}: {a.message}"
+                          for a in by_step.get(r.get("step"), []))
+        print(f"{r.get('step', 0):>6} {_fmt(r.get('loss'))} "
+              f"{_fmt(r.get('loss_scale'), 8)} {_fmt(r.get('grad_norm'))} "
+              f"{_fmt(ur)} {_fmt(nf)} "
+              f"{'  yes' if r.get('skipped') else '   no'}  {marks}")
+
+
+def _selftest_records(planted):
+    """Deterministic synthetic trajectory: 48 steps of smoothly-decaying
+    loss over four param groups. The planted twin carries one defect per
+    detector — a 12x loss spike at step 36 and non-finite grads in
+    ``blocks/attn`` at step 42 — so ``--fail-on anomaly`` exits 3; the
+    clean twin exits 0. (The program_lint planted/clean idiom.)"""
+    names = ("embeddings", "blocks/attn", "blocks/mlp", "norms")
+    records = []
+    for i in range(48):
+        loss = 8.0 * (0.985 ** i) + 0.03 * math.sin(i * 1.7)
+        gnorm = 1.2 * (0.99 ** i) + 0.02 * math.sin(i * 2.3)
+        groups = {}
+        for j, n in enumerate(names):
+            gn = gnorm * (0.2 + 0.1 * j)
+            groups[n] = {"grad_norm": gn, "grad_max_abs": gn * 0.3,
+                         "grad_nonfinite": 0.0, "param_norm": 10.0 + j,
+                         "update_norm": 0.01, "update_ratio": 0.001,
+                         "param_nonfinite": 0.0}
+        if planted and i == 36:
+            loss *= 12.0
+        if planted and i == 42:
+            groups["blocks/attn"]["grad_nonfinite"] = 5.0
+        records.append({"step": i + 1, "loss": loss, "loss_scale": 1.0,
+                        "skipped": False, "grad_norm": gnorm,
+                        "groups": groups})
+    return records
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("path", nargs="?", default=None,
+                    help="black-box dump dir (COMMITTED marker verified) or "
+                         "a bare records JSONL file")
+    ap.add_argument("--selftest", choices=["planted", "clean"], default=None,
+                    help="run the detectors over the built-in synthetic "
+                         "trajectory instead of a file")
+    ap.add_argument("--fail-on", default="none",
+                    choices=["anomaly", "nonfinite", "none"],
+                    help="exit 3 when the trajectory has findings at/above "
+                         "this class")
+    ap.add_argument("--spike-zscore", type=float, default=None)
+    ap.add_argument("--update-ratio-max", type=float, default=None)
+    ap.add_argument("--limit", type=int, default=40,
+                    help="timeline rows shown (0 = all)")
+    ap.add_argument("--no-verify", action="store_true",
+                    help="skip the dump-dir marker/CRC verification")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the machine-readable summary instead of the "
+                         "table")
+    args = ap.parse_args()
+
+    from deepspeed_tpu.telemetry.health import load_dump, replay_records
+
+    meta = {}
+    verify = (True, "selftest")
+    if args.selftest:
+        records = _selftest_records(planted=args.selftest == "planted")
+        source = f"selftest:{args.selftest}"
+    elif args.path:
+        try:
+            records, meta, verify = load_dump(args.path,
+                                              verify=not args.no_verify)
+        except (OSError, ValueError) as e:
+            print(f"cannot load {args.path}: {e}", file=sys.stderr)
+            return 1
+        source = args.path
+    else:
+        ap.error("give a dump path or --selftest")
+
+    cfg = _detector_config(args, meta)
+    anomalies = replay_records(records, cfg)
+    nonfinite_steps = sum(
+        1 for r in records
+        if any(s.get("grad_nonfinite", 0.0) + s.get("param_nonfinite", 0.0) > 0
+               for s in r.get("groups", {}).values()))
+    skipped = sum(1 for r in records if r.get("skipped"))
+
+    ok, reason = verify
+    summary = {
+        "source": source,
+        "records": len(records),
+        "verified": bool(ok),
+        "verify_reason": reason,
+        "anomalies": len(anomalies),
+        "anomalies_by_detector": {},
+        "nonfinite_steps": nonfinite_steps,
+        "skipped_steps": skipped,
+        "dump_reason": meta.get("reason"),
+        "dump_step": meta.get("step"),
+        "provenance": meta.get("provenance"),
+    }
+    for a in anomalies:
+        summary["anomalies_by_detector"][a.detector] = \
+            summary["anomalies_by_detector"].get(a.detector, 0) + 1
+
+    if args.json:
+        summary["anomaly_list"] = [a.to_dict() for a in anomalies]
+        print(json.dumps(summary, indent=1, default=str))
+    else:
+        print(f"## health report: {source}")
+        if meta.get("reason"):
+            print(f"- dump reason: {meta['reason']} at step "
+                  f"{meta.get('step')}; provenance "
+                  f"{(meta.get('provenance') or {}).get('git_sha')}")
+        print(f"- marker verification: {'OK' if ok else 'FAILED'} ({reason})")
+        print(f"- {len(records)} records, {len(anomalies)} anomalies, "
+              f"{nonfinite_steps} nonfinite steps, {skipped} skipped steps")
+        print_timeline(records, anomalies, limit=args.limit)
+
+    if not ok:
+        print(f"DUMP VERIFICATION FAILED: {reason}", file=sys.stderr)
+        return 2
+    if args.fail_on == "anomaly" and anomalies:
+        print(f"FAIL: {len(anomalies)} anomalies "
+              f"({summary['anomalies_by_detector']})", file=sys.stderr)
+        return 3
+    if args.fail_on == "nonfinite" and nonfinite_steps:
+        print(f"FAIL: {nonfinite_steps} steps with non-finite values",
+              file=sys.stderr)
+        return 3
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
