@@ -292,6 +292,44 @@ class CommsLoggerConfig(ConfigModel):
     prof_ops: list = []
 
 
+class KVPoolConfig(ConfigModel):
+    """Paged KV cache (``serving/kv_pool.py``): the slot pool's KV memory is
+    a fixed-shape pool of token blocks plus a per-slot block table instead of
+    one dense ``n_slots x max_len`` region. Blocks are allocated/freed at
+    request granularity on the host; the decode program reads through the
+    (traced) block table with gathers, so it still compiles exactly once.
+    Slot count stops being capped by worst-case sequence length — requests
+    reserve ``ceil((prompt + max_new - 1) / block_size)`` blocks, their real
+    footprint."""
+
+    enabled: bool = False
+    # tokens per KV block; serving max_len must be a multiple of it
+    block_size: int = 16
+    # physical blocks in the pool, INCLUDING the reserved garbage block 0
+    # (freed slots' dead decode writes land there). 0 = auto: the dense
+    # pool's token capacity, n_slots * (max_len / block_size) + 1.
+    n_blocks: int = 0
+    # "" = the engine serving dtype; "int8" stores blocks as int8 payloads
+    # with per-(token, head) fp32 scales (the ZeRO++ blockwise kernels from
+    # comm/collectives.py), ~halving pool HBM at a pinned logits tolerance
+    kv_dtype: str = ""
+    # copy-on-write shared-prefix cache: full prompt blocks are content-
+    # addressed; an identical prefix maps to the SAME physical blocks
+    # (refcounted) and only the suffix is prefilled
+    prefix_cache: bool = True
+
+    def _validate(self):
+        if self.block_size < 1:
+            raise ConfigError(
+                f"kv_pool.block_size must be >= 1, got {self.block_size}")
+        if self.n_blocks < 0:
+            raise ConfigError(
+                f"kv_pool.n_blocks must be >= 0, got {self.n_blocks}")
+        if self.kv_dtype not in ("", "int8"):
+            raise ConfigError(
+                f"kv_pool.kv_dtype must be '' or 'int8', got {self.kv_dtype!r}")
+
+
 class ServingConfig(ConfigModel):
     """Continuous-batching serving (Orca-style slot scheduler over ONE jitted
     decode program; DeepSpeed-Inference's serving-side batching layer,
@@ -316,13 +354,19 @@ class ServingConfig(ConfigModel):
     virtual_clock: bool = False
     virtual_decode_step_cost: float = 1.0
     virtual_prefill_cost_per_token: float = 0.0625  # ~flash prefill vs decode
-    # zero a slot's KV rows when its request finishes (the causal mask and
-    # whole-row insert already prevent stale-KV leaks; hygiene/debug knob)
+    # zero freed KV memory when a request finishes (the causal mask and
+    # whole-row/whole-block insert already prevent stale-KV leaks; hygiene/
+    # debug knob). Dense pool: zero the slot's rows; paged pool: zero each
+    # physical block as its refcount hits zero (block-granularity scrub).
     scrub_freed_slots: bool = False
     # emit Serving/* monitor events every N scheduler steps (0 disables)
     monitor_interval: int = 32
+    # paged + quantized KV cache with shared-prefix reuse (kv_pool.enabled)
+    kv_pool: KVPoolConfig = None
 
     def _validate(self):
+        if self.kv_pool is None:
+            self.kv_pool = KVPoolConfig()
         if self.n_slots < 1:
             raise ConfigError(f"serving.n_slots must be >= 1, got {self.n_slots}")
         if self.max_queue_depth < 1:

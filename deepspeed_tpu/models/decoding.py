@@ -66,6 +66,212 @@ def reset_slot_kv(pool, slot):
     }
 
 
+# ---------------------------------------------------------------------------
+# paged (block) KV cache: fixed pool of token blocks + per-slot block table
+# ---------------------------------------------------------------------------
+
+def init_paged_cache(cfg, n_blocks, block_size, dtype=None, kv_dtype=None):
+    """Allocate the paged KV pool: ``n_blocks`` physical blocks of
+    ``block_size`` tokens each, stacked over layers (a physical block id
+    addresses the same block row in EVERY layer, so host allocation is one
+    decision per token block, not per layer).
+
+    ``kv_dtype="int8"`` stores blocks as int8 payloads with per-(token, head)
+    fp32 scales (``comm/collectives.py`` blockwise kernels, ZeRO++ idiom) —
+    k/v: [L, n_blocks, block_size, kvh, dh] int8, k_scale/v_scale:
+    [L, n_blocks, block_size, kvh, 1] f32."""
+    dtype = dtype or cfg.compute_dtype
+    kvh = cfg.kv_heads
+    shape = (cfg.n_layers, n_blocks, block_size, kvh, cfg.head_dim)
+    if kv_dtype == "int8":
+        return {
+            "k": jnp.zeros(shape, jnp.int8),
+            "v": jnp.zeros(shape, jnp.int8),
+            "k_scale": jnp.zeros(shape[:-1] + (1,), jnp.float32),
+            "v_scale": jnp.zeros(shape[:-1] + (1,), jnp.float32),
+        }
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+
+def _dequant_layer(q, scale, dtype):
+    """int8 payload + per-(token, head) scale -> ``dtype``. ``scale`` keeps
+    its trailing 1-axis: it is the [..., n // block] axis
+    ``dequantize_blockwise`` blocks the last payload axis by (block == dh,
+    one scale per head vector)."""
+    from ..comm.collectives import dequantize_blockwise
+
+    return dequantize_blockwise(q, scale, dtype=dtype)
+
+
+def _paged_view(kc, sc, table, view_dtype):
+    """Gather a slot-major dense view of the pool through the block table.
+
+    kc: [n_blocks, bs, kvh, dh] (one layer); table: [S, NB] physical block
+    ids; returns [S, NB * bs, kvh, dh] — row ``s`` holds slot s's KV window
+    in position order (block j covers positions [j*bs, (j+1)*bs)), exactly
+    the dense cache layout, so the attention math downstream is the SAME
+    program as the dense per-row path."""
+    nb, bs, kvh, dh = kc.shape
+    s_dim, per_slot = table.shape
+    g = kc[table]                                    # [S, NB, bs, kvh, dh]
+    if sc is not None:
+        g = _dequant_layer(g, sc[table], view_dtype)
+    return g.reshape(s_dim, per_slot * bs, kvh, dh)
+
+
+def _paged_writeback(kc, sc, view, table, pos, block_size):
+    """Scatter the row each slot just wrote (at its cursor) from the dense
+    view back into the pool at (table[s, pos // bs], pos % bs). Freed slots
+    carry an all-garbage-block table row, so their dead writes land in the
+    reserved garbage block instead of corrupting a reallocated block."""
+    s_dim = pos.shape[0]
+    rows = jax.vmap(
+        lambda c, p: jax.lax.dynamic_slice(
+            c, (p, 0, 0), (1,) + c.shape[1:]))(view, pos)[:, 0]  # [S, kvh, dh]
+    bi = jnp.take_along_axis(table, (pos // block_size)[:, None], axis=1)[:, 0]
+    off = pos % block_size
+    if sc is not None:
+        from ..comm.collectives import quantize_blockwise
+
+        q, scale = quantize_blockwise(rows, block=rows.shape[-1])
+        return kc.at[bi, off].set(q), sc.at[bi, off].set(scale)
+    return kc.at[bi, off].set(rows.astype(kc.dtype)), None
+
+
+def forward_with_paged_cache(model, params, input_ids, pool, table, pos,
+                             block_size):
+    """One decode step ([S, 1] tokens) reading/writing KV through a TRACED
+    block table — the paged twin of ``forward_with_cache``'s per-row decode.
+
+    Per layer: gather the slot-major dense view through ``table``
+    (dequantizing int8 blocks), run the UNCHANGED dense per-row attention on
+    it (``_block_cached``), then scatter each slot's newly-written row back
+    into the pool. Because the gathered view is bit-identical to the dense
+    cache at every unmasked position and the math in between is the same
+    program, greedy paged decode is bitwise-equal to the dense slot pool
+    (tier-1 pins it). Returns (logits [S, 1, vocab], new pool)."""
+    cfg = model.config
+    b, q_len = input_ids.shape
+    int8 = "k_scale" in pool
+    view_dtype = cfg.compute_dtype
+    positions = pos[:, None] + jnp.arange(q_len)[None, :]
+    kv_len = table.shape[1] * block_size
+
+    x = L.embedding_apply(params["wte"], input_ids, cfg.compute_dtype)
+    if cfg.position_embedding == "learned":
+        x = x + jnp.take(params["wpe"]["weight"].astype(cfg.compute_dtype),
+                         positions, axis=0)
+    rope = None
+    if cfg.position_embedding == "rope":
+        rope = L.rotary_embedding(positions, cfg.rotary_dim or cfg.head_dim,
+                                  cfg.rope_base)
+
+    def block_step(h, p_i, kc, vc, ks, vs, loc):
+        kview = _paged_view(kc, ks, table, view_dtype)
+        vview = _paged_view(vc, vs, table, view_dtype)
+        h, kview, vview = _block_cached(cfg, p_i, h, kview, vview, pos,
+                                        kv_len, rope=rope, is_local=loc)
+        kc, ks = _paged_writeback(kc, ks, kview, table, pos, block_size)
+        vc, vs = _paged_writeback(vc, vs, vview, table, pos, block_size)
+        return h, kc, vc, ks, vs
+
+    scales = (pool["k_scale"], pool["v_scale"]) if int8 else None
+    if cfg.local_attention_window > 0:
+        from .transformer import local_attention_flags
+
+        is_local_arr = jnp.asarray(local_attention_flags(cfg))
+    else:
+        is_local_arr = None
+
+    def scan_fn(carry, layer):
+        h = carry
+        if int8:
+            if is_local_arr is not None:
+                p_i, kc, vc, ks, vs, loc = layer
+            else:
+                (p_i, kc, vc, ks, vs), loc = layer, None
+        else:
+            ks = vs = None
+            if is_local_arr is not None:
+                p_i, kc, vc, loc = layer
+            else:
+                (p_i, kc, vc), loc = layer, None
+        h, kc, vc, ks, vs = block_step(h, p_i, kc, vc, ks, vs, loc)
+        out = (kc, vc, ks, vs) if int8 else (kc, vc)
+        return h, out
+
+    xs = [params["blocks"], pool["k"], pool["v"]]
+    if int8:
+        xs += [scales[0], scales[1]]
+    if is_local_arr is not None:
+        xs += [is_local_arr]
+    h, new = jax.lax.scan(scan_fn, x, tuple(xs))
+    h = _norm_apply(cfg, params["ln_f"], h)
+    if cfg.tie_embeddings:
+        logits = L.embedding_attend(params["wte"], h)
+    else:
+        logits = L.linear_apply(params["lm_head"], h)
+    new_pool = {"k": new[0], "v": new[1]}
+    if int8:
+        new_pool["k_scale"], new_pool["v_scale"] = new[2], new[3]
+    return logits, new_pool
+
+
+def insert_block_kv(pool, dense_cache, block_id, src_start, block_size):
+    """Copy ONE token block from a freshly-prefilled dense cache into
+    physical block ``block_id`` of the pool (quantizing when the pool is
+    int8). ``block_id``/``src_start`` are TRACED scalars — one compiled
+    program covers every (block, offset) pair. The whole block is
+    overwritten, so nothing from its previous occupant survives (the paged
+    analogue of ``insert_slot_kv``'s whole-row guarantee)."""
+    out = dict(pool)
+    for name in ("k", "v"):
+        rows = jax.lax.dynamic_slice_in_dim(
+            dense_cache[name], src_start, block_size, axis=2)  # [L,1,bs,kvh,dh]
+        rows = jnp.swapaxes(rows, 1, 2)[:, :, 0]               # [L,bs,kvh,dh]
+        if name + "_scale" in pool:
+            from ..comm.collectives import quantize_blockwise
+
+            q, scale = quantize_blockwise(rows, block=rows.shape[-1])
+            out[name] = jax.lax.dynamic_update_slice(
+                pool[name], q[:, None], (0, block_id, 0, 0, 0))
+            out[name + "_scale"] = jax.lax.dynamic_update_slice(
+                pool[name + "_scale"], scale[:, None],
+                (0, block_id, 0, 0, 0))
+        else:
+            out[name] = jax.lax.dynamic_update_slice(
+                pool[name], rows[:, None].astype(pool[name].dtype),
+                (0, block_id, 0, 0, 0))
+    return out
+
+
+def reset_block_kv(pool, block_id):
+    """Zero physical block ``block_id`` (block-granularity hygiene scrub —
+    ``scrub_freed_slots`` generalized from the dense pool's whole-row
+    scrub; int8 scales zero too, so a dequantized read is exactly 0)."""
+    out = {}
+    for name, a in pool.items():
+        z = jnp.zeros(a.shape[:1] + (1,) + a.shape[2:], a.dtype)
+        out[name] = jax.lax.dynamic_update_slice(a, z, (0, block_id, 0, 0, 0))
+    return out
+
+
+def gather_slot_cache(cfg, pool, table_row, dtype):
+    """Materialize one slot's dense [L, 1, NB*bs, kvh, dh] cache view from
+    its block-table row (dequantizing int8 blocks) — seeds the suffix
+    prefill on a shared-prefix hit: positions below the shared length hold
+    the canonical prefix KV, everything above is garbage the suffix prefill
+    overwrites or the causal mask hides."""
+    g = pool["k"][:, table_row]                    # [L, NB, bs, kvh, dh]
+    gv = pool["v"][:, table_row]
+    if "k_scale" in pool:
+        g = _dequant_layer(g, pool["k_scale"][:, table_row], dtype)
+        gv = _dequant_layer(gv, pool["v_scale"][:, table_row], dtype)
+    L_, nb, bs, kvh, dh = g.shape
+    return {"k": g.reshape(L_, 1, nb * bs, kvh, dh).astype(dtype),
+            "v": gv.reshape(L_, 1, nb * bs, kvh, dh).astype(dtype)}
+
+
 def _attn_with_cache(cfg, p_attn, h, k_cache, v_cache, pos, kv_len, rope=None,
                      is_local=None, prefill=False):
     """Attention for q block [b, q, d] against cache[:, :kv_len] after writing the

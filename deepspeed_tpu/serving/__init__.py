@@ -8,11 +8,13 @@ Serving/* metrics — the request-level layer that turns the single-call
 
 from .clock import VirtualClock, WallClock
 from .engine import ServingEngine
+from .kv_pool import GARBAGE_BLOCK, KVPoolManager
 from .metrics import ServingMetrics, percentile
 from .queue import RequestQueue
 from .request import (FINISH_EOS, FINISH_LENGTH, FINISH_UNHEALTHY,
-                      REJECT_PROMPT_TOO_LONG, REJECT_QUEUE_FULL, Request,
-                      RequestState, SamplingParams, TokenEvent, as_request)
+                      REJECT_NO_FREE_BLOCKS, REJECT_PROMPT_TOO_LONG,
+                      REJECT_QUEUE_FULL, Request, RequestState,
+                      SamplingParams, TokenEvent, as_request)
 from .scheduler import ServingScheduler, simulate_static_batching
 
 __all__ = [
@@ -29,9 +31,12 @@ __all__ = [
     "as_request",
     "percentile",
     "simulate_static_batching",
+    "KVPoolManager",
+    "GARBAGE_BLOCK",
     "FINISH_EOS",
     "FINISH_LENGTH",
     "FINISH_UNHEALTHY",
     "REJECT_QUEUE_FULL",
     "REJECT_PROMPT_TOO_LONG",
+    "REJECT_NO_FREE_BLOCKS",
 ]

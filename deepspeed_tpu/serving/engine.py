@@ -26,10 +26,14 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..config.base import ConfigError
 from ..inference.engine import lru_compiled
-from ..models.decoding import (forward_with_cache, init_cache, insert_slot_kv,
-                               reset_slot_kv, sample_token)
+from ..models.decoding import (forward_with_cache, forward_with_paged_cache,
+                               gather_slot_cache, init_cache,
+                               init_paged_cache, insert_block_kv,
+                               insert_slot_kv, reset_block_kv, reset_slot_kv,
+                               sample_token)
 from ..utils.logging import log_dist
 from .clock import VirtualClock, WallClock
+from .kv_pool import GARBAGE_BLOCK, KVPoolManager
 from .metrics import ServingMetrics
 from .queue import RequestQueue
 from .request import (FINISH_EOS, FINISH_LENGTH, FINISH_STOP,
@@ -59,6 +63,15 @@ class ServingEngine:
                 f"max_tokens {engine.config.max_tokens}")
         self.clock = clock if clock is not None else (
             VirtualClock() if self.cfg.virtual_clock else WallClock())
+        # paged KV pool (kv_pool.enabled): block allocator + prefix cache on
+        # the host, block-table gathers on the device (serving/kv_pool.py)
+        self.paged = bool(self.cfg.kv_pool.enabled)
+        self.pool_mgr = KVPoolManager(self.cfg.kv_pool, self.n_slots,
+                                      self.max_len) if self.paged else None
+        if self.paged and self.cfg.scrub_freed_slots:
+            # block-granularity scrub: zero each physical block as its last
+            # reference drops (the dense pool's whole-row scrub generalized)
+            self.pool_mgr._scrub = self._scrub_block
         self.queue = RequestQueue(self.cfg.max_queue_depth)
         self.scheduler = ServingScheduler(
             self.queue, self.n_slots,
@@ -75,7 +88,9 @@ class ServingEngine:
                 monitor = MonitorMaster(mc)
         self.metrics = ServingMetrics(self.n_slots, self.clock,
                                       monitor=monitor,
-                                      interval=self.cfg.monitor_interval)
+                                      interval=self.cfg.monitor_interval,
+                                      kv_pool=self.pool_mgr.stats
+                                      if self.paged else None)
         # numerics watchdog (the serving leg of telemetry/health.py): the
         # decode program ALWAYS emits the per-slot nonfinite-logit count
         # (so the sanitizer budget audits the real program); the shed hook
@@ -97,10 +112,14 @@ class ServingEngine:
         self._free_slots = list(range(self.n_slots - 1, -1, -1))  # pop() -> 0 first
         self._next_id = 0
         self._prefill_programs = OrderedDict()   # padded_len -> jitted prefill
+        self._suffix_programs = OrderedDict()    # padded suffix -> jitted
         self._decode_jit = None
         self._insert_jit = None
         self._release_jit = None
         self._sample_first_jit = None
+        self._insert_block_jit = None    # paged: copy one block into the pool
+        self._seed_cache_jit = None      # paged: block table row -> dense view
+        self._scrub_jit = None           # paged: zero one physical block
         # ONE sharding for the pool state, pinned as out_shardings on every
         # pool program: kv heads over the model axis (TP), everything else
         # replicated. Without the pin, insert and decode outputs would carry
@@ -115,25 +134,55 @@ class ServingEngine:
         self._cache_sharding = NamedSharding(
             mesh, P(None, None, None, kv_axis, None))
         self._rep_sharding = NamedSharding(mesh, P())
+        kv_names = ("k", "v", "k_scale", "v_scale") \
+            if self.paged and self.cfg.kv_pool.kv_dtype == "int8" \
+            else ("k", "v")
+        extra = ("table",) if self.paged else ()
         self._state_shardings = {
-            name: self._cache_sharding if name in ("k", "v")
+            name: self._cache_sharding if name in kv_names
             else self._rep_sharding
-            for name in ("k", "v", "pos", "tok", "active", "remaining",
-                         "rng", "temp", "top_k", "top_p", "eos")}
+            for name in kv_names + extra + (
+                "pos", "tok", "active", "remaining", "rng", "temp", "top_k",
+                "top_p", "eos")}
         self._state = self._init_state()
-        log_dist(
-            f"ServingEngine: {self.n_slots} slots x {self.max_len} KV window, "
-            f"queue depth {self.cfg.max_queue_depth}, "
-            f"clock={'virtual' if isinstance(self.clock, VirtualClock) else 'wall'}",
-            ranks=[0])
+        if self.paged:
+            # the small fix the paged pool makes necessary: the KV window is
+            # no longer n_slots x max_len — report the REAL capacity (blocks
+            # and tokens) so operators see the effective slot multiplier
+            mgr = self.pool_mgr
+            cap = mgr.allocatable * mgr.block_size
+            log_dist(
+                f"ServingEngine: {self.n_slots} slots, paged KV pool "
+                f"{mgr.allocatable} blocks x {mgr.block_size} tok = {cap} "
+                f"tokens ({cap / self.max_len:.1f} max-len-equivalent slots"
+                f", kv_dtype={self.cfg.kv_pool.kv_dtype or 'engine'}, "
+                f"prefix_cache={'on' if self.cfg.kv_pool.prefix_cache else 'off'}), "
+                f"queue depth {self.cfg.max_queue_depth}, "
+                f"clock={'virtual' if isinstance(self.clock, VirtualClock) else 'wall'}",
+                ranks=[0])
+        else:
+            log_dist(
+                f"ServingEngine: {self.n_slots} slots x {self.max_len} KV window, "
+                f"queue depth {self.cfg.max_queue_depth}, "
+                f"clock={'virtual' if isinstance(self.clock, VirtualClock) else 'wall'}",
+                ranks=[0])
 
     # ------------------------------------------------------------------ state
     def _init_state(self):
         cfg = self.engine.module.config
-        cache = init_cache(cfg, self.n_slots, self.max_len, self.engine.dtype)
         s = self.n_slots
-        state = {
-            "k": cache["k"], "v": cache["v"],
+        if self.paged:
+            mgr = self.pool_mgr
+            cache = init_paged_cache(cfg, mgr.n_blocks, mgr.block_size,
+                                     self.engine.dtype,
+                                     self.cfg.kv_pool.kv_dtype or None)
+            # every slot starts parked on the garbage block: a dead decode
+            # write can never land in an allocatable block
+            cache["table"] = jnp.full((s, mgr.blocks_per_slot),
+                                      GARBAGE_BLOCK, jnp.int32)
+        else:
+            cache = init_cache(cfg, s, self.max_len, self.engine.dtype)
+        state = dict(cache, **{
             "pos": jnp.zeros((s,), jnp.int32),        # next KV write cursor
             "tok": jnp.zeros((s,), jnp.int32),        # last sampled token
             "active": jnp.zeros((s,), jnp.bool_),
@@ -143,7 +192,7 @@ class ServingEngine:
             "top_k": jnp.zeros((s,), jnp.int32),
             "top_p": jnp.ones((s,), jnp.float32),
             "eos": jnp.full((s,), -1, jnp.int32),     # -1 = no eos
-        }
+        })
         return {name: jax.device_put(a, self._state_shardings[name])
                 for name, a in state.items()}
 
@@ -171,17 +220,54 @@ class ServingEngine:
                             int(self.engine.config.compile_cache_size or 0),
                             "serving prefill")
 
+    def _suffix_program(self, padded_len):
+        """Shared-prefix hit: prefill only the SUFFIX (cache already holds
+        the prefix KV gathered from shared blocks) — one compiled program
+        per suffix bucket, start position and true length traced."""
+        model, max_len = self.engine.module, self.max_len
+
+        def build():
+            def suffix_prefill(params, ids, cache, start_pos, true_len):
+                logits, c = forward_with_cache(model, params, ids, cache,
+                                               start_pos, max_len)
+                last = jax.lax.dynamic_slice_in_dim(
+                    logits, true_len - 1, 1, axis=1)[:, 0]
+                return last, c
+
+            with self.engine.mesh:
+                return jax.jit(suffix_prefill, donate_argnums=(2,),
+                               out_shardings=(
+                                   self._rep_sharding,
+                                   {"k": self._cache_sharding,
+                                    "v": self._cache_sharding}))
+
+        return lru_compiled(self._suffix_programs, padded_len, build,
+                            int(self.engine.config.compile_cache_size or 0),
+                            "serving suffix prefill")
+
     def _build_pool_programs(self):
         model, max_len = self.engine.module, self.max_len
+        paged = self.paged
+        bs = self.pool_mgr.block_size if paged else 0
+        pool_keys = ("k", "v", "k_scale", "v_scale") \
+            if paged and self.cfg.kv_pool.kv_dtype == "int8" else ("k", "v")
 
         def decode(params, state):
             # one token for EVERY slot, each at its own cursor; inactive
-            # slots decode garbage into their own freed rows (overwritten
-            # whole-row by the next insert) and are masked below
+            # slots decode garbage into their own freed rows (dense: the
+            # slot's private rows, overwritten whole-row by the next insert;
+            # paged: the reserved garbage block their table row points at)
+            # and are masked below
             split = jax.vmap(jax.random.split)(state["rng"])  # [S, 2, 2]
-            logits, cache = forward_with_cache(
-                model, params, state["tok"][:, None],
-                {"k": state["k"], "v": state["v"]}, state["pos"], max_len)
+            if paged:
+                logits, cache = forward_with_paged_cache(
+                    model, params, state["tok"][:, None],
+                    {k: state[k] for k in pool_keys}, state["table"],
+                    state["pos"], bs)
+            else:
+                logits, cache = forward_with_cache(
+                    model, params, state["tok"][:, None],
+                    {"k": state["k"], "v": state["v"]}, state["pos"], max_len)
             # in-graph health: per-slot nonfinite-logit count (the serving
             # leg of the numerics flight recorder — one tiny i32[S] side
             # output, no host callback; the sanitizer budget audits it)
@@ -196,8 +282,7 @@ class ServingEngine:
             remaining = state["remaining"] - active.astype(jnp.int32)
             hit_eos = (state["eos"] >= 0) & (nxt == state["eos"])
             done_now = active & (hit_eos | (remaining <= 0))
-            new_state = {
-                "k": cache["k"], "v": cache["v"],
+            new_state = dict(cache, **{
                 "pos": state["pos"] + active.astype(jnp.int32),
                 "tok": nxt,
                 "active": active & jnp.logical_not(done_now),
@@ -205,7 +290,9 @@ class ServingEngine:
                 "rng": split[:, 1],
                 "temp": state["temp"], "top_k": state["top_k"],
                 "top_p": state["top_p"], "eos": state["eos"],
-            }
+            })
+            if paged:
+                new_state["table"] = state["table"]
             return (nxt, done_now, nonfinite), new_state
 
         def insert(state, slot, k_slot, v_slot, tok, pos, remaining, rng,
@@ -227,13 +314,73 @@ class ServingEngine:
                 "eos": put(state["eos"], eos),
             }
 
+        def insert_meta(state, slot, table_row, tok, pos, remaining, rng,
+                        temp, top_k, top_p, eos):
+            # paged: the KV rows were already copied block-wise
+            # (insert_block); this binds the slot's block table + scalars
+            put = lambda a, v_: a.at[slot].set(v_)
+            return dict(state, **{
+                "table": state["table"].at[slot].set(table_row),
+                "pos": put(state["pos"], pos),
+                "tok": put(state["tok"], tok),
+                "active": put(state["active"], True),
+                "remaining": put(state["remaining"], remaining),
+                "rng": state["rng"].at[slot].set(rng),
+                "temp": put(state["temp"], temp),
+                "top_k": put(state["top_k"], top_k),
+                "top_p": put(state["top_p"], top_p),
+                "eos": put(state["eos"], eos),
+            })
+
+        def insert_blocks(state, dense_k, dense_v, block_ids, src_starts):
+            # copy a request's private blocks from its freshly-prefilled
+            # dense cache into the pool in ONE dispatch: a fori_loop over
+            # the (traced) padded [blocks_per_slot] id/offset arrays, so
+            # TTFT pays one jitted call instead of one per block. Padding
+            # entries point at the garbage block (their copy is dead) —
+            # total device work is O(max_len), the dense insert's cost.
+            pool = {k: state[k] for k in pool_keys}
+
+            def body(i, p):
+                return insert_block_kv(p, {"k": dense_k, "v": dense_v},
+                                       block_ids[i], src_starts[i], bs)
+
+            pool = jax.lax.fori_loop(0, block_ids.shape[0], body, pool)
+            return dict(state, **pool)
+
+        def seed_cache(state, table_row):
+            # shared-prefix hit: materialize the slot's dense cache view
+            # from its (partly shared) block row for the suffix prefill
+            return gather_slot_cache(model.config,
+                                     {k: state[k] for k in pool_keys},
+                                     table_row, self.engine.dtype)
+
         def release(state, slot):
+            if paged:
+                # MANDATORY on the paged pool (not hygiene): the freed
+                # slot's blocks go back to the allocator, so its table row
+                # must retreat to the garbage block before anything reuses
+                # them — a dead decode write to a reallocated block would
+                # be silent cross-request corruption
+                return dict(
+                    state,
+                    table=state["table"].at[slot].set(
+                        jnp.full((state["table"].shape[1],), GARBAGE_BLOCK,
+                                 jnp.int32)),
+                    pos=state["pos"].at[slot].set(0),
+                    active=state["active"].at[slot].set(False))
             # hygiene scrub (config.scrub_freed_slots): zero the freed KV
             # rows; the causal mask + whole-row insert already guarantee no
             # stale-KV leak without it
             kv = reset_slot_kv({"k": state["k"], "v": state["v"]}, slot)
             return dict(state, k=kv["k"], v=kv["v"],
                         active=state["active"].at[slot].set(False))
+
+        def scrub_block(state, block_id):
+            # block-granularity scrub (scrub_freed_slots under paging):
+            # zero a physical block when its last reference drops
+            return dict(state, **reset_block_kv(
+                {k: state[k] for k in pool_keys}, block_id))
 
         def sample_first(logits, key, temp, top_k, top_p):
             # same in-graph guard as decode: the first token samples from
@@ -250,8 +397,19 @@ class ServingEngine:
         with self.engine.mesh:
             self._decode_jit = jax.jit(decode, donate_argnums=(1,),
                                        out_shardings=((rep, rep, rep), st))
-            self._insert_jit = jax.jit(insert, donate_argnums=(0,),
-                                       out_shardings=st)
+            if paged:
+                self._insert_jit = jax.jit(insert_meta, donate_argnums=(0,),
+                                           out_shardings=st)
+                self._insert_block_jit = jax.jit(
+                    insert_blocks, donate_argnums=(0,), out_shardings=st)
+                self._seed_cache_jit = jax.jit(
+                    seed_cache, out_shardings={"k": self._cache_sharding,
+                                               "v": self._cache_sharding})
+                self._scrub_jit = jax.jit(scrub_block, donate_argnums=(0,),
+                                          out_shardings=st)
+            else:
+                self._insert_jit = jax.jit(insert, donate_argnums=(0,),
+                                           out_shardings=st)
             self._release_jit = jax.jit(release, donate_argnums=(0,),
                                         out_shardings=st)
             self._sample_first_jit = jax.jit(sample_first,
@@ -281,11 +439,21 @@ class ServingEngine:
         the decode step compiles exactly once per (model, slot-pool)
         configuration no matter how requests join/leave mid-flight."""
         size = lambda f: f._cache_size() if f is not None else 0
-        return {
+        out = {
             "decode": size(self._decode_jit),
             "insert": size(self._insert_jit),
             "prefill_buckets": len(self._prefill_programs),
         }
+        if self.paged:
+            out["insert_block"] = size(self._insert_block_jit)
+            out["seed_cache"] = size(self._seed_cache_jit)
+            out["suffix_buckets"] = len(self._suffix_programs)
+        return out
+
+    def _scrub_block(self, block_id):
+        """KVPoolManager scrub hook: zero one freed physical block."""
+        if self._scrub_jit is not None and self._state is not None:
+            self._state = self._scrub_jit(self._state, np.int32(block_id))
 
     # ------------------------------------------------------------ submission
     def submit(self, request, **kwargs):
@@ -309,7 +477,9 @@ class ServingEngine:
             # offset from an absolute clock reading
             req.arrival_time += req.submit_time
             req.arrival_resolved = True
-        reason = self.queue.admit(req, self.max_len)
+        reason = self.queue.admit(
+            req, self.max_len,
+            kv_fits=self.pool_mgr.fits_ever if self.paged else None)
         if reason is None:
             self.metrics.record_submit()
             self.tracer.instant(
@@ -331,8 +501,30 @@ class ServingEngine:
         (prefill + splice), then run one decode step over the pool. Returns
         the list of TokenEvents produced."""
         events = []
+        can_admit = None
+        if self.paged:
+            # block-aware admission: the queue head waits (FCFS, no
+            # overtaking) until enough blocks are free or evictable.
+            # ``reserved`` makes multi-admission steps conservative: earlier
+            # candidates' not-yet-allocated blocks count against later ones.
+            # Prefix sharing is ignored here (a hit only needs FEWER blocks,
+            # so the check stays sound). No livelock: every queued request
+            # passed fits_ever at submit, and with no slots running every
+            # non-free block is prefix-cache-evictable, so the head always
+            # admits once running requests drain.
+            reserved = [0]
+
+            def can_admit(req):
+                need = self.pool_mgr.blocks_for(req.prompt_len,
+                                                req.max_new_tokens)
+                ok = self.pool_mgr.can_allocate(need + reserved[0])
+                if ok:
+                    reserved[0] += need
+                return ok
+
         admitted = self.scheduler.next_admissions(len(self._free_slots),
-                                                  self.clock.now())
+                                                  self.clock.now(),
+                                                  can_admit=can_admit)
         for req in admitted:
             self._start_request(req, events)
         if self._slots:
@@ -359,18 +551,56 @@ class ServingEngine:
     def _start_request(self, req, events):
         if self._decode_jit is None:
             self._build_pool_programs()
-        # ceiling is the full slot window: pad rows past the cursor are
-        # causally masked and then overwritten one-by-one as decode advances
-        # (same scheme as generate()), so padding may overlap the generation
-        # region — one bucket serves every max_new_tokens
-        padded = self.engine._bucket_prompt_len(req.prompt_len, self.max_len)
-        with self.tracer.span("prefill", cat="serving",
-                              request_id=req.request_id, padded_len=padded):
-            ids = np.zeros((1, padded), np.int32)
-            ids[0, :req.prompt_len] = req.prompt
-            logits, cache = self._prefill_program(padded)(
-                self.engine.params, jnp.asarray(ids), np.int32(req.prompt_len))
-            self.clock.advance(padded * self.cfg.virtual_prefill_cost_per_token)
+        shared_len, shared_blocks = 0, []
+        if self.paged:
+            # take refs on matched prefix blocks NOW so an eviction between
+            # here and the slot insert can't dangle them
+            shared_len, shared_blocks = self.pool_mgr.acquire_prefix(req.prompt)
+        if shared_len:
+            # shared-prefix hit: the pool already holds the prefix KV — seed
+            # a dense view from the (partly shared) block row and prefill
+            # ONLY the suffix. Capped at prompt_len - 1, so there is always
+            # at least one suffix token to yield the first-token logits.
+            mgr = self.pool_mgr
+            row = np.full((mgr.blocks_per_slot,), GARBAGE_BLOCK, np.int32)
+            row[:len(shared_blocks)] = shared_blocks
+            suffix = req.prompt[shared_len:]
+            # ceiling shrinks by the shared prefix: the suffix q-block is
+            # written AT pos=shared_len, and a bucket that overruns max_len
+            # would make XLA clamp the update start — silently clobbering
+            # the prefix KV rows (bucket 64 + shared 16 in a 64 window did
+            # exactly that before this cap)
+            padded = self.engine._bucket_prompt_len(
+                len(suffix), self.max_len - shared_len)
+            with self.tracer.span("prefill", cat="serving",
+                                  request_id=req.request_id,
+                                  padded_len=padded, shared_len=shared_len):
+                cache = self._seed_cache_jit(self._state, jnp.asarray(row))
+                ids = np.zeros((1, padded), np.int32)
+                ids[0, :len(suffix)] = suffix
+                logits, cache = self._suffix_program(padded)(
+                    self.engine.params, jnp.asarray(ids), cache,
+                    np.int32(shared_len), np.int32(len(suffix)))
+                # the prefix-cache win in virtual time: only the suffix pays
+                self.clock.advance(
+                    padded * self.cfg.virtual_prefill_cost_per_token)
+        else:
+            # ceiling is the full slot window: pad rows past the cursor are
+            # causally masked and then overwritten one-by-one as decode
+            # advances (same scheme as generate()), so padding may overlap
+            # the generation region — one bucket serves every max_new_tokens
+            padded = self.engine._bucket_prompt_len(req.prompt_len,
+                                                    self.max_len)
+            with self.tracer.span("prefill", cat="serving",
+                                  request_id=req.request_id,
+                                  padded_len=padded):
+                ids = np.zeros((1, padded), np.int32)
+                ids[0, :req.prompt_len] = req.prompt
+                logits, cache = self._prefill_program(padded)(
+                    self.engine.params, jnp.asarray(ids),
+                    np.int32(req.prompt_len))
+                self.clock.advance(
+                    padded * self.cfg.virtual_prefill_cost_per_token)
 
         keys = self._request_key(req)
         s = req.sampling
@@ -386,6 +616,8 @@ class ServingEngine:
         if self._health_shed and nf:
             # poisoned prefill: the first token is garbage — shed BEFORE
             # streaming anything (the request never takes a slot)
+            if self.paged:
+                self.pool_mgr.release_blocks(shared_blocks)
             self.metrics.record_shed("unhealthy_slot")
             self.metrics.record_unhealthy()
             self.tracer.instant("request/unhealthy", cat="serving", ts=now,
@@ -413,18 +645,55 @@ class ServingEngine:
                 reason = FINISH_STOP
             else:
                 reason = FINISH_LENGTH
+            if self.paged:
+                # finished at the first token: no blocks were bound
+                self.pool_mgr.release_blocks(shared_blocks)
             self._finish(req, reason, now)
             events.append(TokenEvent(req.request_id, t, 0, True, reason, now))
             return
         slot = self._free_slots.pop()
         self._slots[slot] = req
         req.slot = slot
-        self._state = self._insert_jit(
-            self._state, np.int32(slot), cache["k"], cache["v"], tok[0],
-            np.int32(req.prompt_len), np.int32(req.max_new_tokens - 1),
-            keys[1], np.float32(s.temperature), np.int32(s.top_k),
-            np.float32(s.top_p), np.int32(-1 if eos is None else eos))
+        if self.paged:
+            self._insert_paged(req, slot, cache, shared_len, shared_blocks,
+                               tok, keys[1], s, eos)
+        else:
+            self._state = self._insert_jit(
+                self._state, np.int32(slot), cache["k"], cache["v"], tok[0],
+                np.int32(req.prompt_len), np.int32(req.max_new_tokens - 1),
+                keys[1], np.float32(s.temperature), np.int32(s.top_k),
+                np.float32(s.top_p), np.int32(-1 if eos is None else eos))
         events.append(TokenEvent(req.request_id, t, 0, False, None, now))
+
+    def _insert_paged(self, req, slot, cache, shared_len, shared_blocks,
+                      tok, chain_key, s, eos):
+        """Bind a paged slot: allocate the request's footprint in blocks,
+        copy the freshly-prefilled PRIVATE blocks from the dense cache
+        (shared prefix blocks are refcounted, never copied — copy-on-write),
+        set the slot's table row + scalars, and content-address the full
+        prompt blocks for future prefix hits."""
+        mgr = self.pool_mgr
+        needed = mgr.blocks_for(req.prompt_len, req.max_new_tokens)
+        # the scheduler's can_admit reserved this; alloc may still evict
+        private = mgr.alloc(needed - len(shared_blocks))
+        blocks = list(shared_blocks) + private
+        ids = np.full((mgr.blocks_per_slot,), GARBAGE_BLOCK, np.int32)
+        srcs = np.zeros((mgr.blocks_per_slot,), np.int32)
+        for i, bid in enumerate(private):
+            ids[i] = bid
+            srcs[i] = (len(shared_blocks) + i) * mgr.block_size
+        self._state = self._insert_block_jit(
+            self._state, cache["k"], cache["v"], jnp.asarray(ids),
+            jnp.asarray(srcs))
+        row = np.full((mgr.blocks_per_slot,), GARBAGE_BLOCK, np.int32)
+        row[:len(blocks)] = blocks
+        self._state = self._insert_jit(
+            self._state, np.int32(slot), jnp.asarray(row), tok[0],
+            np.int32(req.prompt_len), np.int32(req.max_new_tokens - 1),
+            chain_key, np.float32(s.temperature), np.int32(s.top_k),
+            np.float32(s.top_p), np.int32(-1 if eos is None else eos))
+        mgr.bind_slot(slot, blocks, req.prompt_len + req.max_new_tokens - 1)
+        mgr.register_prefix(req.prompt, blocks)
 
     def _decode_once(self, events):
         with self.tracer.span("decode_step", cat="serving",
@@ -486,7 +755,15 @@ class ServingEngine:
         if req.slot is not None:
             del self._slots[req.slot]
             self._free_slots.append(req.slot)
-            if deactivate or self.cfg.scrub_freed_slots:
+            if self.paged:
+                # ALWAYS release under paging: the table row must retreat
+                # to the garbage block before the allocator reuses the
+                # blocks (the dense pool's rows are private, so it only
+                # releases for host-side stops / the hygiene scrub)
+                self._state = self._release_jit(self._state,
+                                                np.int32(req.slot))
+                self.pool_mgr.free_slot(req.slot)
+            elif deactivate or self.cfg.scrub_freed_slots:
                 self._state = self._release_jit(self._state,
                                                 np.int32(req.slot))
             req.slot = None
@@ -553,7 +830,11 @@ class ServingEngine:
         self._insert_jit = None
         self._release_jit = None
         self._sample_first_jit = None
+        self._insert_block_jit = None
+        self._seed_cache_jit = None
+        self._scrub_jit = None
         self._prefill_programs = OrderedDict()
+        self._suffix_programs = OrderedDict()
         self._slots = {}
         self._free_slots = list(range(self.n_slots - 1, -1, -1))
         self.tracer.flush()

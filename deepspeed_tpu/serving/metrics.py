@@ -22,11 +22,16 @@ def percentile(samples, q):
 
 
 class ServingMetrics:
-    def __init__(self, n_slots, clock, monitor=None, interval=32):
+    def __init__(self, n_slots, clock, monitor=None, interval=32,
+                 kv_pool=None):
         self.n_slots = n_slots
         self.clock = clock
         self.monitor = monitor
         self.interval = int(interval)
+        # paged KV pool stats source (KVPoolManager.stats): block occupancy,
+        # internal fragmentation, prefix hit rate — the memory-side truth
+        # the slot-occupancy number no longer tells under paging
+        self.kv_pool = kv_pool
         self.start_time = clock.now()
         self._started = False       # start_time re-pins at first activity
         self._window_tokens = 0     # tokens since the last reset_window()
@@ -39,6 +44,7 @@ class ServingMetrics:
         self.steps = 0
         self._queue_depth = 0
         self._active_slots = 0
+        self.active_slots_peak = 0   # paged pool's ">= 2x effective slots" pin
         # numerics health (fed by the decode program's in-graph
         # nonfinite-logit count; see serving/engine.py _decode_once)
         self.nonfinite_logit_steps = 0  # decode steps with >=1 bad active slot
@@ -106,6 +112,7 @@ class ServingMetrics:
         self.steps += 1
         self._queue_depth = queue_depth
         self._active_slots = active_slots
+        self.active_slots_peak = max(self.active_slots_peak, active_slots)
         if self.monitor is not None and getattr(self.monitor, "enabled", False) \
                 and self.interval > 0 and self.steps % self.interval == 0:
             self.emit_events()
@@ -151,10 +158,13 @@ class ServingMetrics:
             "steps": self.steps,
             "queue_depth": self._queue_depth,
             "slot_occupancy": self._active_slots / max(self.n_slots, 1),
+            "active_slots_peak": self.active_slots_peak,
             "health": {
                 "nonfinite_logit_steps": self.nonfinite_logit_steps,
                 "unhealthy_slots": self.unhealthy_slots,
             },
+            **({"kv_pool": self.kv_pool()} if self.kv_pool is not None
+               else {}),
         }
 
     def emit_events(self):
@@ -173,6 +183,17 @@ class ServingMetrics:
             ("Serving/health_unhealthy_slots",
              float(self.unhealthy_slots), self.steps),
         ]
+        if self.kv_pool is not None:
+            kv = self.kv_pool()
+            events += [
+                ("Serving/kv_occupancy", float(kv["occupancy"]), self.steps),
+                ("Serving/kv_fragmentation", float(kv["fragmentation"]),
+                 self.steps),
+                ("Serving/kv_capacity_tokens",
+                 float(kv["capacity_tokens"]), self.steps),
+                ("Serving/prefix_hit_rate", float(kv["prefix_hit_rate"]),
+                 self.steps),
+            ]
         p50 = percentile(self.ttft_samples, 50)
         if p50 is not None:
             events.append(("Serving/ttft_ms", p50 * 1e3, self.steps))

@@ -8,8 +8,8 @@ rejecting work, never by growing host/device memory until it falls over.
 
 import collections
 
-from .request import (REJECT_BAD_REQUEST, REJECT_PROMPT_TOO_LONG,
-                      REJECT_QUEUE_FULL, RequestState)
+from .request import (REJECT_BAD_REQUEST, REJECT_NO_FREE_BLOCKS,
+                      REJECT_PROMPT_TOO_LONG, REJECT_QUEUE_FULL, RequestState)
 
 
 class RequestQueue:
@@ -25,17 +25,23 @@ class RequestQueue:
     def depth(self):
         return len(self._q)
 
-    def admit(self, request, max_total_len):
+    def admit(self, request, max_total_len, kv_fits=None):
         """Admission control: accept ``request`` into the queue or shed it.
 
         Returns None on admission; on shed, marks the request REJECTED and
         returns the reason string. ``max_total_len`` is the per-slot KV
-        window that prompt + generation must fit."""
+        window that prompt + generation must fit. ``kv_fits`` (paged KV
+        pool): (prompt_len, max_new_tokens) -> bool — False means the
+        request's block footprint exceeds what the pool could EVER free, so
+        queueing it would wait forever: shed ``no_free_blocks`` now."""
         reason = None
         if request.prompt_len < 1 or request.max_new_tokens < 1:
             reason = REJECT_BAD_REQUEST
         elif request.prompt_len + request.max_new_tokens > max_total_len:
             reason = REJECT_PROMPT_TOO_LONG
+        elif kv_fits is not None and not kv_fits(request.prompt_len,
+                                                request.max_new_tokens):
+            reason = REJECT_NO_FREE_BLOCKS
         elif len(self._q) >= self.max_depth:
             reason = REJECT_QUEUE_FULL
         if reason is not None:

@@ -24,6 +24,8 @@ class RequestState(enum.Enum):
 REJECT_QUEUE_FULL = "queue_full"
 REJECT_PROMPT_TOO_LONG = "prompt_too_long"
 REJECT_BAD_REQUEST = "bad_request"
+# paged KV pool: the request's block footprint exceeds the pool's capacity
+REJECT_NO_FREE_BLOCKS = "no_free_blocks"
 
 FINISH_EOS = "eos"
 FINISH_LENGTH = "length"

@@ -26,16 +26,23 @@ class ServingScheduler:
         self.n_slots = n_slots
         self.max_prefills_per_step = max(int(max_prefills_per_step), 1)
 
-    def next_admissions(self, free_slots, now):
+    def next_admissions(self, free_slots, now, can_admit=None):
         """Requests to prefill this step: bounded by free slots AND the
         per-step prefill cap. ``now`` gates open-loop arrivals that were
-        queued with a future arrival_time (virtual-clock simulations)."""
+        queued with a future arrival_time (virtual-clock simulations).
+
+        ``can_admit``: optional capacity predicate (the paged KV pool's
+        block-availability check). A head it rejects WAITS at the front —
+        FCFS, nothing behind it may jump the queue — until running requests
+        free blocks."""
         out = []
         budget = min(free_slots, self.max_prefills_per_step)
         while budget > 0 and len(self.queue):
             head = self.queue.peek()
             if head.arrival_time is not None and head.arrival_time > now:
                 break  # FCFS: nothing behind it may jump the queue
+            if can_admit is not None and not can_admit(head):
+                break  # not enough KV blocks yet; hold the line (FCFS)
             out.append(self.queue.pop())
             budget -= 1
         return out

@@ -381,6 +381,48 @@ def test_serving_decode_within_sanitizer_budget(decode_report):
     assert count_at_or_above(san["findings"], "warning") == 0
 
 
+@pytest.fixture(scope="module")
+def decode_report_paged(devices8):
+    """tools/program_lint.py --program decode --paged geometry: the PAGED
+    decode program (block-table gathers + pool writeback) held to the
+    checked-in serving-decode-paged/8/bf16 budget — the fence for ROADMAP
+    item 1's rewrite, enforced tier-1 alongside the dense gate."""
+    import jax.numpy as jnp
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models import CausalLM, TransformerConfig
+
+    model = CausalLM(TransformerConfig(
+        vocab_size=512, max_seq_len=64, n_layers=4, n_heads=4,
+        d_model=128, d_ff=256, compute_dtype=jnp.bfloat16))
+    engine = deepspeed_tpu.init_inference(
+        model=model,
+        config={"dtype": "bfloat16", "max_tokens": 64,
+                "serving": {"n_slots": 4, "max_len": 64,
+                            "virtual_clock": True,
+                            "kv_pool": {"enabled": True,
+                                        "block_size": 16}}})
+    report = engine.decode_program_report()
+    yield report
+    engine.destroy()
+
+
+def test_serving_decode_paged_within_sanitizer_budget(decode_report_paged):
+    from deepspeed_tpu.profiling.collectives import check_budgets
+
+    v = check_budgets(decode_report_paged,
+                      BUDGETS["serving-decode-paged/8/bf16"])
+    assert not v, v
+    san = decode_report_paged["sanitizer"]
+    assert count_at_or_above(san["findings"], "warning") == 0
+    # full donation of the paged pool state: k/v pool + block table +
+    # per-slot cursors/rng/knobs all alias outputs, zero host transfers —
+    # the paged rewrite kept the program inside the same fence
+    assert san["summary"]["n_aliased_params"] == 12
+    assert san["summary"]["undonated_candidate_bytes"] == 0
+    assert san["summary"]["transfer_count"] == 0
+
+
 def test_serving_decode_slot_state_fully_donated(decode_report):
     """The donation discipline the slot pool depends on: every state leaf
     (KV pool, cursors, rng, sampling knobs — 11 arrays) aliases an output,

@@ -389,19 +389,24 @@ def test_serving_tp_mesh_parity(devices8):
     eng.destroy()
 
 
-def test_bench_serving_qps_smoke(tmp_path):
+@pytest.mark.parametrize("paged", [True, False], ids=["paged", "dense"])
+def test_bench_serving_qps_smoke(tmp_path, paged):
     """tools/bench_serving.py --qps emits the throughput–latency artifact on
     the tiny preset under JAX_PLATFORMS=cpu (tier-1 smoke, incl. overload
-    shed accounting)."""
+    shed accounting) — both the dense default and, with --paged, the
+    kv_pool block the committed artifact carries (occupancy, fragmentation,
+    prefix hit rate, shed histogram)."""
     out = tmp_path / "serving_load.json"
     env = dict(os.environ, JAX_PLATFORMS="cpu")
-    proc = subprocess.run(
-        [sys.executable, os.path.join(REPO, "tools", "bench_serving.py"),
-         "--qps", "200", "--num-requests", "10", "--family", "gpt2",
-         "--sizes", "tiny", "--modes", "bf16", "--prompts", "8,16",
-         "--new-tokens", "6", "--slots", "2", "--queue-depth", "3",
-         "--seed", "0", "--output", str(out)],
-        cwd=REPO, env=env, capture_output=True, text=True, timeout=300)
+    cmd = [sys.executable, os.path.join(REPO, "tools", "bench_serving.py"),
+           "--qps", "200", "--num-requests", "10", "--family", "gpt2",
+           "--sizes", "tiny", "--modes", "bf16", "--prompts", "8,16",
+           "--new-tokens", "6", "--slots", "2", "--queue-depth", "3",
+           "--seed", "0", "--output", str(out)]
+    if paged:
+        cmd += ["--paged", "--kv-block-size", "8", "--shared-prefix", "8"]
+    proc = subprocess.run(cmd, cwd=REPO, env=env, capture_output=True,
+                          text=True, timeout=300)
     assert proc.returncode == 0, proc.stdout + proc.stderr
     art = json.loads(out.read_text())
     assert art["bench"] == "serving_open_loop"
@@ -411,3 +416,13 @@ def test_bench_serving_qps_smoke(tmp_path):
     assert art["ttft_ms"]["p50"] is not None
     assert art["tokens_per_s"] > 0
     assert art["compile_counts"]["decode"] == 1
+    assert art["numerics"]["nonfinite_logit_steps"] == 0
+    if paged:
+        kv = art["kv_pool"]
+        assert kv["n_blocks"] > 1 and kv["block_size"] == 8
+        assert 0.0 <= kv["occupancy"] <= 1.0
+        assert 0.0 <= kv["fragmentation"] <= 1.0
+        assert "prefix_hit_rate" in kv and "shed_reasons" in kv
+        assert sum(kv["shed_reasons"].values()) == art["shed"]
+    else:
+        assert "kv_pool" not in art  # dense path unchanged

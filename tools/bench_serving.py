@@ -163,19 +163,29 @@ def run_open_loop(args):
     prompts = [int(p) for p in args.prompts.split(",")]
     max_tokens = ((max(prompts) + args.new_tokens + 63) // 64) * 64
     engine, n_params, _ = build_engine(args.family, size, mode, max_tokens)
-    engine._config.serving = engine._config.serving.replace(
-        n_slots=args.slots, max_queue_depth=args.queue_depth)
+    serving_kw = dict(n_slots=args.slots, max_queue_depth=args.queue_depth)
+    if args.paged:
+        serving_kw["kv_pool"] = {
+            "enabled": True, "block_size": args.kv_block_size,
+            "n_blocks": args.kv_blocks, "kv_dtype": args.kv_dtype}
+    engine._config.serving = engine._config.serving.replace(**serving_kw)
 
     rng = np.random.RandomState(args.seed)
     arrivals = np.cumsum(rng.exponential(1.0 / args.qps, args.num_requests))
     vocab = engine.module.config.vocab_size
+    # --shared-prefix: every prompt opens with the SAME system-prompt tokens
+    # (the paged pool's prefix cache turns the repeats into block hits)
+    shared = rng.randint(0, vocab, (max(args.shared_prefix, 0),)) \
+        .astype(np.int32)
     requests = []
     for i in range(args.num_requests):
         plen = int(rng.choice(prompts))
         new = int(rng.randint(max(args.new_tokens // 2, 1),
                               args.new_tokens + 1))
+        tail = rng.randint(0, vocab,
+                           (max(plen - len(shared), 1),)).astype(np.int32)
         requests.append(Request(
-            prompt=rng.randint(0, vocab, (plen,)).astype(np.int32),
+            prompt=np.concatenate([shared, tail])[:max(plen, 1)],
             max_new_tokens=new, arrival_time=float(arrivals[i])))
 
     # compile outside the measured window (the reference's capture-at-init):
@@ -229,13 +239,24 @@ def run_open_loop(args):
         "numerics": metrics_snap.get("health", {}),
         "n_params_m": round(n_params / 1e6, 1),
     }
+    if "kv_pool" in metrics_snap:
+        # paged-pool accounting next to the run stamp / numerics blocks: a
+        # tokens/s number means something different at 30% vs 95% block
+        # occupancy, and the shed histogram says WHY work was turned away
+        artifact["kv_pool"] = dict(
+            metrics_snap["kv_pool"],
+            kv_dtype=args.kv_dtype or "engine",
+            shed_reasons=dict(metrics_snap.get("shed", {})))
     from _common import stamp_record
 
     stamp_record(artifact, config={
         "family": args.family, "size": size, "mode": mode, "qps": args.qps,
         "num_requests": args.num_requests, "slots": args.slots,
         "queue_depth": args.queue_depth, "prompts": prompts,
-        "new_tokens": args.new_tokens, "seed": args.seed})
+        "new_tokens": args.new_tokens, "seed": args.seed,
+        "paged": bool(args.paged), "kv_block_size": args.kv_block_size,
+        "kv_blocks": args.kv_blocks, "kv_dtype": args.kv_dtype,
+        "shared_prefix": args.shared_prefix})
     print(json.dumps(artifact), flush=True)
     if args.output:
         with open(args.output, "w") as f:
@@ -260,6 +281,18 @@ def main():
     ap.add_argument("--num-requests", type=int, default=64)
     ap.add_argument("--slots", type=int, default=4)
     ap.add_argument("--queue-depth", type=int, default=8)
+    ap.add_argument("--paged", action="store_true",
+                    help="open-loop mode over the PAGED KV pool "
+                         "(serving.kv_pool): the artifact gains a kv_pool "
+                         "block (occupancy, fragmentation, prefix_hit_rate, "
+                         "shed histogram)")
+    ap.add_argument("--kv-block-size", type=int, default=16)
+    ap.add_argument("--kv-blocks", type=int, default=0,
+                    help="0 = auto (dense-equivalent token capacity)")
+    ap.add_argument("--kv-dtype", default="", choices=["", "int8"])
+    ap.add_argument("--shared-prefix", type=int, default=0,
+                    help="open every prompt with this many IDENTICAL "
+                         "system-prompt tokens (exercises the prefix cache)")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--output", default=None,
                     help="write the open-loop JSON artifact here")

@@ -12,6 +12,8 @@ recompile hazards, and a liveness-walk peak-HBM estimate.
     python tools/program_lint.py --program train --preset tiny-test \
         --devices 8 --budget tiny-test/8/bf16 --fail-on error
     python tools/program_lint.py --program decode --budget serving-decode/8/bf16
+    python tools/program_lint.py --program decode --paged \
+        --budget serving-decode-paged/8/bf16 --fail-on warning
 
     # regression check at headline scale (abstract 256-chip mesh):
     python tools/program_lint.py --program train --preset opt-13b \
@@ -78,14 +80,20 @@ def lint_decode(args):
         n_layers=preset["n_layers"], n_heads=preset["n_heads"],
         d_model=preset["d_model"], d_ff=preset["d_ff"],
         compute_dtype=jnp.bfloat16))
+    serving = {"n_slots": args.slots, "max_len": max_len,
+               "virtual_clock": True}
+    if args.paged:
+        serving["kv_pool"] = {"enabled": True,
+                              "block_size": args.kv_block_size,
+                              "kv_dtype": args.kv_dtype}
     engine = deepspeed_tpu.init_inference(
         model=model,
         config={"dtype": "bfloat16", "max_tokens": max_len,
-                "serving": {"n_slots": args.slots, "max_len": max_len,
-                            "virtual_clock": True}})
+                "serving": serving})
     report = engine.decode_program_report()
     report.update({"preset": args.preset, "devices": args.devices,
                    "n_slots": args.slots, "serving_max_len": max_len,
+                   "paged": bool(args.paged),
                    "n_params": engine.module.num_parameters
                    if hasattr(engine.module, "num_parameters") else None})
     engine.destroy()
@@ -237,6 +245,12 @@ def main():
                     choices=["fp32", "bf16"])
     ap.add_argument("--slots", type=int, default=4)
     ap.add_argument("--serving-max-len", type=int, default=None)
+    ap.add_argument("--paged", action="store_true",
+                    help="decode program over the PAGED KV pool "
+                         "(serving.kv_pool) instead of the dense slot pool; "
+                         "gate with --budget serving-decode-paged/8/bf16")
+    ap.add_argument("--kv-block-size", type=int, default=16)
+    ap.add_argument("--kv-dtype", default="", choices=["", "int8"])
     ap.add_argument("--budget", default=None,
                     help="key into tools/collective_budgets.json; applies "
                          "to every linted program, violations exit 2")
@@ -270,7 +284,12 @@ def main():
            "--gather-dtype", args.gather_dtype,
            "--gather-impl", args.gather_impl,
            "--grad-reduce-dtype", args.grad_reduce_dtype,
-           "--slots", str(args.slots)]
+           "--slots", str(args.slots),
+           "--kv-block-size", str(args.kv_block_size)]
+    if args.paged:
+        cmd += ["--paged"]
+    if args.kv_dtype:
+        cmd += ["--kv-dtype", args.kv_dtype]
     if args.serving_max_len:
         cmd += ["--serving-max-len", str(args.serving_max_len)]
     proc = subprocess.run(cmd, env=env, cwd=REPO, stdout=subprocess.PIPE,
