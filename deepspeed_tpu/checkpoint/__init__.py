@@ -9,10 +9,11 @@ from .atomic import (
     read_latest,
     list_tags,
 )
+from .snapshot import GraceBudgeter, SnapshotManager
 
 __all__ = [
     "CheckpointEngine", "NpzCheckpointEngine", "AsyncCheckpointEngine",
     "CheckpointError", "CheckpointCorruptionError", "TornWriteError",
     "verify_checkpoint_dir", "resume_candidates", "quarantine",
-    "read_latest", "list_tags",
+    "read_latest", "list_tags", "GraceBudgeter", "SnapshotManager",
 ]

@@ -1,0 +1,394 @@
+"""Overlapped step-state snapshots + grace-window flush for elastic training.
+
+The preemption-native checkpoint path (ROADMAP item 5): instead of stopping
+the train loop every ``save_interval`` steps for a synchronous save, the
+:class:`SnapshotManager` keeps a **double-buffered host shadow** of the full
+step state (params / optimizer / loss-scale / rng / counters, via
+``engine.capture_step_state``) and drains it to published sharded tags in a
+background writer thread:
+
+- ``capture`` (every ``elastic.snapshot_interval`` steps, between step
+  dispatches): issue ``copy_to_host_async`` on every addressable shard in one
+  pass, then materialize the deduplicated host shards (the sharded engine's
+  ``_prepare``). No file I/O happens on the step path, and the capture must
+  complete before the next dispatch anyway — the step functions donate the
+  very buffers being read.
+- background **writer**: stages and publishes the shadow as a normal
+  ``<prefix>-step<N>`` tag (full COMMITTED marker — every snapshot is a valid
+  resume candidate the moment it is published). Freshest-wins: if the writer
+  is still busy when a new shadow lands, the waiting shadow is replaced, so
+  at most one write is ever queued.
+- ``flush`` (SIGTERM / end of run): join the in-flight write, write the
+  **not-yet-written remainder** (only if a fresher shadow was waiting — never
+  a from-scratch save), then swap the ``latest`` pointer. Worst case is one
+  snapshot write + a ~20-byte pointer swap, which is what the grace budgeter
+  sizes against.
+
+The :class:`GraceBudgeter` measures real write+fsync time per snapshot and
+step time between captures, stretches the capture cadence when the writer
+cannot keep up (within ``[snapshot_interval, max_interval]``), and fires a
+once-per-run warning when a flush estimate no longer fits
+``grace_period_s / safety_factor`` — observable headroom
+(``Elastic/grace_margin_ms``), not assumed.
+
+Clocks are pluggable (``serving.clock.VirtualClock``) so every budgeter
+policy is assertable in tier-1 without real sleeps.
+"""
+
+import math
+import os
+import threading
+
+from ..utils.logging import logger
+from . import atomic
+from .sharded import ShardedCheckpointEngine
+
+
+class _WallClock:
+    """now()-only wall clock. Deliberately NOT serving.clock.WallClock:
+    importing it would execute the serving package __init__ (ServingEngine
+    -> inference engine) at checkpoint-package import time — a cycle. The
+    budgeter only ever calls now()."""
+
+    def now(self):
+        import time
+
+        return time.perf_counter()
+
+
+class GraceBudgeter:
+    """Measured flush-time vs grace-window accounting.
+
+    ``record_write`` feeds real write+fsync durations; ``record_step`` feeds
+    step durations (EWMA). ``flush_estimate_s`` is the conservative (max of
+    the recent window) time one snapshot write takes — the worst-case SIGTERM
+    flush. ``effective_interval`` stretches the capture cadence so the writer
+    drains between captures instead of piling freshest-wins drops.
+    """
+
+    def __init__(self, cfg):
+        self.grace_s = float(cfg.grace_period_s)
+        self.safety = float(cfg.safety_factor)
+        self.base_interval = int(cfg.snapshot_interval)
+        self.max_interval = int(cfg.max_interval)
+        self._writes = []  # trailing window of write durations (seconds)
+        self._step_ewma = None
+        self._warned = False
+        self.warnings = 0
+
+    def record_write(self, seconds):
+        self._writes.append(float(seconds))
+        del self._writes[:-32]
+
+    def record_step(self, seconds):
+        s = float(seconds)
+        self._step_ewma = s if self._step_ewma is None \
+            else 0.8 * self._step_ewma + 0.2 * s
+
+    def flush_estimate_s(self):
+        return max(self._writes) if self._writes else 0.0
+
+    def grace_margin_s(self):
+        """Headroom left in the grace window after a worst-case flush (with
+        the safety factor applied). Negative = a preemption may tear."""
+        return self.grace_s - self.flush_estimate_s() * self.safety
+
+    def effective_interval(self):
+        """Capture cadence: at least ``snapshot_interval``, stretched so one
+        write fits between captures (ceil(write / step_time)), capped at
+        ``max_interval`` — beyond the cap the writer simply skips shadows
+        (freshest-wins) rather than lying about the lost-work bound."""
+        if not self._writes or not self._step_ewma:
+            return self.base_interval
+        keep_up = math.ceil(self.flush_estimate_s()
+                            / max(self._step_ewma, 1e-9))
+        return max(self.base_interval, min(keep_up, self.max_interval))
+
+    def check(self, step):
+        """Once-per-run warning when the measured flush no longer fits the
+        grace window; returns the margin either way (for ``Elastic/*``)."""
+        margin = self.grace_margin_s()
+        if margin < 0 and not self._warned:
+            self._warned = True
+            self.warnings += 1
+            logger.warning(
+                "elastic: measured snapshot flush %.1f ms x safety %.1f "
+                "exceeds the %.1f ms preemption grace window — a SIGTERM "
+                "may arrive mid-write; shrink the state, raise "
+                "elastic.grace_period_s, or speed up checkpoint storage",
+                self.flush_estimate_s() * 1e3, self.safety,
+                self.grace_s * 1e3)
+        return margin
+
+
+class SnapshotManager:
+    """Double-buffered host shadow + background sharded writer + budgeter.
+
+    Single-process multi-device today (the tier-1 rig): every snapshot tag is
+    published with a full marker the moment the writer finishes, so the
+    recovery chain can resume from it even if the final ``latest`` swap never
+    happened. Multi-process jobs keep using the agent's synchronous
+    ``save_checkpoint`` path (the async commit would need the cross-rank
+    consensus fence on the signal path — out of scope here).
+    """
+
+    def __init__(self, engine, save_dir, *, cfg, tag_prefix="elastic",
+                 clock=None):
+        import jax
+
+        if jax.process_count() > 1:
+            raise NotImplementedError(
+                "SnapshotManager is single-process (multi-host elastic "
+                "flush needs the commit consensus fence on the signal path)")
+        self.engine = engine
+        self.save_dir = save_dir
+        self.cfg = cfg
+        self.tag_prefix = tag_prefix
+        self.clock = clock or _WallClock()
+        self.budget = GraceBudgeter(cfg)
+        self._io = ShardedCheckpointEngine(
+            getattr(engine.checkpoint_engine, "_retry", None))
+        self._lock = threading.Lock()
+        self._writer = None         # in-flight writer thread
+        self._writer_err = None     # last background failure (sticky til flush)
+        self._pending = None        # freshest captured-but-unwritten shadow
+        self._writing_tag = None    # tag the live writer owns right now
+        self._written_step = None   # newest fully PUBLISHED snapshot step
+        self._committed_step = None  # newest step 'latest' points at
+        self._last_capture_step = None
+        self._last_step_t = None
+        self.stats = {"snapshots": 0, "writes": 0, "dropped_shadows": 0,
+                      "flushes": 0, "flush_ms": [], "write_ms": []}
+
+    # -- capture --------------------------------------------------------------
+    def _issue_d2h(self, state_tree):
+        """One pass starting every shard's device-to-host copy before any is
+        read — the copies overlap each other (and, on an async backend, the
+        tail of the step) instead of serializing at np.asarray time.
+
+        Skipped on the CPU backend: host-to-host "transfers" are synchronous
+        there (no overlap to win), and on jaxlib 0.4.x ``copy_to_host_async``
+        against buffers produced by warm-compile-cache-deserialized
+        executables is in the PR 3 crash class."""
+        import jax
+
+        if jax.default_backend() == "cpu":
+            return
+
+        def issue(leaf):
+            if not isinstance(leaf, jax.Array):
+                return
+            try:
+                if hasattr(leaf, "addressable_shards") \
+                        and leaf.addressable_shards:
+                    for shard in leaf.addressable_shards:
+                        if getattr(shard, "replica_id", 0) == 0:
+                            shard.data.copy_to_host_async()
+                else:
+                    leaf.copy_to_host_async()
+            except Exception:
+                pass  # best-effort prefetch; _prepare's read is authoritative
+
+        jax.tree_util.tree_map(issue, state_tree)
+
+    def maybe_snapshot(self, client_state=None):
+        """Per-step hook (call right after ``train_batch``). Captures on the
+        budgeted cadence, feeds the step-time EWMA, emits ``Elastic/*``."""
+        now = self.clock.now()
+        if self._last_step_t is not None:
+            self.budget.record_step(now - self._last_step_t)
+        self._last_step_t = now
+        step = self.engine.global_steps
+        interval = self.budget.effective_interval()
+        if self._last_capture_step is not None \
+                and step - self._last_capture_step < interval:
+            self._emit(step)
+            return False
+        self.capture(client_state)
+        self._emit(step)
+        return True
+
+    def capture(self, client_state=None):
+        """Pull the deduplicated host shards of the live step state into a
+        shadow and hand it to the writer (or park it as pending)."""
+        step = self.engine.global_steps
+        with self.engine.tracer.span("checkpoint/snapshot", cat="checkpoint",
+                                     step=step):
+            state, meta = self.engine.capture_step_state(client_state)
+            self._issue_d2h(state)
+            blobs, pieces, manifest = self._io._prepare(state)
+        shadow = (step, blobs, pieces, manifest, meta)
+        self._last_capture_step = step
+        self.stats["snapshots"] += 1
+        with self._lock:
+            if self._pending is not None:
+                # freshest-wins in BOTH branches: a parked shadow orphaned by
+                # a failed write must never be resurrected after this newer
+                # one (it would regress _written_step and point 'latest'
+                # backwards at flush)
+                self.stats["dropped_shadows"] += 1
+                self._pending = None
+            if self._writer is not None and self._writer.is_alive():
+                self._pending = shadow
+                return
+            self._start_write(shadow)
+
+    # -- background writer ----------------------------------------------------
+    def _tag(self, step):
+        return f"{self.tag_prefix}-step{step}"
+
+    def _start_write(self, shadow):
+        # caller holds self._lock
+        self._writing_tag = self._tag(shadow[0])
+        self._writer = threading.Thread(
+            target=self._write, args=(shadow,), daemon=True)
+        self._writer.start()
+
+    def _write(self, shadow):
+        step, blobs, pieces, manifest, meta = shadow
+        path = os.path.join(self.save_dir, self._tag(step))
+        t0 = self.clock.now()
+        try:
+            with self.engine.tracer.span("checkpoint/snapshot_write",
+                                         cat="checkpoint", step=step):
+                self._io._stage(path, blobs, pieces, manifest, meta)
+                self._io._finalize(path, meta)
+        except BaseException as e:
+            self._writer_err = e
+            return
+        finally:
+            dt = self.clock.now() - t0
+            self.budget.record_write(dt)
+            self.stats["write_ms"].append(dt * 1e3)
+        self.stats["writes"] += 1
+        self._writer_err = None  # a newer successful write heals older ones
+        with self._lock:
+            # monotone: a write completing out of order (a stale shadow that
+            # slipped through) must never regress the freshest published step
+            advanced = self._written_step is None or step > self._written_step
+            if advanced:
+                self._written_step = step
+            self._writing_tag = None
+            nxt, self._pending = self._pending, None
+            if nxt is not None:
+                if nxt[0] > step:
+                    self._start_write(nxt)
+                else:
+                    self.stats["dropped_shadows"] += 1
+        if advanced:
+            # commit as we go: the tag is fully durable (staged + fsynced +
+            # marker + publish), so advancing 'latest' here makes every
+            # snapshot count toward keep_last retention immediately — tags
+            # no longer pile up uncommitted between periodic flushes (only
+            # the remainder window stays protected from pruning). A flake
+            # on the ~20-byte swap is left for flush to retry.
+            try:
+                atomic.publish_latest(self.save_dir, self._tag(step))
+                self._committed_step = step
+            except OSError as e:
+                logger.warning("elastic: snapshot latest swap failed (%s) — "
+                               "the next flush retries it", e)
+
+    def _drain(self):
+        while True:
+            with self._lock:
+                w = self._writer
+            if w is None or not w.is_alive():
+                # one more pending shadow may have been promoted to a live
+                # writer between checks — loop until genuinely idle
+                with self._lock:
+                    if self._writer is w or self._writer is None:
+                        break
+                continue
+            w.join()
+
+    # -- flush (the grace-window path) ----------------------------------------
+    def finalize(self, reason="final"):
+        """End-of-run commit: capture the live state if the cadence skipped
+        it (the run's last step must never be lost), then flush."""
+        if self._last_capture_step != self.engine.global_steps:
+            self.capture()
+        return self.flush(reason)
+
+    def flush(self, reason="flush"):
+        """Commit the freshest shadow: join the in-flight write, write only
+        the not-yet-written remainder, swap ``latest``. Returns the committed
+        ``(tag, step)`` or ``None`` when nothing was ever captured."""
+        step = self.engine.global_steps
+        t0 = self.clock.now()
+        with self.engine.tracer.span("checkpoint/flush", cat="checkpoint",
+                                     reason=reason, step=step):
+            self._drain()
+            err, self._writer_err = self._writer_err, None
+            with self._lock:
+                remainder, self._pending = self._pending, None
+            if remainder is None and err is not None:
+                # the freshest shadow's background write failed and nothing
+                # newer was waiting: that shadow IS the remainder — rebuild
+                # it from its tag (the stage is torn; re-stage from memory is
+                # gone) by re-raising so the agent falls back to a sync save
+                raise atomic.CheckpointError(
+                    "elastic flush: background snapshot write failed and no "
+                    "fresher shadow is available") from err
+            if remainder is not None and (
+                    self._written_step is None
+                    or remainder[0] > self._written_step):
+                # the writer fell behind (or died): stage the remainder NOW —
+                # still from the already-captured host shadow, never a fresh
+                # device pull (a remainder no newer than what's published is
+                # just dropped)
+                rstep, blobs, pieces, manifest, meta = remainder
+                path = os.path.join(self.save_dir, self._tag(rstep))
+                t_w = self.clock.now()
+                self._io._stage(path, blobs, pieces, manifest, meta)
+                self._io._finalize(path, meta)
+                self.budget.record_write(self.clock.now() - t_w)
+                self.stats["writes"] += 1
+                self._written_step = rstep
+            if self._written_step is None:
+                return None
+            if self._committed_step != self._written_step:
+                tag = self._tag(self._written_step)
+                atomic.publish_latest(self.save_dir, tag)
+                self._committed_step = self._written_step
+        dt = self.clock.now() - t0
+        self.stats["flushes"] += 1
+        self.stats["flush_ms"].append(dt * 1e3)
+        margin = self.budget.check(step)
+        self._monitor_events(
+            [("Elastic/flush_ms", dt * 1e3, step),
+             ("Elastic/grace_margin_ms", margin * 1e3, step)])
+        return self._tag(self._committed_step), self._committed_step
+
+    # -- telemetry ------------------------------------------------------------
+    def _emit(self, step):
+        age = step - (self._last_capture_step
+                      if self._last_capture_step is not None else 0)
+        self._monitor_events(
+            [("Elastic/snapshot_age_steps", float(age), step),
+             ("Elastic/snapshots", float(self.stats["snapshots"]), step),
+             ("Elastic/grace_margin_ms",
+              self.budget.grace_margin_s() * 1e3, step)])
+
+    def _monitor_events(self, events):
+        mon = getattr(self.engine, "monitor", None)
+        if mon is not None and getattr(mon, "enabled", False):
+            mon.write_events(events)
+
+    @property
+    def committed_step(self):
+        return self._committed_step
+
+    @property
+    def live_tags(self):
+        """Tags the writer currently owns (never prune these)."""
+        with self._lock:
+            tags = set()
+            if self._writing_tag:
+                tags.add(self._writing_tag)
+            if self._pending is not None:
+                tags.add(self._tag(self._pending[0]))
+            return tags
+
+    def close(self):
+        self._drain()

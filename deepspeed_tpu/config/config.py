@@ -468,6 +468,55 @@ class HealthConfig(ConfigModel):
                 f"health.max_dumps must be >= 1, got {self.max_dumps}")
 
 
+class ElasticConfig(ConfigModel):
+    """Preemption-native elastic training (``checkpoint/snapshot.py`` +
+    ``elasticity/agent.py``). ``enabled`` arms overlapped snapshots: the
+    agent keeps a double-buffered host shadow of the full step state,
+    captured every ``snapshot_interval`` steps (async device-to-host issue,
+    no file I/O on the step path) and drained to a published sharded tag by
+    a background writer. On SIGTERM the flush commits the freshest
+    already-staged shadow — bounded by one snapshot write, never a
+    from-scratch save — so a preemption loses at most ``snapshot_interval``
+    steps. The grace budgeter measures real write+fsync time per snapshot
+    and warns (once per run) when ``flush_time * safety_factor`` no longer
+    fits ``grace_period_s``, stretching the cadence within
+    ``[snapshot_interval, max_interval]`` when the writer can't keep up."""
+
+    enabled: bool = False
+    # steps between shadow captures (the max steps a preemption can lose)
+    snapshot_interval: int = 1
+    # the preemption grace window the SIGTERM flush must fit (seconds)
+    grace_period_s: float = 30.0
+    # flush must fit grace_period_s / safety_factor before the budgeter warns
+    safety_factor: float = 2.0
+    # cadence ceiling when the budgeter stretches a too-slow writer
+    max_interval: int = 64
+    # keep the newest N snapshot tags (retention; None = keep everything)
+    keep_last: typing.Optional[int] = 4
+
+    def _validate(self):
+        if self.snapshot_interval < 1:
+            raise ConfigError(
+                f"elastic.snapshot_interval must be >= 1, got "
+                f"{self.snapshot_interval}")
+        if self.max_interval < self.snapshot_interval:
+            raise ConfigError(
+                f"elastic.max_interval must be >= snapshot_interval "
+                f"({self.snapshot_interval}), got {self.max_interval}")
+        if self.grace_period_s <= 0:
+            raise ConfigError(
+                f"elastic.grace_period_s must be > 0, got "
+                f"{self.grace_period_s}")
+        if self.safety_factor < 1.0:
+            raise ConfigError(
+                f"elastic.safety_factor must be >= 1.0, got "
+                f"{self.safety_factor}")
+        if self.keep_last is not None and self.keep_last < 1:
+            raise ConfigError(
+                f"elastic.keep_last must be >= 1 or null, got "
+                f"{self.keep_last}")
+
+
 class FlopsProfilerConfig(ConfigModel):
     """Reference: ``profiling/config.py``."""
 
@@ -541,6 +590,7 @@ class DeepSpeedConfig(ConfigModel):
     csv_monitor: CSVConfig = CSVConfig
     telemetry: TelemetryConfig = TelemetryConfig
     health: HealthConfig = HealthConfig
+    elastic: ElasticConfig = ElasticConfig
     comms_logger: CommsLoggerConfig = CommsLoggerConfig
     flops_profiler: FlopsProfilerConfig = FlopsProfilerConfig
     data_types: DataTypesConfig = DataTypesConfig

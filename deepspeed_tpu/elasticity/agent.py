@@ -33,16 +33,46 @@ from ..utils.logging import log_dist, logger
 
 class ElasticAgent:
     def __init__(self, engine, save_dir, *, save_interval=100,
-                 tag_prefix="elastic", keep_last=None):
+                 tag_prefix="elastic", keep_last=None, clock=None):
         self.engine = engine
         self.save_dir = save_dir
         self.save_interval = save_interval
         self.tag_prefix = tag_prefix
+        # overlapped snapshots (checkpoint/snapshot.py), armed by the
+        # engine's `elastic` config block: the shadow capture + background
+        # writer replace the synchronous save_interval saves, and the
+        # SIGTERM path commits the freshest shadow inside the grace window
+        self.snapshots = None
+        ecfg = getattr(getattr(engine, "config", None), "elastic", None)
+        if ecfg is not None and ecfg.enabled:
+            ckpt_cfg = getattr(engine.config, "checkpoint", None)
+            if ckpt_cfg is not None and ckpt_cfg.engine != "sharded":
+                from ..config import ConfigError
+
+                # the snapshot writer emits the sharded layout; resuming it
+                # through an npz engine would fail every tag and the
+                # recovery chain would then QUARANTINE the healthy
+                # snapshots — reject the combination up front
+                raise ConfigError(
+                    f"elastic.enabled requires checkpoint.engine='sharded' "
+                    f"(got {ckpt_cfg.engine!r}): overlapped snapshots write "
+                    f"the sharded/universal layout")
+            from ..checkpoint.snapshot import SnapshotManager
+
+            self.snapshots = SnapshotManager(
+                engine, save_dir, cfg=ecfg, tag_prefix=tag_prefix,
+                clock=clock)
+            if keep_last is None:
+                keep_last = ecfg.keep_last
         if keep_last is not None and keep_last < 1:
             raise ValueError("keep_last must be >= 1 (the newest valid "
                              "checkpoint is never pruned)")
         self.keep_last = keep_last
+        self.preemptions = 0
+        self.resumes_rescaled = 0
         self._preempted = False
+        self._torn_down = False
+        self._signum = None
         self._prev_handlers = {}
 
     # -- signals ------------------------------------------------------------
@@ -56,17 +86,57 @@ class ElasticAgent:
         self._prev_handlers = {}
 
     def _on_signal(self, signum, frame):
+        """Record the preemption and return — the handler itself does no
+        I/O. The run loop finishes the in-flight step, then walks the ONE
+        ordered teardown path: checkpoint commit -> health dump -> exit
+        (``_teardown``), so the black box can never race the grace-window
+        flush and nothing dumps twice."""
         log_dist(f"ElasticAgent: received signal {signum}; will checkpoint "
                  f"and stop after the current step", ranks=[0])
         self._preempted = True
-        # Numerics black box: preemption is exactly the moment post-mortem
-        # data vanishes — publish the health ring buffer NOW (atomic commit,
-        # host data only, cheap) rather than hoping the final checkpoint
-        # lands inside the grace window. dump() never raises.
-        health = getattr(self.engine, "health", None)
-        if (health is not None and health.enabled
-                and getattr(health.cfg, "dump_on_signal", True)):
-            health.dump(f"signal{signum}")
+        self._torn_down = False
+        self._signum = signum
+
+    def _teardown(self):
+        """Ordered preemption teardown after the in-flight step: (1) commit
+        the freshest state — the overlapped-snapshot flush when armed (only
+        the not-yet-written remainder), else a full synchronous save; (2)
+        publish the health black box; (3) hand control back. A checkpoint
+        failure must not swallow the dump — the finally does (2) on the way
+        out of a raising (1)."""
+        self._torn_down = True
+        self.preemptions += 1
+        try:
+            if self.snapshots is not None:
+                try:
+                    self.snapshots.flush("preempt")
+                except Exception as e:
+                    logger.warning(
+                        "ElasticAgent: snapshot flush failed (%s) — falling "
+                        "back to a synchronous save", e)
+                    try:
+                        # quiesce the background writer first: the sync save
+                        # may reuse the very tag a live writer is staging
+                        self.snapshots.close()
+                    except Exception:
+                        pass
+                    self.save()
+                else:
+                    self._prune_if_configured()
+            else:
+                self.save()
+            self._emit([("Elastic/preemptions", float(self.preemptions),
+                         self.engine.global_steps)])
+        finally:
+            health = getattr(self.engine, "health", None)
+            if (health is not None and health.enabled
+                    and getattr(health.cfg, "dump_on_signal", True)):
+                health.dump(f"signal{self._signum}")
+
+    def _emit(self, events):
+        mon = getattr(self.engine, "monitor", None)
+        if mon is not None and getattr(mon, "enabled", False):
+            mon.write_events(events)
 
     # -- checkpoint plumbing ------------------------------------------------
     def _tag(self):
@@ -74,8 +144,21 @@ class ElasticAgent:
 
     def save(self):
         self.engine.save_checkpoint(self.save_dir, tag=self._tag())
+        self._prune_if_configured()
+
+    def _prune_if_configured(self):
         if self.keep_last is not None:
             self._prune()
+
+    def _committed_step(self):
+        """Step of the newest COMMITTED checkpoint — the ``latest``
+        pointer's target (the pointer swap IS the commit record)."""
+        tag = atomic.read_latest(self.save_dir)
+        if tag is None:
+            return None
+        marker = atomic.read_marker(os.path.join(self.save_dir, tag))
+        step = marker.get("step") if marker else None
+        return step if isinstance(step, (int, float)) else None
 
     def _prune(self):
         """Retention: drop this agent's committed tags (``<tag_prefix>-*``)
@@ -83,20 +166,39 @@ class ElasticAgent:
         writer put in the same save_dir. Uncommitted stages and quarantined dirs are left for
         fsck; the newest valid checkpoint always survives. Multi-process:
         only process 0 mutates the shared directory (save_checkpoint's
-        commit barrier has already fenced every rank's shards)."""
+        commit barrier has already fenced every rank's shards).
+
+        Race fence vs the overlapped-snapshot writer: a snapshot tag is
+        PUBLISHED by the background thread before the ``latest`` swap makes
+        it the commit point — counting such a tag toward ``keep_last`` could
+        push the last *committed* one over the retention edge, leaving
+        ``latest`` dangling if the fresh tag's commit then fails. Anything
+        newer than the last committed step, anything the live writer still
+        owns, and ``.tmp`` stages (excluded by ``list_tags``) are therefore
+        off-limits; retention only ever counts committed history."""
         import jax
 
         if jax.process_count() > 1 and jax.process_index() != 0:
             return
         prefix = self.tag_prefix + "-"
+        committed = self._committed_step()
+        live = self.snapshots.live_tags if self.snapshots is not None else ()
         valid = []
         for tag in atomic.list_tags(self.save_dir, newest_first=True):
             if not tag.startswith(prefix):
                 continue  # not ours: a shared save_dir may hold user tags
-            ok, _ = atomic.verify_checkpoint_dir(
-                os.path.join(self.save_dir, tag), deep=False)
-            if ok:
-                valid.append(tag)
+            if tag in live:
+                continue  # the background writer still owns this stage
+            path = os.path.join(self.save_dir, tag)
+            ok, _ = atomic.verify_checkpoint_dir(path, deep=False)
+            if not ok:
+                continue
+            marker = atomic.read_marker(path)
+            step = marker.get("step") if marker else None
+            if committed is not None and isinstance(step, (int, float)) \
+                    and step > committed:
+                continue  # published but not yet committed: never touch
+            valid.append(tag)
         for tag in valid[self.keep_last:]:
             path = os.path.join(self.save_dir, tag)
             log_dist(f"ElasticAgent: pruning old checkpoint {tag} "
@@ -212,6 +314,14 @@ class ElasticAgent:
                     "ElasticAgent: skipped %d corrupt checkpoint(s) on "
                     "resume: %s", len(skipped),
                     "; ".join(f"{t} ({r})" for t, r in skipped))
+            if getattr(self.engine, "_last_resume_rescaled", False):
+                # the checkpoint was written on a different mesh and the
+                # universal layout resharded it onto this one — observable,
+                # not assumed
+                self.resumes_rescaled += 1
+                self._emit([("Elastic/resumes_rescaled",
+                             float(self.resumes_rescaled),
+                             self.engine.global_steps)])
             log_dist(f"ElasticAgent: resumed at step {self.engine.global_steps} "
                      f"on mesh {dict(self.engine.mesh.shape)}", ranks=[0])
             return self.engine.global_steps
@@ -234,19 +344,47 @@ class ElasticAgent:
     # -- the loop -----------------------------------------------------------
     def run(self, data_iter, total_steps):
         """Train until ``total_steps`` or preemption. Returns
-        ("finished" | "preempted", steps_done)."""
+        ("finished" | "preempted", steps_done).
+
+        With the elastic snapshot path armed, the shadow capture runs after
+        every step (on the budgeted cadence) and ``save_interval`` marks the
+        periodic COMMIT cadence (a flush: join the writer + pointer swap) —
+        the synchronous full save only remains for the non-elastic mode."""
         self._install()
         try:
             start = self.engine.global_steps
-            for _ in range(start, total_steps):
-                batch = next(data_iter)
-                self.engine.train_batch(batch=batch)
-                if self.engine.global_steps % self.save_interval == 0:
-                    self.save()
-                if self._preempted:
-                    self.save()
-                    return "preempted", self.engine.global_steps
-            self.save()
+            try:
+                for _ in range(start, total_steps):
+                    batch = next(data_iter)
+                    self.engine.train_batch(batch=batch)
+                    if self.snapshots is not None:
+                        if self.snapshots.maybe_snapshot():
+                            # the writer commits each published snapshot, so
+                            # retention can run on the capture cadence
+                            # instead of letting tags pile up to the next
+                            # periodic flush
+                            self._prune_if_configured()
+                        if self.engine.global_steps % self.save_interval == 0:
+                            self.snapshots.flush("periodic")
+                            self._prune_if_configured()
+                    elif self.engine.global_steps % self.save_interval == 0:
+                        self.save()
+                    if self._preempted:
+                        self._teardown()
+                        return "preempted", self.engine.global_steps
+            except BaseException:
+                if self._preempted and not self._torn_down:
+                    # the preemption arrived but the loop died before the
+                    # normal teardown (e.g. the data iterator raised):
+                    # still spend the grace window on the ordered
+                    # commit -> dump path before propagating
+                    self._teardown()
+                raise
+            if self.snapshots is not None:
+                self.snapshots.finalize("final")
+                self._prune_if_configured()
+            else:
+                self.save()
             return "finished", self.engine.global_steps
         finally:
             self._restore()

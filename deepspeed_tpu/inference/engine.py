@@ -75,6 +75,12 @@ class InferenceEngine:
         # ring attention read the mesh off the model config
         if hasattr(model, "config") and hasattr(model.config, "mesh"):
             model.config.mesh = self.mesh
+        if self.mp_world_size > 1 and hasattr(model, "config") \
+                and getattr(model.config, "fused_qkv", False):
+            # sharded-concat SPMD hazard (see runtime/engine.py): the fused
+            # qkv concat is miscompiled when the kernels carry a model-axis
+            # sharding; per-projection matmuls are bitwise per output column
+            model.config.fused_qkv = False
 
         self._rng = jax.random.PRNGKey(config.seed)
         self._request_seq = 0  # folded into per-call rng: two requests with

@@ -97,6 +97,14 @@ class TransformerConfig:
     sparse_block: int = 128
     sparse_pattern_config: typing.Any = None  # dict of pattern kwargs
     attention_interpret: bool = False  # pallas interpret mode (CPU tests)
+    # Fused qkv projection (concat the q/k/v kernels, one matmul). The engines
+    # force this OFF whenever the ``model`` mesh axis is >1: jnp.concatenate
+    # along an axis the operands are sharded on is miscompiled by the SPMD
+    # partitioner (jaxlib 0.4.x; a pure sharded concat returns wrong bytes),
+    # and under tensor parallelism the three column-parallel matmuls are the
+    # standard Megatron form anyway. Fused vs unfused is bitwise-identical
+    # per output column, so flipping it never breaks parity pins.
+    fused_qkv: bool = True
     # Flash-kernel tile sizes (None = kernel defaults: 256x512 fwd, 256x256
     # bwd). Tuning knobs for tools/bench_attention.py BENCH_BLOCKS sweeps.
     flash_block_q: typing.Any = None
@@ -391,7 +399,7 @@ def block_apply(cfg, p, x, mask=None, rope=None, alibi=None, deterministic=True,
         pa = p["attn"]
         if tp_manual:
             h = L.tp_copy(h, "model")  # completes dL/dh with a backward psum
-        if "kernel" in pa["q"]:
+        if "kernel" in pa["q"] and cfg.fused_qkv:
             # one fused qkv matmul (the reference's c_attn / fused qkv gemm):
             # concat of the kernels is a cheap copy next to the [tokens, d] x
             # [d, d+2kv] matmul it enables — wider N keeps the MXU busier than

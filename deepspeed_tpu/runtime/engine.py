@@ -182,6 +182,16 @@ class DeepSpeedEngine:
                 "mesh has expert>1 but the model config has no `mesh` field: MoE "
                 "dispatch cannot be constrained to all_to_all and will compile "
                 "to a degraded replicated layout")
+        if self.mp_world_size > 1 and hasattr(self.module, "config") \
+                and getattr(self.module.config, "fused_qkv", False):
+            # the SPMD partitioner miscompiles jnp.concatenate along an axis
+            # the operands are sharded on (verified wrong bytes on jaxlib
+            # 0.4.x), which is exactly the fused-qkv concat under a >1 model
+            # axis; the unfused projections are the Megatron column-parallel
+            # form and bitwise-identical per output column
+            self.module.config.fused_qkv = False
+            log_dist("tensor parallelism: fused qkv disabled (sharded-concat "
+                     "SPMD hazard); using per-projection matmuls", ranks=[0])
 
         # -- compression-in-training (reference compression_training section) --------
         self._compression = None
@@ -270,6 +280,7 @@ class DeepSpeedEngine:
         self.global_steps = 0
         self.micro_steps = 0
         self.skipped_steps = 0
+        self._last_resume_rescaled = False  # set by load_checkpoint
         tel = self._config.telemetry
         from ..telemetry import SpanTracer
 
@@ -1822,13 +1833,15 @@ class DeepSpeedEngine:
     # ------------------------------------------------------------------------------
     # checkpointing (reference engine.py:2493 load / :2798 save)
     # ------------------------------------------------------------------------------
-    def save_checkpoint(self, save_dir, tag=None, client_state=None):
-        tag = tag or f"global_step{self.global_steps}"
-        # all ranks must save the same tag/step or shard files interleave
-        # (reference engine.py:2781 checkpoint tag validation)
-        dist.assert_same_across_ranks(
-            {"tag": np.frombuffer(tag.encode(), np.uint8),
-             "step": self.global_steps}, name="checkpoint tag")
+    def capture_step_state(self, client_state=None):
+        """The complete step state as a ``(state_tree, meta)`` pair — the
+        single source of truth for what a checkpoint must carry so a resumed
+        trajectory is CONTINUOUS: params + optimizer state (the tree), and in
+        meta the counters, loss-scale/good-steps, the live rng key (bitwise
+        stream continuity across restarts), the lr-scheduler state, and the
+        health monitor's ring-buffer window (so spike/z-score detectors don't
+        restart blind after a preemption). Also the capture point the elastic
+        snapshot path reads every ``snapshot_interval`` steps."""
         if self._offloaded is not None:
             state = {
                 "params": self._offloaded.masters,  # fp32 masters, not bf16 copies
@@ -1845,11 +1858,24 @@ class DeepSpeedEngine:
             "skipped_steps": self.skipped_steps,
             "loss_scale": float(self._scale),
             "good_steps": int(self._good_steps),
+            "rng": np.asarray(self._rng).tolist(),
             "lr_scheduler": self.lr_scheduler.state_dict() if self.lr_scheduler else None,
             "zero_stage": self.zero_stage,
             "mesh": dict(self.mesh.shape),
             "client_state": client_state or {},
         }
+        if self.health is not None and self.health.enabled:
+            meta["health"] = self.health.state_dict()
+        return state, meta
+
+    def save_checkpoint(self, save_dir, tag=None, client_state=None):
+        tag = tag or f"global_step{self.global_steps}"
+        # all ranks must save the same tag/step or shard files interleave
+        # (reference engine.py:2781 checkpoint tag validation)
+        dist.assert_same_across_ranks(
+            {"tag": np.frombuffer(tag.encode(), np.uint8),
+             "step": self.global_steps}, name="checkpoint tag")
+        state, meta = self.capture_step_state(client_state)
         path = os.path.join(save_dir, tag)
         with self.tracer.span("checkpoint/save", cat="checkpoint", tag=tag,
                               step=self.global_steps):
@@ -1892,12 +1918,25 @@ class DeepSpeedEngine:
                     return None, {}
                 tag = tags[0]
         path = os.path.join(load_dir, tag)
+        # the marker records the writing mesh — read it up front so a
+        # rescaled resume traces as checkpoint/reshard (the region reads
+        # through _parse_ranges onto the new mesh's shardings ARE the
+        # reshard work), an equal-scale one as checkpoint/load
+        from ..checkpoint import atomic as ckpt_atomic
+
+        marker = ckpt_atomic.read_marker(path)
+        marker_mesh = marker.get("mesh") if marker else None
+        reshard = bool(marker_mesh
+                       and dict(marker_mesh) != dict(self.mesh.shape))
+        load_span = "checkpoint/reshard" if reshard else "checkpoint/load"
         if self._offloaded is not None:
             template = {"params": self._offloaded.masters,
                         "optimizer_state": self._offloaded.state_for_checkpoint()}
-            state, meta = self.checkpoint_engine.load(path, template=template,
-                                                      shardings=None,
-                                                      verify=verify)
+            with self.tracer.span(load_span, cat="checkpoint", tag=tag):
+                state, meta = self.checkpoint_engine.load(path,
+                                                          template=template,
+                                                          shardings=None,
+                                                          verify=verify)
             self._offloaded.load_masters(state["params"])
             if load_optimizer_states:
                 self._offloaded.load_state(state["optimizer_state"])
@@ -1906,9 +1945,11 @@ class DeepSpeedEngine:
             template = {"params": self.params, "optimizer_state": self.optimizer_state}
             shardings = {"params": self.param_shardings,
                          "optimizer_state": self._opt_shardings}
-            state, meta = self.checkpoint_engine.load(path, template=template,
-                                                      shardings=shardings,
-                                                      verify=verify)
+            with self.tracer.span(load_span, cat="checkpoint", tag=tag):
+                state, meta = self.checkpoint_engine.load(path,
+                                                          template=template,
+                                                          shardings=shardings,
+                                                          verify=verify)
             self.params = state["params"]
             if load_optimizer_states:
                 self.optimizer_state = state["optimizer_state"]
@@ -1917,8 +1958,30 @@ class DeepSpeedEngine:
         self.skipped_steps = meta["skipped_steps"]
         self._scale = jnp.asarray(meta["loss_scale"], jnp.float32)
         self._good_steps = jnp.asarray(meta["good_steps"], jnp.int32)
+        if meta.get("rng") is not None:
+            # bitwise stream continuity: the restored trajectory folds the
+            # SAME dropout/noise keys the uninterrupted run would have
+            self._rng = jnp.asarray(np.asarray(meta["rng"], np.uint32))
+        if self.health is not None and self.health.enabled \
+                and meta.get("health"):
+            # ring-buffer carry: the spike/z-score detectors resume with the
+            # pre-preemption window instead of restarting blind
+            self.health.load_state_dict(meta["health"])
         if self.lr_scheduler is not None and meta.get("lr_scheduler"):
             self.lr_scheduler.load_state_dict(meta["lr_scheduler"])
-        span.set(tag=tag, step=self.global_steps)
+        # one source of truth for "rescaled": the marker mesh that chose the
+        # span name, falling back to the meta mesh only for marker-less
+        # (legacy) tags — the span and the Elastic/resumes_rescaled counter
+        # must never contradict each other
+        saved_mesh = marker_mesh or meta.get("mesh")
+        self._last_resume_rescaled = bool(
+            saved_mesh and dict(saved_mesh) != dict(self.mesh.shape))
+        if self._last_resume_rescaled:
+            log_dist(
+                f"Checkpoint {tag} was written on mesh {dict(saved_mesh)} — "
+                f"resharded onto {dict(self.mesh.shape)} "
+                f"(params + ZeRO optimizer state)", ranks=[0])
+        span.set(tag=tag, step=self.global_steps,
+                 rescaled=self._last_resume_rescaled)
         log_dist(f"Loaded checkpoint {path} at step {self.global_steps}", ranks=[0])
         return path, meta.get("client_state", {})

@@ -435,6 +435,31 @@ class HealthMonitor:
     def anomaly_count(self):
         return len(self.anomalies)
 
+    # -- checkpoint carry ---------------------------------------------------
+    def state_dict(self):
+        """The ring-buffer window as a JSON-able dict — checkpointed by the
+        engine so a resumed run's spike/z-score detectors see the SAME
+        trailing history the uninterrupted run would have (a blind window
+        after every preemption would mute the detectors for ``spike_window``
+        steps each restart)."""
+        return {
+            "records": list(self.records),
+            "steps_observed": self.steps_observed,
+            "last_step": self.last_step,
+            "anomalies": [a.to_dict() for a in self.anomalies],
+        }
+
+    def load_state_dict(self, state):
+        self.records.clear()
+        self.records.extend(state.get("records", ()))
+        self.steps_observed = int(state.get("steps_observed", 0))
+        self.last_step = int(state.get("last_step", 0))
+        self.anomalies = [
+            Anomaly(d.get("detector", "?"), d.get("action", "warn"),
+                    d.get("step", 0), d.get("message", ""),
+                    tuple(d.get("groups", ())))
+            for d in state.get("anomalies", ())]
+
     def snapshot(self):
         """Machine-readable rollup (bench provenance rides this)."""
         return {

@@ -1,4 +1,5 @@
 from .fault_injection import (
+    ChaosSchedule,
     FaultInjector,
     InjectedFault,
     truncate_file,
@@ -6,5 +7,6 @@ from .fault_injection import (
 )
 
 __all__ = [
-    "FaultInjector", "InjectedFault", "truncate_file", "sigterm_data_iter",
+    "ChaosSchedule", "FaultInjector", "InjectedFault", "truncate_file",
+    "sigterm_data_iter",
 ]

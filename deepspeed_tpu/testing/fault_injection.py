@@ -63,6 +63,55 @@ def sigterm_data_iter(data_iter, at_step):
         yield batch
 
 
+class ChaosSchedule:
+    """Seeded kill/resize schedule for preemption chaos runs.
+
+    Draws ``n_kills`` strictly-increasing SIGTERM steps in
+    ``[min_gap, total_steps - 1]`` (each at least ``min_gap`` apart, so every
+    segment makes progress) and assigns each restart the next mesh from the
+    ``meshes`` cycle (``8 -> 4 -> 8`` style). Deterministic: the same seed
+    always produces the same trajectory, which is what lets chaos tests
+    assert exact step continuity rather than "it survived".
+
+    ``events`` is ``[(kill_step, resume_mesh), ...]``; ``mesh_at(segment)``
+    names the mesh segment ``i`` runs on (segment 0 = the initial mesh =
+    ``meshes[0]``, the segment after kill ``i`` runs on ``events[i][1]``).
+    """
+
+    def __init__(self, seed, total_steps, n_kills, meshes=None, min_gap=2):
+        import numpy as np
+
+        if total_steps < (n_kills + 1) * min_gap:
+            raise ValueError(
+                f"total_steps={total_steps} too small for {n_kills} kills "
+                f"with min_gap={min_gap}")
+        self.seed = seed
+        self.total_steps = total_steps
+        self.meshes = list(meshes) if meshes else [{"data": 8}]
+        rng = np.random.RandomState(seed)
+        steps, floor = [], min_gap
+        for i in range(n_kills):
+            # leave room for the remaining kills' gaps
+            ceil = total_steps - 1 - (n_kills - 1 - i) * min_gap
+            if floor > ceil:
+                raise ValueError("schedule does not fit; raise total_steps")
+            s = int(rng.randint(floor, ceil + 1))
+            steps.append(s)
+            floor = s + min_gap
+        self.kill_steps = steps
+        self.events = [(s, self.meshes[(i + 1) % len(self.meshes)])
+                       for i, s in enumerate(steps)]
+
+    def mesh_at(self, segment):
+        return self.meshes[segment % len(self.meshes)]
+
+    def __iter__(self):
+        return iter(self.events)
+
+    def __len__(self):
+        return len(self.events)
+
+
 class _Fault:
     def __init__(self, event, match, nth, times, action, only_background):
         self.event = event

@@ -215,3 +215,237 @@ def rank_consistency_pass_and_fail():
     else:
         raise AssertionError("divergent values were not detected")
     dist.barrier()
+
+
+# ---------------------------------------------------------------------------
+# PR 11 elastic reshard bodies (driven by tests/unit/test_elastic_reshard.py
+# as world_size=1 subprocess workers: the tensor-parallel step programs are in
+# the jaxlib 0.4.x warm-compile-cache crash class — a fresh cache-less worker
+# process sidesteps the bad deserialize/free paths entirely, and a crash
+# fails ONE test instead of killing the tier-1 run)
+# ---------------------------------------------------------------------------
+def _reshard_engine(meshcfg, elastic=None):
+    import jax.numpy as jnp
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models import get_model
+
+    model = get_model("gpt2", "tiny", vocab_size=128, max_seq_len=32,
+                      compute_dtype=jnp.float32)
+    config = {
+        "train_batch_size": 8,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": 2}, "mesh": meshcfg,
+        "checkpoint": {"engine": "sharded"},
+        "steps_per_print": 10 ** 9}
+    if elastic is not None:
+        config["elastic"] = elastic
+    eng, _, _, _ = deepspeed_tpu.initialize(model=model, config=config)
+    return eng
+
+
+def _reshard_batch(step):
+    import numpy as np
+
+    rng = np.random.RandomState(7000 + step)
+    return {"input_ids": rng.randint(0, 128, (8, 16)).astype(np.int32)}
+
+
+def elastic_rescale_and_concat_guard():
+    """Body of test_agent_resumes_at_different_scale (the formerly
+    quarantined known-failing test, root-caused to the fused-qkv
+    sharded-concat SPMD miscompile) + the miscompile-premise guard."""
+    import tempfile
+
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from deepspeed_tpu.elasticity import ElasticAgent
+
+    # -- the concat-miscompile premise guard --------------------------------
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(4, 2), ("data", "model"))
+    rng = np.random.RandomState(0)
+    ws = [rng.randn(16, 32).astype(np.float32) for _ in range(3)]
+    ref = np.concatenate(ws, axis=1)
+    sh = NamedSharding(mesh, P(None, "model"))
+    args = [jax.device_put(w, sh) for w in ws]
+    with mesh:
+        out = np.asarray(
+            jax.jit(lambda *w: jnp.concatenate(w, axis=1))(*args))
+        # the workaround's correctness: concat of REPLICATED operands is exact
+        safe = np.asarray(jax.jit(lambda *w: jnp.concatenate(
+            [jax.lax.with_sharding_constraint(
+                a, NamedSharding(mesh, P(None, None))) for a in w],
+            axis=1))(*args))
+    np.testing.assert_array_equal(safe, ref)
+    if np.array_equal(out, ref):
+        # informational: a fixed partitioner would let fused_qkv re-enable
+        print("NOTE: sharded-axis concat is exact on this jaxlib — the "
+              "fused_qkv TP gate may be retired")
+
+    # -- rescale resume: dp8 -> dp4 x tp2 -----------------------------------
+    tmp = tempfile.mkdtemp(prefix="reshard_")
+    eng = _reshard_engine({"data": 8})
+    agent = ElasticAgent(eng, tmp, save_interval=1000)
+    agent.run(iter([_reshard_batch(s) for s in range(3)]), total_steps=3)
+    loss_before = float(eng.eval_batch(_reshard_batch(100)))
+
+    eng2 = _reshard_engine({"data": 4, "model": 2})
+    agent2 = ElasticAgent(eng2, tmp)
+    resumed = agent2.try_resume()
+    assert resumed == 3, resumed
+    assert agent2.resumes_rescaled == 1  # Elastic/resumes_rescaled source
+    assert eng2._last_resume_rescaled
+    loss_after = float(eng2.eval_batch(_reshard_batch(100)))
+    np.testing.assert_allclose(loss_before, loss_after, rtol=1e-4)
+
+    status, steps = agent2.run(iter([_reshard_batch(s) for s in range(3, 5)]),
+                               total_steps=5)
+    assert status == "finished" and steps == 5
+
+
+def elastic_chaos_resize_8_4_8():
+    """8 -> 4x2 -> 8 preemption/resize chaos with overlapped snapshots:
+    per-step losses within 2e-5 of an uninterrupted dp8 reference, both
+    reshards automatic (params + ZeRO optimizer state)."""
+    import os
+    import signal
+    import tempfile
+
+    import numpy as np
+
+    from deepspeed_tpu.elasticity import ElasticAgent
+
+    total = 9
+    kills = [2, 5]
+    meshes = [{"data": 8}, {"data": 4, "model": 2}, {"data": 8}]
+
+    ref = _reshard_engine({"data": 8})
+    ref_losses = [float(ref.train_batch(batch=_reshard_batch(s)))
+                  for s in range(total)]
+
+    tmp = tempfile.mkdtemp(prefix="chaos838_")
+    losses = {}
+    rescaled = 0
+    eng = _reshard_engine(meshes[0],
+                          elastic={"enabled": True, "snapshot_interval": 1})
+    agent = ElasticAgent(eng, tmp, save_interval=1000)
+    for seg in range(len(meshes)):
+        kill = kills[seg] if seg < len(kills) else None
+        agent._install()
+        try:
+            while eng.global_steps < total and not agent._preempted:
+                step = eng.global_steps
+                if kill is not None and step == kill:
+                    os.kill(os.getpid(), signal.SIGTERM)
+                losses[step] = float(eng.train_batch(batch=_reshard_batch(step)))
+                agent.snapshots.maybe_snapshot()
+            if agent._preempted:
+                agent._teardown()
+            else:
+                agent.snapshots.finalize("final")
+        finally:
+            agent._restore()
+        if not agent._preempted:
+            break
+        eng = _reshard_engine(meshes[seg + 1],
+                              elastic={"enabled": True,
+                                       "snapshot_interval": 1})
+        agent = ElasticAgent(eng, tmp, save_interval=1000)
+        resumed = agent.try_resume()
+        assert resumed == kills[seg] + 1, (resumed, kills[seg])
+        rescaled += int(eng._last_resume_rescaled)
+
+    assert eng.global_steps == total
+    assert rescaled == 2, rescaled  # 8 -> 4x2 and 4x2 -> 8 both resharded
+    assert sorted(losses) == list(range(total))
+    for s in range(total):
+        np.testing.assert_allclose(losses[s], ref_losses[s], atol=2e-5)
+
+
+def elastic_chaos_equal_scale_bitwise():
+    """Seeded SIGTERM at an arbitrary step, equal scale: the resumed
+    trajectory is BITWISE identical to the uninterrupted run — losses, rng
+    stream, loss-scale, skipped/micro counters."""
+    import os
+    import signal
+    import tempfile
+
+    import numpy as np
+
+    from deepspeed_tpu.elasticity import ElasticAgent
+    from deepspeed_tpu.testing import ChaosSchedule
+
+    total = 8
+    schedule = ChaosSchedule(seed=3, total_steps=total, n_kills=1,
+                             meshes=[{"data": 8}])
+    (kill_step, _mesh), = schedule.events
+
+    ref = _reshard_engine({"data": 8})
+    ref_losses = [float(ref.train_batch(batch=_reshard_batch(s)))
+                  for s in range(total)]
+    ref_rng = np.asarray(ref._rng).copy()
+
+    tmp = tempfile.mkdtemp(prefix="chaos_eq_")
+    eng = _reshard_engine({"data": 8},
+                          elastic={"enabled": True, "snapshot_interval": 1})
+    agent = ElasticAgent(eng, tmp, save_interval=1000)
+    losses = []
+    agent._install()
+    try:
+        while eng.global_steps < total and not agent._preempted:
+            step = eng.global_steps
+            if step == kill_step:
+                os.kill(os.getpid(), signal.SIGTERM)
+            losses.append(float(eng.train_batch(batch=_reshard_batch(step))))
+            agent.snapshots.maybe_snapshot()
+        assert agent._preempted
+        agent._teardown()
+    finally:
+        agent._restore()
+    died_at = eng.global_steps
+    assert died_at == kill_step + 1  # the in-flight step finished
+
+    eng2 = _reshard_engine({"data": 8},
+                           elastic={"enabled": True, "snapshot_interval": 1})
+    agent2 = ElasticAgent(eng2, tmp, save_interval=1000)
+    resumed = agent2.try_resume()
+    assert resumed == died_at  # snapshot_interval=1: zero lost steps
+    # loss-scale / rng / counters carried exactly
+    assert float(eng2._scale) == float(eng._scale)
+    assert eng2.skipped_steps == eng.skipped_steps
+    assert eng2.micro_steps == eng.micro_steps
+    np.testing.assert_array_equal(np.asarray(eng2._rng), np.asarray(eng._rng))
+    losses += [float(eng2.train_batch(batch=_reshard_batch(s)))
+               for s in range(resumed, total)]
+
+    assert losses == ref_losses  # BITWISE trajectory continuity
+    np.testing.assert_array_equal(np.asarray(eng2._rng), ref_rng)
+
+    elastic_chaos_cadence_bounds_lost_steps()
+
+
+def elastic_chaos_cadence_bounds_lost_steps():
+    """snapshot_interval=2: a kill loses at most 2 steps. Chained after
+    elastic_chaos_equal_scale_bitwise in ONE worker (process spawns are the
+    expensive part of the tier-1 window)."""
+    import tempfile
+
+    from deepspeed_tpu.elasticity import ElasticAgent
+    from deepspeed_tpu.testing import sigterm_data_iter
+
+    tmp = tempfile.mkdtemp(prefix="chaos_cad_")
+    eng = _reshard_engine({"data": 8},
+                          elastic={"enabled": True, "snapshot_interval": 2})
+    agent = ElasticAgent(eng, tmp, save_interval=1000)
+    status, steps = agent.run(sigterm_data_iter(
+        (_reshard_batch(s) for s in range(100)), at_step=6), total_steps=100)
+    assert status == "preempted" and steps == 6
+
+    eng2 = _reshard_engine({"data": 8},
+                           elastic={"enabled": True, "snapshot_interval": 2})
+    resumed = ElasticAgent(eng2, tmp).try_resume()
+    assert steps - resumed <= 2
+    assert resumed >= 4

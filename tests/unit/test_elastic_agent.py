@@ -58,19 +58,7 @@ def test_agent_preemption_checkpoints_and_stops(tmp_path, devices8):
     assert signal.getsignal(signal.SIGTERM) == signal.SIG_DFL
 
 
-def test_agent_resumes_at_different_scale(tmp_path, devices8):
-    eng = _engine({"data": 8})
-    agent = ElasticAgent(eng, str(tmp_path), save_interval=1000)
-    agent.run(_data(), total_steps=3)
-    loss_before = float(eng.eval_batch(next(_data())))
-
-    # restart at HALF the data-parallel width plus TP — the rescale case
-    eng2 = _engine({"data": 4, "model": 2})
-    agent2 = ElasticAgent(eng2, str(tmp_path))
-    resumed = agent2.try_resume()
-    assert resumed == 3
-    loss_after = float(eng2.eval_batch(next(_data())))
-    np.testing.assert_allclose(loss_before, loss_after, rtol=1e-4)
-
-    status, steps = agent2.run(_data(), total_steps=5)
-    assert status == "finished" and steps == 5
+# test_agent_resumes_at_different_scale moved to test_elastic_reshard.py
+# (root-caused in PR 11: the fused-qkv sharded-concat SPMD miscompile, not
+# the checkpoint — see that module's header) and folded into the chaos/
+# reshard acceptance suite there.

@@ -345,6 +345,152 @@ def test_sharded_load_verifies_per_piece_crcs(tmp_path, devices8):
                  shardings={"w": NamedSharding(mesh, P("data", None))})
 
 
+def test_sharded_latest_swap_failure_keeps_tag_recoverable(tmp_path, devices8):
+    """fail at the ``latest`` swap of the SHARDED engine's commit: the tag
+    is already published and COMMITTED, so commit() raises but the recovery
+    chain still finds the tag; a retried commit (transient gone) succeeds
+    and moves the pointer."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    import jax
+
+    from deepspeed_tpu.checkpoint.sharded import ShardedCheckpointEngine
+    from deepspeed_tpu.config import MeshConfig
+    from deepspeed_tpu.parallel import build_mesh
+
+    mesh = build_mesh(MeshConfig(data=8), devices=devices8)
+    state = {"w": jax.device_put(jnp.arange(64.0).reshape(8, 8),
+                                 NamedSharding(mesh, P("data", None)))}
+    eng = ShardedCheckpointEngine(FAST_RETRY)
+    with FaultInjector() as fi:
+        fi.fail_latest()  # every attempt, incl. the in-commit retries
+        eng.save(state, str(tmp_path / "t"), meta={"global_steps": 1})
+        with pytest.raises(OSError):
+            eng.commit("t")
+    # the tag is durable and walks into the resume chain without a pointer
+    ok, reason = atomic.verify_checkpoint_dir(str(tmp_path / "t"))
+    assert ok, reason
+    assert atomic.read_latest(str(tmp_path)) is None
+    assert atomic.resume_candidates(str(tmp_path)) == ["t"]
+    # the injector is gone: a retried commit completes the swap
+    assert eng.commit("t")
+    assert atomic.read_latest(str(tmp_path)) == "t"
+
+
+def test_truncated_manifest_mid_stage_is_torn(tmp_path, devices8):
+    """Silent truncation of the staged ``meta.json`` (the manifest) must be
+    caught when the marker is sealed — and fsck must report the leftover
+    stage as a TORN SHARDED STAGE with exit code 2."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    import jax
+
+    from deepspeed_tpu.checkpoint.sharded import ShardedCheckpointEngine
+    from deepspeed_tpu.config import MeshConfig
+    from deepspeed_tpu.parallel import build_mesh
+
+    mesh = build_mesh(MeshConfig(data=8), devices=devices8)
+    state = {"w": jax.device_put(jnp.arange(64.0).reshape(8, 8),
+                                 NamedSharding(mesh, P("data", None)))}
+    eng = ShardedCheckpointEngine(FAST_RETRY)
+    with FaultInjector() as fi:
+        fi.truncate_write(match="meta.json", then_fail=False)  # silent tear
+        with pytest.raises(atomic.TornWriteError):
+            eng.save(state, str(tmp_path / "t"), meta={"global_steps": 1})
+    assert not (tmp_path / "t").exists()
+    assert (tmp_path / "t.tmp").exists()  # the torn stage, left for fsck
+
+    r = _run_fsck(str(tmp_path), "--json")
+    assert r.returncode == 2, r.stdout + r.stderr  # the preemption signature
+    report = json.loads(r.stdout)
+    assert report["torn_sharded_stages"] == ["t.tmp"]
+
+    r = _run_fsck(str(tmp_path), "--repair")
+    assert r.returncode in (0, 1), r.stdout + r.stderr  # torn stage cleared
+    assert not (tmp_path / "t.tmp").exists()
+
+
+def test_fsck_validates_sharded_region_coverage(tmp_path, devices8):
+    """A sharded tag whose pieces no longer cover the manifest (a lost
+    shard npz / edited index) verifies file-by-file but cannot assemble —
+    the layout check must flag it."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    import jax
+
+    from deepspeed_tpu.checkpoint.sharded import ShardedCheckpointEngine
+    from deepspeed_tpu.config import MeshConfig
+    from deepspeed_tpu.parallel import build_mesh
+
+    mesh = build_mesh(MeshConfig(data=8), devices=devices8)
+    state = {"w": jax.device_put(jnp.arange(64.0).reshape(8, 8),
+                                 NamedSharding(mesh, P("data", None)))}
+    eng = ShardedCheckpointEngine(FAST_RETRY)
+    eng.save(state, str(tmp_path / "t"), meta={"global_steps": 1})
+    assert eng.commit("t")
+
+    r = _run_fsck(str(tmp_path), "--json")
+    assert r.returncode == 0, r.stdout + r.stderr
+    report = json.loads(r.stdout)
+    assert report["tags"][0]["sharded"] and report["tags"][0]["ok"]
+
+    # drop one piece from the index and reseal the file-level view
+    pieces_path = tmp_path / "t" / "pieces-0.json"
+    pieces = json.loads(pieces_path.read_text())
+    dropped = dict(list(pieces["w"].items())[1:])  # lose rows 0:1
+    pieces["w"] = dropped
+    pieces_path.write_text(json.dumps(pieces))
+    marker_path = tmp_path / "t" / "COMMITTED"
+    marker = json.loads(marker_path.read_text())
+    data = pieces_path.read_bytes()
+    marker["files"]["pieces-0.json"] = {"size": len(data),
+                                        "crc32": atomic.crc32_bytes(data)}
+    marker_path.write_text(json.dumps(marker))
+
+    ok, reason = atomic.verify_checkpoint_dir(str(tmp_path / "t"))
+    assert ok, reason  # the file-level view is clean...
+    r = _run_fsck(str(tmp_path), "--json")
+    assert r.returncode == 1, r.stdout + r.stderr  # ...the layout is not
+    report = json.loads(r.stdout)
+    assert not report["tags"][0]["ok"]
+    assert "uncovered" in report["tags"][0]["reason"]
+
+
+def test_fsck_catches_sharded_piece_crc_rot(tmp_path, devices8):
+    """Post-commit bit rot inside a shard npz entry: the per-piece decode
+    CRC in the layout check catches what the (skipped) file CRC cannot."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    import jax
+
+    from deepspeed_tpu.checkpoint.sharded import ShardedCheckpointEngine
+    from deepspeed_tpu.config import MeshConfig
+    from deepspeed_tpu.parallel import build_mesh
+
+    mesh = build_mesh(MeshConfig(data=8), devices=devices8)
+    state = {"w": jax.device_put(jnp.arange(64.0).reshape(8, 8),
+                                 NamedSharding(mesh, P("data", None)))}
+    eng = ShardedCheckpointEngine(FAST_RETRY)
+    eng.save(state, str(tmp_path / "t"), meta={"global_steps": 1})
+    assert eng.commit("t")
+
+    # flip a piece's recorded CRC (the index is outside its own checksum
+    # set once the marker entry is resealed — models decode-level rot)
+    pieces_path = tmp_path / "t" / "pieces-0.json"
+    pieces = json.loads(pieces_path.read_text())
+    rk = next(iter(pieces["w"]))
+    pieces["w"][rk] ^= 0xDEADBEEF
+    pieces_path.write_text(json.dumps(pieces))
+    marker_path = tmp_path / "t" / "COMMITTED"
+    marker = json.loads(marker_path.read_text())
+    data = pieces_path.read_bytes()
+    marker["files"]["pieces-0.json"] = {"size": len(data),
+                                        "crc32": atomic.crc32_bytes(data)}
+    marker_path.write_text(json.dumps(marker))
+
+    r = _run_fsck(str(tmp_path), "--json")
+    assert r.returncode == 1, r.stdout + r.stderr
+    report = json.loads(r.stdout)
+    assert not report["tags"][0]["ok"]
+    assert "CRC32 after decode" in report["tags"][0]["reason"]
+
+
 # ---------------------------------------------------------------------------
 # harness self-tests
 # ---------------------------------------------------------------------------
@@ -359,6 +505,22 @@ def test_injector_counts_and_nth_semantics(tmp_path):
     # hooks removed on exit: saves work again
     eng.save(_state(3), str(tmp_path / "t3"), meta={})
     assert fi.total_fired == 1
+
+
+def test_chaos_schedule_is_deterministic():
+    from deepspeed_tpu.testing import ChaosSchedule
+
+    a = ChaosSchedule(5, 30, 3, meshes=[{"data": 8}, {"data": 4}])
+    b = ChaosSchedule(5, 30, 3, meshes=[{"data": 8}, {"data": 4}])
+    assert a.kill_steps == b.kill_steps and len(a.kill_steps) == 3
+    # strictly increasing with the min gap: every segment makes progress
+    assert all(y - x >= 2 for x, y in zip(a.kill_steps, a.kill_steps[1:]))
+    assert a.kill_steps[0] >= 2 and a.kill_steps[-1] < 30
+    assert a.events[0][1] == {"data": 4}  # restart cycles the mesh list
+    assert a.mesh_at(0) == {"data": 8} and a.mesh_at(1) == {"data": 4}
+    assert ChaosSchedule(6, 30, 3).kill_steps != a.kill_steps
+    with pytest.raises(ValueError):
+        ChaosSchedule(0, 4, 3)  # does not fit
 
 
 def test_truncate_file_is_deterministic(tmp_path):

@@ -384,8 +384,10 @@ def test_halt_action_raises_after_dump(devices8, tmp_path):
 @pytest.mark.faults
 def test_sigterm_mid_training_publishes_dump(devices8, tmp_path):
     """Fault-injection integration (acceptance): SIGTERM lands mid-run via
-    the ElasticAgent's signal machinery -> the black box publishes
-    atomically, passes fsck-style validation, and health_report reads it."""
+    the ElasticAgent's signal machinery -> the ONE ordered teardown path
+    (finish the in-flight step -> checkpoint commit -> health dump) publishes
+    the black box exactly once, it passes fsck-style validation, and
+    health_report reads it."""
     from deepspeed_tpu.elasticity.agent import ElasticAgent
     from deepspeed_tpu.testing.fault_injection import sigterm_data_iter
 
@@ -395,13 +397,17 @@ def test_sigterm_mid_training_publishes_dump(devices8, tmp_path):
     status, steps = agent.run(it, total_steps=8)
     assert status == "preempted" and steps == 3
     dumps = glob.glob(str(tmp_path / "dumps" / "health-*signal*"))
-    assert len(dumps) == 1
+    assert len(dumps) == 1  # single teardown path: no double dump
     ok, reason = atomic.verify_checkpoint_dir(dumps[0], deep=True)
     assert ok, reason
     records, meta, (ok, _) = load_dump(dumps[0])
     assert ok and meta["reason"].startswith("signal")
-    assert len(records) == 2  # the signal landed inside step 3
+    # the dump happens AFTER the in-flight step finishes and the checkpoint
+    # commits (PR 11 teardown ordering) — step 3's record is IN the box
+    assert len(records) == 3
     assert replay_records(records, _health_cfg()) == []  # clean trajectory
+    # the checkpoint committed first: latest names the preemption step
+    assert atomic.read_latest(str(tmp_path / "ckpt")) == "elastic-step3"
     # the dump never shadows the real checkpoints in the resume chain
     assert all("health" not in t
                for t in atomic.list_tags(str(tmp_path / "ckpt")))
