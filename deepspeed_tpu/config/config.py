@@ -317,6 +317,13 @@ class KVPoolConfig(ConfigModel):
     # addressed; an identical prefix maps to the SAME physical blocks
     # (refcounted) and only the suffix is prefilled
     prefix_cache: bool = True
+    # reserve-as-you-decode: admission reserves only the PROMPT's blocks and
+    # decode blocks are allocated as cursors advance (admission stops paying
+    # for tokens not yet generated — effective concurrency rises). On pool
+    # exhaustion mid-decode the newest request is preempted back to the
+    # queue (resuming bitwise-identical) instead of OOM/shed. False = the
+    # PR 7 whole-footprint reservation.
+    on_demand_growth: bool = False
 
     def _validate(self):
         if self.block_size < 1:
@@ -328,6 +335,82 @@ class KVPoolConfig(ConfigModel):
         if self.kv_dtype not in ("", "int8"):
             raise ConfigError(
                 f"kv_pool.kv_dtype must be '' or 'int8', got {self.kv_dtype!r}")
+
+
+class ChunkedPrefillConfig(ConfigModel):
+    """Chunked prefill (``serving/engine.py``): split a long prompt's prefill
+    into fixed-token chunks interleaved with decode steps, so a single long
+    arrival cannot stall the co-batched decode program — a bounded-TPOT
+    guarantee instead of an unbounded prefill window. Each chunk rides the
+    shared-prefix suffix-prefill machinery (one compiled program per chunk
+    bucket, start position traced), so chunking changes the SCHEDULE, never
+    the math: greedy streams stay bitwise-equal to ``generate()``."""
+
+    enabled: bool = False
+    # tokens per prefill chunk (bucketed by the prompt-bucket policy, so all
+    # full chunks share one compiled suffix program)
+    chunk_size: int = 64
+    # decode steps run for the co-batched slots between consecutive chunks.
+    # The virtual-clock worst inter-token gap for a running decoder is
+    # chunk_bucket * prefill_cost + decode_step_cost (one chunk at most
+    # lands between two decode steps); raising this knob does not shrink
+    # that ceiling — it slows the long prompt's prefill in exchange for
+    # more decode throughput between chunks.
+    decode_steps_between_chunks: int = 1
+
+    def _validate(self):
+        if self.chunk_size < 1:
+            raise ConfigError(
+                f"chunked_prefill.chunk_size must be >= 1, got "
+                f"{self.chunk_size}")
+        if self.decode_steps_between_chunks < 1:
+            raise ConfigError(
+                "chunked_prefill.decode_steps_between_chunks must be >= 1, "
+                f"got {self.decode_steps_between_chunks}")
+
+
+class RouterConfig(ConfigModel):
+    """Multi-replica router (``serving/router.py``): N ServingEngine replicas
+    behind a load-aware dispatcher. Scoring extends the single-replica
+    shed-with-reason admission control into cross-replica balancing: replicas
+    are scored on queue depth + slot/block occupancy (from
+    ``ServingMetrics``), with session and prefix affinity (the paged pool's
+    SHA-256 prefix chain keys as the cross-replica currency) steering
+    repeated system prompts to the replica already holding their blocks."""
+
+    # least_loaded (default) scores replicas on load; round_robin cycles
+    policy: str = "least_loaded"
+    # sticky sessions: requests with the same session_id land on the same
+    # replica (until it drains or saturates)
+    session_affinity: bool = True
+    # shared prefix index: full-prompt-block chain keys -> replica, so an
+    # identical system prompt routes to the replica whose paged pool already
+    # caches its blocks (suffix-only prefill there)
+    prefix_affinity: bool = True
+    # bound on the shared prefix index (LRU past it)
+    prefix_index_cap: int = 4096
+    # load-score weights (normalized queue depth / slot occupancy / paged
+    # block occupancy)
+    queue_weight: float = 1.0
+    slot_weight: float = 1.0
+    block_weight: float = 1.0
+    # an affinity target whose load score exceeds the best candidate's by
+    # more than this margin is overridden (counted as a rebalance)
+    rebalance_margin: float = 1.0
+
+    def _validate(self):
+        if self.policy not in ("least_loaded", "round_robin"):
+            raise ConfigError(
+                f"router.policy must be 'least_loaded' or 'round_robin', "
+                f"got {self.policy!r}")
+        if self.prefix_index_cap < 1:
+            raise ConfigError(
+                f"router.prefix_index_cap must be >= 1, got "
+                f"{self.prefix_index_cap}")
+        if self.rebalance_margin < 0:
+            raise ConfigError(
+                f"router.rebalance_margin must be >= 0, got "
+                f"{self.rebalance_margin}")
 
 
 class ServingConfig(ConfigModel):
@@ -363,10 +446,29 @@ class ServingConfig(ConfigModel):
     monitor_interval: int = 32
     # paged + quantized KV cache with shared-prefix reuse (kv_pool.enabled)
     kv_pool: KVPoolConfig = None
+    # chunked prefill: interleave fixed-token prefill chunks with decode
+    # steps for a bounded co-batched TPOT (chunked_prefill.enabled)
+    chunked_prefill: ChunkedPrefillConfig = None
+    # multi-replica router policy (serving/router.py reads this block off
+    # its first replica's config unless given one explicitly)
+    router: RouterConfig = None
+    # head-of-line bypass under block-aware admission: when the queue head's
+    # KV footprint cannot fit, up to this many later requests that DO fit may
+    # be admitted past it before admissions stop until the head clears
+    # (bounded starvation). 0 = strict FCFS, nothing ever overtakes the head.
+    hol_bypass_limit: int = 0
 
     def _validate(self):
         if self.kv_pool is None:
             self.kv_pool = KVPoolConfig()
+        if self.chunked_prefill is None:
+            self.chunked_prefill = ChunkedPrefillConfig()
+        if self.router is None:
+            self.router = RouterConfig()
+        if self.hol_bypass_limit < 0:
+            raise ConfigError(
+                f"serving.hol_bypass_limit must be >= 0, got "
+                f"{self.hol_bypass_limit}")
         if self.n_slots < 1:
             raise ConfigError(f"serving.n_slots must be >= 1, got {self.n_slots}")
         if self.max_queue_depth < 1:

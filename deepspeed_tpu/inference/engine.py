@@ -506,6 +506,28 @@ class InferenceEngine:
                 report["sanitizer"], sanitize_jaxpr(jaxpr, config=cfg))
         return report
 
+    def prefill_chunk_report(self, chunk_tokens=None):
+        """Static audit of the chunked suffix-prefill program (one full
+        chunk's bucket against a donated partial cache) — the serving-side
+        fence for chunked prefill, enforced via the
+        ``serving-prefill-chunked/8/bf16`` budget
+        (``tools/program_lint.py --program prefill-chunked``)."""
+        from ..profiling.collectives import audit_lowered
+        from ..profiling.sanitizer import (ATTENTION_F32_ALLOW,
+                                           merge_reports, sanitize_jaxpr)
+
+        sv = self.serving
+        dtype = {jnp.bfloat16: "bf16", jnp.float16: "f16"}.get(
+            self.dtype, "f32")
+        cfg = {"compute_dtype": dtype, "allow": list(ATTENTION_F32_ALLOW)}
+        n = max(self.mesh.devices.size, 1)
+        lowered, jaxpr = sv.trace_prefill_chunk(chunk_tokens)
+        report = audit_lowered(lowered, n, sanitizer_config=cfg)
+        if jaxpr is not None:
+            report["sanitizer"] = merge_reports(
+                report["sanitizer"], sanitize_jaxpr(jaxpr, config=cfg))
+        return report
+
     @property
     def config(self):
         return self._config

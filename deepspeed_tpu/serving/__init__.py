@@ -8,13 +8,14 @@ Serving/* metrics — the request-level layer that turns the single-call
 
 from .clock import VirtualClock, WallClock
 from .engine import ServingEngine
-from .kv_pool import GARBAGE_BLOCK, KVPoolManager
+from .kv_pool import GARBAGE_BLOCK, KVPoolManager, prefix_chain_keys
 from .metrics import ServingMetrics, percentile
 from .queue import RequestQueue
 from .request import (FINISH_EOS, FINISH_LENGTH, FINISH_UNHEALTHY,
-                      REJECT_NO_FREE_BLOCKS, REJECT_PROMPT_TOO_LONG,
-                      REJECT_QUEUE_FULL, Request, RequestState,
-                      SamplingParams, TokenEvent, as_request)
+                      REJECT_ALL_REPLICAS_SATURATED, REJECT_NO_FREE_BLOCKS,
+                      REJECT_PROMPT_TOO_LONG, REJECT_QUEUE_FULL, Request,
+                      RequestState, SamplingParams, TokenEvent, as_request)
+from .router import Router, RouterMetrics
 from .scheduler import ServingScheduler, simulate_static_batching
 
 __all__ = [
@@ -33,10 +34,14 @@ __all__ = [
     "simulate_static_batching",
     "KVPoolManager",
     "GARBAGE_BLOCK",
+    "Router",
+    "RouterMetrics",
+    "prefix_chain_keys",
     "FINISH_EOS",
     "FINISH_LENGTH",
     "FINISH_UNHEALTHY",
     "REJECT_QUEUE_FULL",
     "REJECT_PROMPT_TOO_LONG",
     "REJECT_NO_FREE_BLOCKS",
+    "REJECT_ALL_REPLICAS_SATURATED",
 ]

@@ -17,6 +17,8 @@ positions over the same KV window (pinned in tier-1
 ``tests/unit/test_serving.py``).
 """
 
+import collections
+import dataclasses
 from collections import OrderedDict
 
 import numpy as np
@@ -40,6 +42,29 @@ from .request import (FINISH_EOS, FINISH_LENGTH, FINISH_STOP,
                       FINISH_UNHEALTHY, Request, RequestState, TokenEvent,
                       as_request)
 from .scheduler import ServingScheduler
+
+
+@dataclasses.dataclass
+class _PrefillJob:
+    """A prompt prefill in flight across scheduler steps (chunked prefill
+    and/or preemption resume). The job owns its reserved slot and the
+    partially-filled dense b=1 cache between chunks; ``pos`` is the next
+    prompt position to prefill (``ids`` = prompt, or prompt + already-
+    generated tokens on a resume replay)."""
+
+    req: object
+    slot: int
+    cache: dict
+    ids: np.ndarray          # full token sequence to prefill
+    pos: int                 # next position to write (starts at shared_len)
+    shared_len: int
+    shared_blocks: list
+    resume: bool             # replaying a preempted request: no first-token
+    #                          sampling, stream/metrics continue where left
+
+    @property
+    def done(self):
+        return self.pos >= len(self.ids)
 
 
 class ServingEngine:
@@ -72,11 +97,23 @@ class ServingEngine:
             # block-granularity scrub: zero each physical block as its last
             # reference drops (the dense pool's whole-row scrub generalized)
             self.pool_mgr._scrub = self._scrub_block
+        # chunked prefill: long prompts prefill in fixed-token chunks
+        # interleaved with decode steps (bounded co-batched TPOT); each chunk
+        # is one suffix-prefill call against the request's partial cache
+        self.chunked = bool(self.cfg.chunked_prefill.enabled)
+        # on-demand block growth (paged only): admission reserves prompt
+        # blocks, decode blocks are allocated as cursors advance, and pool
+        # exhaustion preempts the newest request back to the queue
+        self.growth = self.paged and bool(self.cfg.kv_pool.on_demand_growth)
+        self._prefill_jobs = collections.deque()
+        self._decode_steps_since_chunk = 1 << 30  # first chunk never waits
+        self._admit_seq = 0    # admission order (preemption victim = newest)
         self.queue = RequestQueue(self.cfg.max_queue_depth)
         self.scheduler = ServingScheduler(
             self.queue, self.n_slots,
             max_prefills_per_step=self.cfg.max_prefills_per_step,
-            policy=self.cfg.policy)
+            policy=self.cfg.policy,
+            hol_bypass_limit=self.cfg.hol_bypass_limit)
         if monitor is None:
             mc = engine.config
             if (mc.tensorboard.enabled or mc.wandb.enabled
@@ -120,6 +157,8 @@ class ServingEngine:
         self._insert_block_jit = None    # paged: copy one block into the pool
         self._seed_cache_jit = None      # paged: block table row -> dense view
         self._scrub_jit = None           # paged: zero one physical block
+        self._fresh_cache_jit = None     # chunked: zeroed dense b=1 cache
+        self._grow_jit = None            # growth: append one table-row block
         # ONE sharding for the pool state, pinned as out_shardings on every
         # pool program: kv heads over the model axis (TP), everything else
         # replicated. Without the pin, insert and decode outputs would carry
@@ -355,6 +394,20 @@ class ServingEngine:
                                      {k: state[k] for k in pool_keys},
                                      table_row, self.engine.dtype)
 
+        def fresh_cache():
+            # chunked prefill / preemption resume: the request carries a
+            # dense b=1 cache ACROSS scheduler steps, so it starts from an
+            # explicit zeroed one instead of one built inside the prefill
+            # program (the suffix programs donate and return it per chunk)
+            return init_cache(model.config, 1, max_len, self.engine.dtype)
+
+        def grow(state, slot, j, block_id):
+            # on-demand growth: extend a running slot's KV coverage by one
+            # block — table[slot, j] retargets from the garbage block to the
+            # freshly-allocated one (slot/j/block_id traced: compiles once)
+            return dict(state,
+                        table=state["table"].at[slot, j].set(block_id))
+
         def release(state, slot):
             if paged:
                 # MANDATORY on the paged pool (not hygiene): the freed
@@ -407,9 +460,15 @@ class ServingEngine:
                                                "v": self._cache_sharding})
                 self._scrub_jit = jax.jit(scrub_block, donate_argnums=(0,),
                                           out_shardings=st)
+                if self.growth:
+                    self._grow_jit = jax.jit(grow, donate_argnums=(0,),
+                                             out_shardings=st)
             else:
                 self._insert_jit = jax.jit(insert, donate_argnums=(0,),
                                            out_shardings=st)
+            self._fresh_cache_jit = jax.jit(
+                fresh_cache, out_shardings={"k": self._cache_sharding,
+                                            "v": self._cache_sharding})
             self._release_jit = jax.jit(release, donate_argnums=(0,),
                                         out_shardings=st)
             self._sample_first_jit = jax.jit(sample_first,
@@ -434,6 +493,29 @@ class ServingEngine:
         """The lowered (uncompiled) decode program (see ``trace_decode``)."""
         return self.trace_decode()[0]
 
+    def trace_prefill_chunk(self, chunk_tokens=None):
+        """``(lowered, jaxpr-or-None)`` of the chunked suffix-prefill program
+        (one full chunk's bucket) — the ``program_lint --program
+        prefill-chunked`` entry point, mirroring ``trace_decode``. This is
+        the SAME compiled program a chunk dispatches (and a shared-prefix
+        suffix hit shares): q-block written at a traced start position
+        against a donated, partially-filled dense b=1 cache."""
+        if self._decode_jit is None:
+            self._build_pool_programs()
+        chunk = int(chunk_tokens or self.cfg.chunked_prefill.chunk_size)
+        padded = self.engine._bucket_prompt_len(min(chunk, self.max_len),
+                                                self.max_len)
+        fn = self._suffix_program(padded)
+        cache = init_cache(self.engine.module.config, 1, self.max_len,
+                           self.engine.dtype)
+        args = (self.engine.params, jnp.zeros((1, padded), jnp.int32), cache,
+                np.int32(0), np.int32(min(chunk, padded)))
+        trace = getattr(fn, "trace", None)
+        if trace is not None:
+            t = trace(*args)
+            return t.lower(), t.jaxpr
+        return fn.lower(*args), None
+
     def compile_counts(self):
         """Compiled-program census, pinned by the tier-1 no-recompile test:
         the decode step compiles exactly once per (model, slot-pool)
@@ -447,7 +529,10 @@ class ServingEngine:
         if self.paged:
             out["insert_block"] = size(self._insert_block_jit)
             out["seed_cache"] = size(self._seed_cache_jit)
+        if self.paged or self.chunked or self.growth:
             out["suffix_buckets"] = len(self._suffix_programs)
+        if self.growth:
+            out["grow"] = size(self._grow_jit)
         return out
 
     def _scrub_block(self, block_id):
@@ -497,39 +582,26 @@ class ServingEngine:
 
     # ------------------------------------------------------------- the loop
     def step(self):
-        """One scheduler iteration: admit queued requests into free slots
-        (prefill + splice), then run one decode step over the pool. Returns
+        """One scheduler iteration: admit queued requests into free slots,
+        advance at most one pending prefill chunk (chunked prefill), grow or
+        preempt paged slots whose cursor reached the end of their blocks
+        (on-demand growth), then run one decode step over the pool. Returns
         the list of TokenEvents produced."""
         events = []
-        can_admit = None
-        if self.paged:
-            # block-aware admission: the queue head waits (FCFS, no
-            # overtaking) until enough blocks are free or evictable.
-            # ``reserved`` makes multi-admission steps conservative: earlier
-            # candidates' not-yet-allocated blocks count against later ones.
-            # Prefix sharing is ignored here (a hit only needs FEWER blocks,
-            # so the check stays sound). No livelock: every queued request
-            # passed fits_ever at submit, and with no slots running every
-            # non-free block is prefix-cache-evictable, so the head always
-            # admits once running requests drain.
-            reserved = [0]
-
-            def can_admit(req):
-                need = self.pool_mgr.blocks_for(req.prompt_len,
-                                                req.max_new_tokens)
-                ok = self.pool_mgr.can_allocate(need + reserved[0])
-                if ok:
-                    reserved[0] += need
-                return ok
-
+        can_admit = self._make_can_admit() if self.paged else None
         admitted = self.scheduler.next_admissions(len(self._free_slots),
                                                   self.clock.now(),
                                                   can_admit=can_admit)
         for req in admitted:
             self._start_request(req, events)
+        if self._prefill_jobs and self._chunk_due():
+            self._advance_prefill(events)
+        if self.growth and self._slots:
+            self._grow_or_preempt()
         if self._slots:
             self._decode_once(events)
-        elif not admitted and self.queue.depth:
+            self._decode_steps_since_chunk += 1
+        elif not admitted and not self._prefill_jobs and self.queue.depth:
             # nothing running and the queue head hasn't arrived yet (direct
             # submit with a future arrival offset): idle the clock forward to
             # it, or a virtual-clock step() loop would spin forever
@@ -541,6 +613,61 @@ class ServingEngine:
         self.metrics.observe_step(self.queue.depth, len(self._slots))
         return events
 
+    def _make_can_admit(self):
+        """Block-aware admission predicate for the scheduler. The queue head
+        waits until enough blocks are free, evictable, or unreserved; a
+        granted admission RESERVES its blocks in the pool manager (not a
+        step-local counter: chunked prefill opens a multi-step window
+        between admission and slot insert, and growth/later admissions must
+        not steal the head's blocks meanwhile). Prefix sharing is ignored
+        here (a hit only needs FEWER blocks, so the check stays sound).
+        No livelock: every queued request passed fits_ever at submit, and
+        with no slots running every non-free block is prefix-cache-evictable
+        and every reservation is consumed by a job already holding a slot,
+        so the head always admits once running requests drain."""
+        def can_admit(req):
+            if self.growth:
+                # reserve-as-you-decode: admission pays only the prefilled
+                # positions (prompt, or prompt + replayed tokens on resume)
+                need = self.pool_mgr.blocks_for_prefill(
+                    self._prefill_len(req))
+            else:
+                need = self.pool_mgr.blocks_for(req.prompt_len,
+                                                req.max_new_tokens)
+            if not self.pool_mgr.can_allocate(need):
+                return False
+            self.pool_mgr.reserve(need)
+            req.reserved_blocks = need
+            return True
+
+        return can_admit
+
+    @staticmethod
+    def _prefill_len(req):
+        """Positions the request's prefill writes: the prompt, plus — on a
+        preemption resume — every already-generated token except the last
+        (which decode re-feeds at the cursor)."""
+        return req.prompt_len + max(len(req.tokens) - 1, 0)
+
+    def _unreserve(self, req):
+        """Cancel an admission-time block reservation (early finish / shed
+        paths that never reach the slot insert)."""
+        if req.reserved_blocks:
+            self.pool_mgr.consume_reservation(req.reserved_blocks)
+            req.reserved_blocks = 0
+
+    def _chunk_due(self):
+        """A pending prefill chunk runs when nothing is decoding, when
+        chunking is off (preemption-resume jobs complete in one shot), or
+        once the configured decode steps have run since the last chunk.
+        The chunk SIZE bounds the co-batched worst inter-token gap (one
+        chunk at most between two decode steps); this pacing knob trades
+        the long prompt's prefill completion for decode throughput."""
+        if not self.chunked or not self._slots:
+            return True
+        return (self._decode_steps_since_chunk
+                >= self.cfg.chunked_prefill.decode_steps_between_chunks)
+
     def _request_key(self, req):
         if req.sampling.seed is not None:
             base = jax.random.PRNGKey(int(req.sampling.seed))
@@ -551,11 +678,40 @@ class ServingEngine:
     def _start_request(self, req, events):
         if self._decode_jit is None:
             self._build_pool_programs()
+        resume = bool(req.tokens)  # preempted request rejoining from the queue
+        if resume and len(req.tokens) > 1:
+            # replay prefill: prompt + every generated token except the last
+            # (decode re-feeds it at the cursor) — rebuilding exactly the KV
+            # coverage the preemption released, so the stream continues
+            # bitwise-identically
+            ids_full = np.concatenate(
+                [req.prompt, np.asarray(req.tokens[:-1], np.int32)])
+        else:
+            ids_full = req.prompt
         shared_len, shared_blocks = 0, []
         if self.paged:
             # take refs on matched prefix blocks NOW so an eviction between
             # here and the slot insert can't dangle them
-            shared_len, shared_blocks = self.pool_mgr.acquire_prefix(req.prompt)
+            shared_len, shared_blocks = self.pool_mgr.acquire_prefix(ids_full)
+        chunk = self.cfg.chunked_prefill.chunk_size
+        if resume or (self.chunked and len(ids_full) - shared_len > chunk):
+            # multi-step prefill (chunked and/or resume replay): reserve the
+            # slot now, seed the partial cache, and let the step loop drive
+            # chunks interleaved with decode steps (_advance_prefill)
+            slot = self._free_slots.pop()
+            if shared_len:
+                mgr = self.pool_mgr
+                row = np.full((mgr.blocks_per_slot,), GARBAGE_BLOCK, np.int32)
+                row[:len(shared_blocks)] = shared_blocks
+                cache = self._seed_cache_jit(self._state, jnp.asarray(row))
+            else:
+                cache = self._fresh_cache_jit()
+            self._prefill_jobs.append(_PrefillJob(
+                req=req, slot=slot, cache=cache,
+                ids=np.asarray(ids_full, np.int32), pos=shared_len,
+                shared_len=shared_len, shared_blocks=shared_blocks,
+                resume=resume))
+            return
         if shared_len:
             # shared-prefix hit: the pool already holds the prefix KV — seed
             # a dense view from the (partly shared) block row and prefill
@@ -602,6 +758,15 @@ class ServingEngine:
                 self.clock.advance(
                     padded * self.cfg.virtual_prefill_cost_per_token)
 
+        self._after_prefill(req, cache, shared_len, shared_blocks, logits,
+                            events)
+
+    def _after_prefill(self, req, cache, shared_len, shared_blocks, logits,
+                       events, slot=None):
+        """Sample the first token from the prefill logits (in-graph health
+        guard included) and either finish the request immediately or bind a
+        slot. ``slot`` is the job-reserved slot for chunked prefills (freed
+        back on an early finish); single-shot prefills pop one here."""
         keys = self._request_key(req)
         s = req.sampling
         tok, nf = self._sample_first_jit(
@@ -618,6 +783,9 @@ class ServingEngine:
             # streaming anything (the request never takes a slot)
             if self.paged:
                 self.pool_mgr.release_blocks(shared_blocks)
+                self._unreserve(req)
+            if slot is not None:
+                self._free_slots.append(slot)
             self.metrics.record_shed("unhealthy_slot")
             self.metrics.record_unhealthy()
             self.tracer.instant("request/unhealthy", cat="serving", ts=now,
@@ -648,15 +816,25 @@ class ServingEngine:
             if self.paged:
                 # finished at the first token: no blocks were bound
                 self.pool_mgr.release_blocks(shared_blocks)
+                self._unreserve(req)
+            if slot is not None:
+                self._free_slots.append(slot)
             self._finish(req, reason, now)
             events.append(TokenEvent(req.request_id, t, 0, True, reason, now))
             return
-        slot = self._free_slots.pop()
+        if slot is None:
+            slot = self._free_slots.pop()
         self._slots[slot] = req
         req.slot = slot
+        if req.admit_seq < 0:
+            # preemption-victim ordering: newest admission yields first; a
+            # RESUMED request keeps its original seniority
+            req.admit_seq = self._admit_seq
+            self._admit_seq += 1
         if self.paged:
             self._insert_paged(req, slot, cache, shared_len, shared_blocks,
-                               tok, keys[1], s, eos)
+                               tok[0], keys[1], s, eos,
+                               req.max_new_tokens - 1)
         else:
             self._state = self._insert_jit(
                 self._state, np.int32(slot), cache["k"], cache["v"], tok[0],
@@ -665,16 +843,141 @@ class ServingEngine:
                 np.float32(s.top_p), np.int32(-1 if eos is None else eos))
         events.append(TokenEvent(req.request_id, t, 0, False, None, now))
 
+    # ----------------------------------------------- chunked prefill driver
+    def _advance_prefill(self, events):
+        """Run ONE prefill chunk of the oldest pending job (the whole
+        remaining suffix when chunking is off — preemption-resume replays).
+        Each chunk is a suffix-prefill call: the q block is written at the
+        job's cursor against its donated partial cache, bucketed so every
+        full chunk shares one compiled program."""
+        job = self._prefill_jobs[0]
+        remaining = len(job.ids) - job.pos
+        n = min(self.cfg.chunked_prefill.chunk_size, remaining) \
+            if self.chunked else remaining
+        # ceiling shrinks by the already-prefilled prefix (same overrun
+        # guard as the shared-prefix suffix path: a bucket past max_len
+        # would make XLA clamp the q-block write start)
+        padded = self.engine._bucket_prompt_len(n, self.max_len - job.pos)
+        with self.tracer.span("prefill_chunk", cat="serving",
+                              request_id=job.req.request_id,
+                              padded_len=padded, start=job.pos,
+                              resume=job.resume):
+            ids = np.zeros((1, padded), np.int32)
+            ids[0, :n] = job.ids[job.pos:job.pos + n]
+            logits, job.cache = self._suffix_program(padded)(
+                self.engine.params, jnp.asarray(ids), job.cache,
+                np.int32(job.pos), np.int32(n))
+            self.clock.advance(
+                padded * self.cfg.virtual_prefill_cost_per_token)
+        job.pos += n
+        self._decode_steps_since_chunk = 0
+        if job.done:
+            self._prefill_jobs.popleft()
+            self._complete_job(job, logits, events)
+
+    def _complete_job(self, job, logits, events):
+        req = job.req
+        if not job.resume:
+            self._after_prefill(req, job.cache, job.shared_len,
+                                job.shared_blocks, logits, events,
+                                slot=job.slot)
+            return
+        # resume: splice back at the saved cursor with the rng captured at
+        # preemption — no first token is sampled (the last streamed token is
+        # re-fed at the cursor), so the stream continues bitwise-identically
+        slot, s, eos = job.slot, req.sampling, req.eos_token_id
+        remaining = req.max_new_tokens - len(req.tokens)
+        req.state = RequestState.RUNNING
+        self._slots[slot] = req
+        req.slot = slot
+        rng = jnp.asarray(req.resume_rng)
+        # committed replicated scalar: the fresh path feeds tok[0] straight
+        # out of _sample_first_jit (committed to the mesh via its pinned
+        # out_shardings), and an uncommitted host scalar here would open a
+        # SECOND jit-cache entry for the same aval — breaking the
+        # insert-compiles-once pin
+        tok = jax.device_put(jnp.asarray(req.tokens[-1], jnp.int32),
+                             self._rep_sharding)
+        if self.paged:
+            self._insert_paged(req, slot, job.cache, job.shared_len,
+                               job.shared_blocks, tok,
+                               rng, s, eos, remaining)
+        else:
+            self._state = self._insert_jit(
+                self._state, np.int32(slot), job.cache["k"], job.cache["v"],
+                tok, np.int32(self._prefill_len(req)),
+                np.int32(remaining), rng, np.float32(s.temperature),
+                np.int32(s.top_k), np.float32(s.top_p),
+                np.int32(-1 if eos is None else eos))
+        self.tracer.instant("request/resumed", cat="serving",
+                            ts=self.clock.now(), request_id=req.request_id,
+                            n_tokens=len(req.tokens),
+                            preemptions=req.preemptions)
+
+    # ------------------------------------------------- on-demand growth
+    def _grow_or_preempt(self):
+        """Reserve-as-you-decode: before the decode step, any active slot
+        whose write cursor reached the end of its bound blocks grows by one
+        block; when the pool can't provide one, the NEWEST-admitted running
+        request is preempted back to the queue head (its blocks free, its
+        stream resumes bitwise-identically later) instead of OOM/shed."""
+        mgr = self.pool_mgr
+        for slot in sorted(list(self._slots)):
+            req = self._slots.get(slot)
+            if req is None:
+                continue  # preempted earlier in this same pass
+            pos = req.prompt_len + len(req.tokens) - 1  # this step's write
+            j = pos // mgr.block_size
+            if j < mgr.slot_block_count(slot):
+                continue
+            preempted_self = False
+            while not mgr.can_allocate(1):
+                victim = max(self._slots,
+                             key=lambda s_: self._slots[s_].admit_seq)
+                self._preempt(victim)
+                if victim == slot:
+                    preempted_self = True
+                    break
+            if preempted_self:
+                continue
+            bid = mgr.grow_slot(slot, live_tokens=pos + 1)
+            self._state = self._grow_jit(self._state, np.int32(slot),
+                                         np.int32(j), np.int32(bid))
+
+    def _preempt(self, slot):
+        """Preempt-to-queue: capture the slot's rng (the resume replay needs
+        the exact stream), release its blocks and table row, and push the
+        request back to the QUEUE HEAD (it outranks everything queued behind
+        it — FCFS by original admission)."""
+        req = self._slots.pop(slot)
+        req.resume_rng = np.asarray(self._state["rng"])[slot].copy()
+        req.preemptions += 1
+        self.pool_mgr.preempted_requests += 1
+        self.metrics.record_preempt()
+        self._state = self._release_jit(self._state, np.int32(slot))
+        self.pool_mgr.free_slot(slot)
+        self._free_slots.append(slot)
+        req.slot = None
+        self.queue.push_front(req)
+        self.tracer.instant("request/preempted", cat="serving",
+                            ts=self.clock.now(), request_id=req.request_id,
+                            n_tokens=len(req.tokens))
+
     def _insert_paged(self, req, slot, cache, shared_len, shared_blocks,
-                      tok, chain_key, s, eos):
+                      tok, chain_key, s, eos, remaining):
         """Bind a paged slot: allocate the request's footprint in blocks,
         copy the freshly-prefilled PRIVATE blocks from the dense cache
         (shared prefix blocks are refcounted, never copied — copy-on-write),
         set the slot's table row + scalars, and content-address the full
-        prompt blocks for future prefix hits."""
+        prompt blocks for future prefix hits. Under on-demand growth the
+        footprint is only the PREFILLED positions; decode blocks arrive via
+        ``_grow_or_preempt`` as the cursor advances."""
         mgr = self.pool_mgr
-        needed = mgr.blocks_for(req.prompt_len, req.max_new_tokens)
+        prefill_len = self._prefill_len(req)
+        needed = mgr.blocks_for_prefill(prefill_len) if self.growth \
+            else mgr.blocks_for(req.prompt_len, req.max_new_tokens)
         # the scheduler's can_admit reserved this; alloc may still evict
+        self._unreserve(req)
         private = mgr.alloc(needed - len(shared_blocks))
         blocks = list(shared_blocks) + private
         ids = np.full((mgr.blocks_per_slot,), GARBAGE_BLOCK, np.int32)
@@ -688,11 +991,13 @@ class ServingEngine:
         row = np.full((mgr.blocks_per_slot,), GARBAGE_BLOCK, np.int32)
         row[:len(blocks)] = blocks
         self._state = self._insert_jit(
-            self._state, np.int32(slot), jnp.asarray(row), tok[0],
-            np.int32(req.prompt_len), np.int32(req.max_new_tokens - 1),
+            self._state, np.int32(slot), jnp.asarray(row), tok,
+            np.int32(prefill_len), np.int32(remaining),
             chain_key, np.float32(s.temperature), np.int32(s.top_k),
             np.float32(s.top_p), np.int32(-1 if eos is None else eos))
-        mgr.bind_slot(slot, blocks, req.prompt_len + req.max_new_tokens - 1)
+        mgr.bind_slot(slot, blocks,
+                      prefill_len if self.growth
+                      else req.prompt_len + req.max_new_tokens - 1)
         mgr.register_prefix(req.prompt, blocks)
 
     def _decode_once(self, events):
@@ -790,14 +1095,16 @@ class ServingEngine:
             elif r.arrival_time is None:
                 r.arrival_time = t0
         try:
-            while pending or self.queue.depth or self._slots:
+            while pending or self.queue.depth or self._slots \
+                    or self._prefill_jobs:
                 now = self.clock.now()
                 while pending and pending[0].arrival_time <= now:
                     req = self.submit(pending.pop(0))
                     if req.state is RequestState.REJECTED and yield_rejections:
                         yield TokenEvent(req.request_id, -1, -1, True,
                                          f"rejected:{req.reject_reason}", now)
-                if not self._slots and not self.queue.depth:
+                if not self._slots and not self.queue.depth \
+                        and not self._prefill_jobs:
                     if not pending:
                         break
                     # idle until the next arrival
@@ -833,8 +1140,11 @@ class ServingEngine:
         self._insert_block_jit = None
         self._seed_cache_jit = None
         self._scrub_jit = None
+        self._fresh_cache_jit = None
+        self._grow_jit = None
         self._prefill_programs = OrderedDict()
         self._suffix_programs = OrderedDict()
+        self._prefill_jobs = collections.deque()
         self._slots = {}
         self._free_slots = list(range(self.n_slots - 1, -1, -1))
         self.tracer.flush()

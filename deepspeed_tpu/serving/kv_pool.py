@@ -42,6 +42,30 @@ from ..config.base import ConfigError
 GARBAGE_BLOCK = 0
 
 
+def prefix_chain_keys(prompt, block_size, limit=None):
+    """``[((end, digest), end), ...]`` — one entry per full ``block_size``
+    prompt block with ``end <= limit`` (default ``len(prompt)``). Keys are an
+    INCREMENTAL SHA-256 chain over the token bytes (key_j digests blocks
+    0..j), so key construction is linear in prompt length and a key still
+    commits to the entire prefix content — two prompts share a key iff their
+    prefixes collide SHA-256, i.e. never in practice.
+
+    This is the cross-replica prefix currency: ``KVPoolManager`` content-
+    addresses physical blocks with these keys, and the router's shared
+    prefix index maps the SAME keys to replicas, so an identical system
+    prompt routes to the replica whose pool already holds its blocks."""
+    if limit is None:
+        limit = len(prompt)
+    out = []
+    h = hashlib.sha256()
+    end = block_size
+    while end <= limit:
+        h.update(prompt[end - block_size:end].tobytes())
+        out.append(((end, h.digest()), end))
+        end += block_size
+    return out
+
+
 class KVPoolManager:
     """Host-side allocator + prefix cache for the paged KV pool.
 
@@ -80,7 +104,13 @@ class KVPoolManager:
         self.prefix_hit_requests = 0
         self.prefix_requests = 0
         self.scrubbed_blocks = 0
+        self.grown_blocks = 0       # on-demand-growth allocations mid-decode
+        self.preempted_requests = 0  # preempt-to-queue on pool exhaustion
         self._scrub = None          # engine-installed per-block scrub hook
+        # admission-time reservations not yet consumed by a slot insert:
+        # chunked prefill opens a multi-step window between can_admit and
+        # insert, and a later admission must not steal the head's blocks
+        self._pending = 0
 
     # -- capacity ----------------------------------------------------------
     @property
@@ -94,12 +124,31 @@ class KVPoolManager:
         tokens = max(prompt_len + max_new_tokens - 1, 1)
         return -(-tokens // self.block_size)
 
+    def blocks_for_prefill(self, prefill_len):
+        """On-demand growth's ADMISSION footprint: only the prefilled
+        positions [0, prefill_len) — decode blocks are allocated as the
+        cursor advances (``reserve-as-you-decode``), so admission stops
+        paying for tokens not yet generated."""
+        return -(-max(prefill_len, 1) // self.block_size)
+
     def _evictable(self):
         """Cached prefix blocks held ONLY by the cache (ref == 1)."""
         return sum(1 for b in self._prefix.values() if self._ref[b] == 1)
 
     def can_allocate(self, n):
-        return n <= len(self._free) + self._evictable()
+        return n + self._pending <= len(self._free) + self._evictable()
+
+    # -- admission reservations -------------------------------------------
+    def reserve(self, n):
+        """Hold ``n`` blocks against future ``can_allocate`` checks until a
+        slot insert consumes the reservation (chunked prefill runs between
+        admission and insert; without this, a later admission or an
+        on-demand growth could strand the admitted head)."""
+        self._pending += int(n)
+
+    def consume_reservation(self, n):
+        """The insert that the reservation guarded is allocating now."""
+        self._pending = max(self._pending - int(n), 0)
 
     def fits_ever(self, prompt_len, max_new_tokens):
         """False -> shed ``no_free_blocks``: even an empty pool could not
@@ -165,22 +214,26 @@ class KVPoolManager:
             self._unref(b)
         self._slot_tokens.pop(slot, None)
 
+    def grow_slot(self, slot, live_tokens):
+        """On-demand growth: allocate ONE more block for ``slot`` (its decode
+        cursor reached the end of its bound blocks) and record it. Returns
+        the physical block id; the caller must have checked
+        ``can_allocate(1)`` (and preempts to the queue when it is False)."""
+        bid = self.alloc(1)[0]
+        self._slot_blocks[slot].append(bid)
+        self._slot_tokens[slot] = int(live_tokens)
+        self.grown_blocks += 1
+        return bid
+
+    def slot_block_count(self, slot):
+        return len(self._slot_blocks.get(slot, ()))
+
     # -- shared prefixes ---------------------------------------------------
     def _candidate_keys(self, prompt, limit):
-        """(key, end) per full prompt block with ``end <= limit``. Keys are
-        an INCREMENTAL SHA-256 chain over the token bytes (key_j digests
-        blocks 0..j), so key construction is linear in prompt length and a
-        key still commits to the entire prefix content — two prompts share
-        a key iff their prefixes collide SHA-256, i.e. never in practice."""
-        bs = self.block_size
-        out = []
-        h = hashlib.sha256()
-        end = bs
-        while end <= limit:
-            h.update(prompt[end - bs:end].tobytes())
-            out.append(((end, h.digest()), end))
-            end += bs
-        return out
+        """(key, end) per full prompt block with ``end <= limit`` (the
+        module-level ``prefix_chain_keys`` chain — shared with the router's
+        cross-replica prefix index so both sides speak the same keys)."""
+        return prefix_chain_keys(prompt, self.block_size, limit)
 
     def acquire_prefix(self, prompt):
         """Longest cached prefix of ``prompt``: returns (shared_len,
@@ -231,9 +284,17 @@ class KVPoolManager:
             self._block_key[bid] = key
 
     # -- metrics -----------------------------------------------------------
+    def occupancy(self):
+        """Held fraction of allocatable blocks — the cheap O(1) accessor
+        the router's per-request load scoring reads; the full ``stats()``
+        dict (with its per-slot scans) is for metrics emission."""
+        allocatable = max(self.allocatable, 1)
+        return (allocatable - len(self._free)) / allocatable
+
     def stats(self):
         allocatable = max(self.allocatable, 1)
         held = allocatable - len(self._free)   # slots + prefix cache
+        occupancy = self.occupancy()
         live_tokens = sum(self._slot_tokens.values())
         slot_capacity = sum(len(b) for b in self._slot_blocks.values()) \
             * self.block_size
@@ -244,7 +305,7 @@ class KVPoolManager:
             "allocated_blocks": held,
             "free_blocks": len(self._free),
             "cached_prefix_blocks": len(self._prefix),
-            "occupancy": round(held / allocatable, 4),
+            "occupancy": round(occupancy, 4),
             # internal fragmentation of REQUEST-held blocks: reserved token
             # capacity the live footprints don't use (0 = perfectly packed)
             "fragmentation": round(1.0 - live_tokens / slot_capacity, 4)
@@ -255,4 +316,7 @@ class KVPoolManager:
             "prefix_hit_requests": self.prefix_hit_requests,
             "prefix_requests": self.prefix_requests,
             "scrubbed_blocks": self.scrubbed_blocks,
+            "grown_blocks": self.grown_blocks,
+            "preempted_requests": self.preempted_requests,
+            "reserved_blocks": self._pending,
         }

@@ -32,6 +32,10 @@ class ServingMetrics:
         # internal fragmentation, prefix hit rate — the memory-side truth
         # the slot-occupancy number no longer tells under paging
         self.kv_pool = kv_pool
+        # router stats source (Router._router_stats, installed when this
+        # replica registers with a Router): snapshot()["router"] then shows
+        # the cross-replica view, coherent with the Serving/router_* events
+        self.router = None
         self.start_time = clock.now()
         self._started = False       # start_time re-pins at first activity
         self._window_tokens = 0     # tokens since the last reset_window()
@@ -49,6 +53,9 @@ class ServingMetrics:
         # nonfinite-logit count; see serving/engine.py _decode_once)
         self.nonfinite_logit_steps = 0  # decode steps with >=1 bad active slot
         self.unhealthy_slots = 0        # requests shed via unhealthy_slot
+        # on-demand growth: requests preempted back to the queue on pool
+        # exhaustion (they resume; NOT part of the shed/finished partition)
+        self.preempted = 0
 
     # -- recording ----------------------------------------------------------
     def _mark_started(self):
@@ -107,6 +114,9 @@ class ServingMetrics:
     def record_unhealthy(self):
         self.unhealthy_slots += 1
 
+    def record_preempt(self):
+        self.preempted += 1
+
     def observe_step(self, queue_depth, active_slots):
         """Once per scheduler step; periodically flushes monitor events."""
         self.steps += 1
@@ -159,11 +169,14 @@ class ServingMetrics:
             "queue_depth": self._queue_depth,
             "slot_occupancy": self._active_slots / max(self.n_slots, 1),
             "active_slots_peak": self.active_slots_peak,
+            "preempted": self.preempted,
             "health": {
                 "nonfinite_logit_steps": self.nonfinite_logit_steps,
                 "unhealthy_slots": self.unhealthy_slots,
             },
             **({"kv_pool": self.kv_pool()} if self.kv_pool is not None
+               else {}),
+            **({"router": self.router()} if self.router is not None
                else {}),
         }
 

@@ -56,5 +56,25 @@ class RequestQueue:
     def pop(self):
         return self._q.popleft()
 
+    def pop_at(self, index):
+        """Remove and return the request at ``index`` (head-of-line bypass:
+        the scheduler admits a later request past a blocked head under its
+        bounded-starvation window)."""
+        req = self._q[index]
+        del self._q[index]
+        return req
+
     def peek(self):
         return self._q[0] if self._q else None
+
+    def peek_at(self, index):
+        return self._q[index]
+
+    def push_front(self, request):
+        """Re-queue an ALREADY-ADMITTED request at the head (on-demand-growth
+        preemption: the request was running, so it outranks everything queued
+        behind it — FCFS by original admission order). Bypasses admission
+        control: its footprint passed ``fits_ever`` at submit and depth
+        bounds protect arrivals, not returners."""
+        request.state = RequestState.QUEUED
+        self._q.appendleft(request)

@@ -26,6 +26,9 @@ REJECT_PROMPT_TOO_LONG = "prompt_too_long"
 REJECT_BAD_REQUEST = "bad_request"
 # paged KV pool: the request's block footprint exceeds the pool's capacity
 REJECT_NO_FREE_BLOCKS = "no_free_blocks"
+# router tier: every replica is draining or at queue capacity — the
+# cross-replica generalization of queue_full
+REJECT_ALL_REPLICAS_SATURATED = "all_replicas_saturated"
 
 FINISH_EOS = "eos"
 FINISH_LENGTH = "length"
@@ -60,6 +63,9 @@ class Request:
     # set once arrival_time has been converted to an absolute clock value —
     # submit() must not re-shift a request serve() already resolved
     arrival_resolved: bool = False
+    # router session affinity: requests sharing a session_id stick to one
+    # replica (None = stateless, routed purely on load/prefix affinity)
+    session_id: typing.Optional[str] = None
 
     # -- scheduler-owned runtime fields -------------------------------------
     state: RequestState = RequestState.QUEUED
@@ -70,6 +76,17 @@ class Request:
     submit_time: typing.Optional[float] = None
     first_token_time: typing.Optional[float] = None
     finish_time: typing.Optional[float] = None
+    # on-demand growth preemption: times this request was preempted back to
+    # the queue, and the per-slot rng key captured at preemption so the
+    # resumed stream continues bitwise-identically (greedy AND sampled)
+    preemptions: int = 0
+    resume_rng: typing.Optional[np.ndarray] = None
+    # admission-time KV block reservation held in KVPoolManager._pending
+    # until the slot insert consumes it (or an early finish cancels it)
+    reserved_blocks: int = 0
+    # first slot-bind order (preemption victim = newest; a resumed request
+    # keeps its original seniority)
+    admit_seq: int = -1
 
     def __post_init__(self):
         self.prompt = np.asarray(self.prompt, np.int32).reshape(-1)

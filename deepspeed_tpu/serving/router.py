@@ -1,0 +1,449 @@
+"""Multi-replica serving router: the tier above the engine.
+
+One ``ServingEngine`` is one replica; the "millions of users" topology is N
+replicas behind a load-aware dispatcher (the DeepSpeed-Inference serving-tier
+shape, arXiv:2207.00032). The router extends the single-replica
+shed-with-reason admission control into cross-replica balancing:
+
+- **Load-aware dispatch.** Replicas are scored on queue depth, slot
+  occupancy and paged-block occupancy (the same signals
+  ``ServingMetrics.snapshot()`` reports); ``least_loaded`` picks the
+  arg-min, ``round_robin`` cycles. A request is only offered to replicas
+  with queue room — when every live replica is saturated the router sheds
+  ``all_replicas_saturated`` instead of letting one replica OOM its queue.
+- **Session & prefix affinity.** Requests with a ``session_id`` stick to
+  one replica. Stateless requests are matched against a shared prefix
+  index: the paged pool's SHA-256 prefix chain keys (``kv_pool.
+  prefix_chain_keys``) mapped to the replica that last served them, so an
+  identical system prompt routes to the replica whose blocks already hold
+  its prefix (suffix-only prefill there). An affinity target that is
+  overloaded relative to the best candidate is overridden (a *rebalance*).
+- **Drain / rejoin.** ``drain(i)`` stops new admissions to a replica while
+  its in-flight requests finish (the PR 11 teardown discipline: quiesce,
+  then tear down); ``rejoin(i)`` re-registers it (optionally with a fresh
+  engine after a restart, which purges its affinity state).
+
+Everything is host-side policy over per-replica virtual (or wall) clocks, so
+the whole topology is assertable in tier-1: ``serve()`` runs a conservative
+discrete-event simulation — always stepping the replica whose local clock is
+furthest behind — which makes N "parallel" replicas exactly reproducible on
+one process.
+"""
+
+import collections
+
+from .clock import VirtualClock
+from .kv_pool import prefix_chain_keys
+from .metrics import percentile
+from .request import (REJECT_ALL_REPLICAS_SATURATED, RequestState, TokenEvent,
+                      as_request)
+
+
+class _Replica:
+    """Router-side replica handle: the engine plus drain state."""
+
+    def __init__(self, sv):
+        self.sv = sv
+        self.draining = False
+
+    @property
+    def busy(self):
+        return bool(self.sv._slots or self.sv.queue.depth
+                    or self.sv._prefill_jobs)
+
+    @property
+    def saturated(self):
+        """Submitting now would shed ``queue_full``."""
+        return self.sv.queue.depth >= self.sv.cfg.max_queue_depth
+
+    def load_score(self, cfg):
+        sv = self.sv
+        score = cfg.queue_weight * sv.queue.depth \
+            / max(sv.cfg.max_queue_depth, 1)
+        score += cfg.slot_weight \
+            * (len(sv._slots) + len(sv._prefill_jobs)) / max(sv.n_slots, 1)
+        if sv.paged:
+            # O(1) accessor, not the full stats() dict: this runs per
+            # routed request per live replica
+            score += cfg.block_weight * sv.pool_mgr.occupancy()
+        return score
+
+
+class RouterMetrics:
+    """Cross-replica counters + the Serving/router_* monitor events.
+
+    ``snapshot()`` is the machine-readable rollup (the bench artifact's
+    ``router`` block); ``emit_events`` writes the same numbers through the
+    existing MonitorMaster fan-out — tier-1 asserts the two stay coherent
+    (the PR 4 trace==metrics discipline, router edition)."""
+
+    def __init__(self, router, monitor=None, interval=32):
+        self._router = router
+        self.monitor = monitor
+        self.interval = max(int(interval), 1)
+        self._loop_calls = 0
+        self.routed = 0
+        self.shed_saturated = 0
+        self.session_hits = 0
+        self.prefix_hits = 0
+        self.prefix_lookups = 0
+        self.rebalances = 0
+        self.drains = 0
+        self.rejoins = 0
+        self.per_replica_routed = collections.Counter()
+        self._events_emitted = 0
+
+    @property
+    def affinity_hit_rate(self):
+        """Prefix-affinity hit rate: routed-by-prefix / prefix lookups."""
+        return self.prefix_hits / self.prefix_lookups \
+            if self.prefix_lookups else 0.0
+
+    def snapshot(self):
+        reps = self._router._replicas
+        return {
+            "replicas": len(reps),
+            "routed": self.routed,
+            "per_replica_routed": [self.per_replica_routed[i]
+                                   for i in range(len(reps))],
+            "per_replica_queue_depth": [r.sv.queue.depth for r in reps],
+            "per_replica_active_slots": [len(r.sv._slots) for r in reps],
+            "per_replica_occupancy": [
+                round(r.sv.pool_mgr.occupancy(), 4) if r.sv.paged else
+                round(len(r.sv._slots) / max(r.sv.n_slots, 1), 4)
+                for r in reps],
+            "draining": [i for i, r in enumerate(reps) if r.draining],
+            "session_hits": self.session_hits,
+            "prefix_hits": self.prefix_hits,
+            "prefix_lookups": self.prefix_lookups,
+            "affinity_hit_rate": round(self.affinity_hit_rate, 4),
+            "rebalances": self.rebalances,
+            "drains": self.drains,
+            "rejoins": self.rejoins,
+            "shed_all_replicas_saturated": self.shed_saturated,
+        }
+
+    def maybe_emit(self):
+        """Rate-limited emit for the serve/step loops (every ``interval``
+        scheduler rounds, mirroring ServingMetrics.monitor_interval)."""
+        self._loop_calls += 1
+        if self.monitor is not None and self._loop_calls % self.interval == 0:
+            self.emit_events()
+
+    def emit_events(self):
+        """Serving/router_* scalars through the monitor fan-out — one event
+        stream per scalar, per-replica queue depths suffixed _r<i>."""
+        if self.monitor is None:
+            return
+        self._events_emitted += 1
+        step = self._events_emitted
+        snap = self.snapshot()
+        events = [
+            ("Serving/router_routed", float(snap["routed"]), step),
+            ("Serving/router_affinity_hit_rate",
+             float(snap["affinity_hit_rate"]), step),
+            ("Serving/router_rebalances", float(snap["rebalances"]), step),
+            ("Serving/router_drains", float(snap["drains"]), step),
+            ("Serving/router_sheds",
+             float(snap["shed_all_replicas_saturated"]), step),
+        ]
+        for i, depth in enumerate(snap["per_replica_queue_depth"]):
+            events.append((f"Serving/router_r{i}_queue_depth", float(depth),
+                           step))
+        for i, occ in enumerate(snap["per_replica_occupancy"]):
+            events.append((f"Serving/router_r{i}_occupancy", float(occ),
+                           step))
+        self.monitor.write_events(events)
+
+
+class Router:
+    """Load-aware dispatcher over N ``ServingEngine`` replicas."""
+
+    def __init__(self, replicas, config=None, monitor=None):
+        if not replicas:
+            raise ValueError("Router needs at least one replica")
+        self.cfg = config if config is not None else replicas[0].cfg.router
+        self._replicas = [_Replica(sv) for sv in replicas]
+        self._sessions = {}                        # session_id -> replica idx
+        self._prefix_index = collections.OrderedDict()  # chain key -> idx
+        self._rr_next = 0
+        self._next_id = 0
+        self.metrics = RouterMetrics(self, monitor=monitor)
+        for rep in self._replicas:
+            # per-replica snapshots gain the cross-replica view (coherent
+            # with the Serving/router_* events, asserted tier-1)
+            rep.sv.metrics.router = self.metrics.snapshot
+
+    # ------------------------------------------------------------- dispatch
+    def submit(self, request):
+        """Route one request to a replica (or shed it router-side).
+
+        Returns the Request; ``state is REJECTED`` with ``reject_reason ==
+        'all_replicas_saturated'`` means no live replica had queue room —
+        the cross-replica generalization of ``queue_full``. Request-
+        intrinsic sheds (``prompt_too_long``, ``no_free_blocks``) propagate
+        from the chosen replica unchanged: a homogeneous fleet would shed
+        them everywhere, so there is nothing to retry."""
+        req = as_request(request)
+        if req.request_id is None:
+            # router-global ids: replicas must not hand out colliding ones
+            req.request_id = self._next_id
+            self._next_id += 1
+        live = [i for i, r in enumerate(self._replicas)
+                if not r.draining and not r.saturated]
+        if not live:
+            req.state = RequestState.REJECTED
+            req.reject_reason = REJECT_ALL_REPLICAS_SATURATED
+            self.metrics.shed_saturated += 1
+            return req
+        idx = self._route(req, live)
+        self._replicas[idx].sv.submit(req)
+        if req.state is RequestState.REJECTED:
+            # request-intrinsic shed (prompt_too_long / no_free_blocks):
+            # not routed work — and registering its prefix/session would
+            # build affinity toward blocks that never materialized
+            return req
+        self.metrics.routed += 1
+        self.metrics.per_replica_routed[idx] += 1
+        if req.session_id is not None and self.cfg.session_affinity:
+            self._sessions[req.session_id] = idx
+        self._register_prefix(req, idx)
+        return req
+
+    def _route(self, req, live):
+        """Pick a replica index from ``live``: affinity target if healthy,
+        else the load-policy choice (overriding affinity = a rebalance)."""
+        scores = {i: self._replicas[i].load_score(self.cfg) for i in live}
+        if self.cfg.policy == "round_robin":
+            # round_robin ignores load AND affinity (no lookups, no hit
+            # counting) — it is the baseline the affinity/load policies are
+            # measured against
+            for _ in range(len(self._replicas)):
+                cand = self._rr_next % len(self._replicas)
+                self._rr_next += 1
+                if cand in scores:
+                    return cand
+            return live[0]
+        target = kind = None
+        if self.cfg.session_affinity and req.session_id is not None:
+            t = self._sessions.get(req.session_id)
+            if t in scores:
+                target, kind = t, "session"
+        if target is None and self.cfg.prefix_affinity:
+            target = self._prefix_lookup(req, scores)
+            kind = "prefix" if target is not None else None
+        best = min(live, key=lambda i: (scores[i], i))
+        if target is not None:
+            if scores[target] - scores[best] <= self.cfg.rebalance_margin:
+                # hits count ONLY when the affinity target is actually used:
+                # affinity_hit_rate means "routed by affinity", and a
+                # rebalanced-away lookup must not inflate it
+                if kind == "session":
+                    self.metrics.session_hits += 1
+                else:
+                    self.metrics.prefix_hits += 1
+                return target
+            # affinity would pile onto an overloaded replica: rebalance
+            self.metrics.rebalances += 1
+        return best
+
+    def _prefix_lookup(self, req, scores):
+        """Longest prefix-chain-key hit among live replicas (the paged
+        pool's SHA-256 chain keys as the cross-replica currency)."""
+        bs = self._chain_block_size()
+        if bs is None or req.prompt_len <= bs:
+            return None
+        self.metrics.prefix_lookups += 1
+        # longest-first: the deepest cached prefix wins (its replica saves
+        # the most prefill). The hit counter moves in _route — a target
+        # rebalanced away for load is a lookup, not a hit.
+        keys = prefix_chain_keys(req.prompt, bs, req.prompt_len - 1)
+        for key, _end in reversed(keys):
+            idx = self._prefix_index.get(key)
+            if idx is not None and idx in scores:
+                self._prefix_index.move_to_end(key)
+                return idx
+        return None
+
+    def _register_prefix(self, req, idx):
+        """Record the request's full prompt blocks as living on ``idx``
+        (last-writer-wins; bounded LRU)."""
+        bs = self._chain_block_size()
+        if bs is None or not self.cfg.prefix_affinity:
+            return
+        for key, _end in prefix_chain_keys(req.prompt, bs,
+                                           req.prompt_len - 1):
+            self._prefix_index[key] = idx
+            self._prefix_index.move_to_end(key)
+        while len(self._prefix_index) > self.cfg.prefix_index_cap:
+            self._prefix_index.popitem(last=False)
+
+    def _chain_block_size(self):
+        """The chain-key granularity: the first paged replica's block size
+        (None when no replica pages — there are no blocks to share)."""
+        for r in self._replicas:
+            if r.sv.paged and r.sv.cfg.kv_pool.prefix_cache:
+                return r.sv.pool_mgr.block_size
+        return None
+
+    # ------------------------------------------------------ drain / rejoin
+    def drain(self, idx):
+        """Stop routing new work to replica ``idx``; in-flight requests keep
+        decoding to completion (``drained(idx)`` turns True). The safe
+        moment to ``sv.destroy()`` for a restart."""
+        rep = self._replicas[idx]
+        if not rep.draining:
+            rep.draining = True
+            self.metrics.drains += 1
+
+    def drained(self, idx):
+        """True once the draining replica has no in-flight work left."""
+        return not self._replicas[idx].busy
+
+    def rejoin(self, idx, engine=None):
+        """Re-admit replica ``idx``. ``engine``: a replacement ServingEngine
+        after a restart — its pool is empty, so the router purges the
+        replica's prefix-index entries and session stickiness (stale
+        affinity would route cache misses at it)."""
+        rep = self._replicas[idx]
+        if engine is not None:
+            rep.sv = engine
+            engine.metrics.router = self.metrics.snapshot
+            for key in [k for k, v in self._prefix_index.items() if v == idx]:
+                del self._prefix_index[key]
+            for sid in [s for s, v in self._sessions.items() if v == idx]:
+                del self._sessions[sid]
+        rep.draining = False
+        self.metrics.rejoins += 1
+
+    # ------------------------------------------------------------- the loop
+    def step(self):
+        """One scheduler step on every busy replica (the wall-clock /
+        manual-driving path). Returns the concatenated TokenEvents."""
+        events = []
+        for rep in self._replicas:
+            if rep.busy:
+                events.extend(rep.sv.step())
+        self.metrics.maybe_emit()
+        return events
+
+    def serve(self, requests=None, yield_rejections=True):
+        """Streaming frontend over the fleet: feed ``requests`` (each
+        optionally carrying an ``arrival_time`` offset) through the router,
+        yielding TokenEvents as replicas produce them.
+
+        Under virtual clocks this is a conservative discrete-event
+        simulation of N PARALLEL replicas: each replica advances its own
+        clock by its own work, and the router always steps the busy replica
+        whose local clock is furthest behind, dispatching arrivals due by
+        that horizon first. Makespan is ``max`` over replica clocks, not the
+        sum — which is what makes least-loaded measurably beat round-robin
+        in tier-1. With wall clocks every busy replica steps each loop."""
+        pending = sorted((as_request(r) for r in (requests or [])),
+                         key=lambda r: r.arrival_time or 0.0)
+        virtual = all(isinstance(r.sv.clock, VirtualClock)
+                      for r in self._replicas)
+        t0 = max(r.sv.clock.now() for r in self._replicas)
+        for r in pending:
+            if not r.arrival_resolved:
+                r.arrival_time = t0 + (r.arrival_time or 0.0)
+                r.arrival_resolved = True
+            elif r.arrival_time is None:
+                r.arrival_time = t0
+        try:
+            while pending or any(r.busy for r in self._replicas):
+                busy = [r for r in self._replicas if r.busy]
+                if busy:
+                    horizon = min(r.sv.clock.now() for r in busy)
+                else:
+                    horizon = pending[0].arrival_time
+                while pending and pending[0].arrival_time <= horizon:
+                    for ev in self._dispatch(pending.pop(0),
+                                             yield_rejections):
+                        yield ev
+                    busy = [r for r in self._replicas if r.busy]
+                if not busy:
+                    if not pending:
+                        break
+                    # everyone idle: jump to the next arrival
+                    self._catch_up_all(pending[0].arrival_time)
+                    continue
+                if virtual:
+                    # advance the laggard one step: no replica's clock ever
+                    # runs ahead of another's un-simulated past
+                    rep = min(busy, key=lambda r: r.sv.clock.now())
+                    for ev in rep.sv.step():
+                        yield ev
+                else:
+                    for rep in busy:
+                        for ev in rep.sv.step():
+                            yield ev
+                self.metrics.maybe_emit()
+        finally:
+            for rep in self._replicas:
+                rep.sv.tracer.flush()
+
+    def _dispatch(self, req, yield_rejections):
+        # an idle target's clock may lag the arrival: idle time passes
+        req = as_request(req)
+        self._catch_up_idle(req.arrival_time)
+        routed = self.submit(req)
+        if routed.state is RequestState.REJECTED and yield_rejections:
+            now = req.arrival_time if req.arrival_time is not None else 0.0
+            return [TokenEvent(routed.request_id, -1, -1, True,
+                               f"rejected:{routed.reject_reason}", now)]
+        return []
+
+    def _catch_up_idle(self, t):
+        if t is None:
+            return
+        for rep in self._replicas:
+            if not rep.busy:
+                gap = t - rep.sv.clock.now()
+                if gap > 0:
+                    rep.sv.clock.sleep(gap)
+
+    def _catch_up_all(self, t):
+        for rep in self._replicas:
+            gap = t - rep.sv.clock.now()
+            if gap > 0:
+                rep.sv.clock.sleep(gap)
+
+    def run(self, requests):
+        """Non-streaming convenience: serve to completion and return
+        ``(finished, rejected, snapshot)`` (cf. ``ServingEngine.run``)."""
+        reqs = [as_request(r) for r in (requests or [])]
+        for _ in self.serve(reqs, yield_rejections=False):
+            pass
+        finished = [r for r in reqs if r.state is RequestState.FINISHED]
+        rejected = [r for r in reqs if r.state is RequestState.REJECTED]
+        return finished, rejected, self.snapshot()
+
+    # -------------------------------------------------------------- rollups
+    def snapshot(self):
+        """Fleet rollup: the router block plus per-replica ServingMetrics
+        snapshots and aggregate latency percentiles."""
+        reps = [r.sv.metrics.snapshot() for r in self._replicas]
+        ttft = [s for r in self._replicas
+                for s in r.sv.metrics.ttft_samples]
+        tpot = [s for r in self._replicas
+                for s in r.sv.metrics.tpot_samples]
+        to_ms = lambda v: None if v is None else v * 1e3
+        return {
+            "router": self.metrics.snapshot(),
+            "replicas": reps,
+            "finished": sum(r["finished"] for r in reps),
+            "total_tokens": sum(r["total_tokens"] for r in reps),
+            "ttft_ms": {"p50": to_ms(percentile(ttft, 50)),
+                        "p99": to_ms(percentile(ttft, 99))},
+            "tpot_ms": {"p50": to_ms(percentile(tpot, 50)),
+                        "p99": to_ms(percentile(tpot, 99))},
+            "makespan": max(r.sv.clock.now() for r in self._replicas),
+        }
+
+    def compile_counts(self):
+        return [r.sv.compile_counts() for r in self._replicas]
+
+    def destroy(self):
+        for rep in self._replicas:
+            rep.sv.destroy()

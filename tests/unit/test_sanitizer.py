@@ -423,6 +423,54 @@ def test_serving_decode_paged_within_sanitizer_budget(decode_report_paged):
     assert san["summary"]["transfer_count"] == 0
 
 
+@pytest.fixture(scope="module")
+def prefill_chunked_report(devices8):
+    """tools/program_lint.py --program prefill-chunked geometry: the chunked
+    suffix-prefill program (one full chunk's bucket at a traced start
+    position against a donated partial cache) held to the checked-in
+    serving-prefill-chunked/8/bf16 budget — the fence for the chunked-
+    prefill path, enforced tier-1 alongside the decode gates."""
+    import jax.numpy as jnp
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models import CausalLM, TransformerConfig
+
+    model = CausalLM(TransformerConfig(
+        vocab_size=512, max_seq_len=64, n_layers=4, n_heads=4,
+        d_model=128, d_ff=256, compute_dtype=jnp.bfloat16))
+    engine = deepspeed_tpu.init_inference(
+        model=model,
+        config={"dtype": "bfloat16", "max_tokens": 64,
+                "serving": {"n_slots": 4, "max_len": 64,
+                            "virtual_clock": True,
+                            "chunked_prefill": {"enabled": True,
+                                                "chunk_size": 16}}})
+    report = engine.prefill_chunk_report()
+    yield report
+    engine.destroy()
+
+
+def test_serving_prefill_chunked_within_sanitizer_budget(
+        prefill_chunked_report):
+    from deepspeed_tpu.profiling.collectives import check_budgets
+
+    v = check_budgets(prefill_chunked_report,
+                      BUDGETS["serving-prefill-chunked/8/bf16"])
+    assert not v, v
+    san = prefill_chunked_report["sanitizer"]
+    assert count_at_or_above(san["findings"], "warning") == 0
+    # the donation pin chunked prefill depends on: the partial b=1 cache
+    # (k + v) aliases the output, so chunk N+1 reuses chunk N's buffers —
+    # a chunked prefill never holds two copies of the request's cache
+    assert san["summary"]["n_aliased_params"] == 2
+    assert san["summary"]["undonated_candidate_bytes"] == 0
+    assert san["summary"]["transfer_count"] == 0
+    # start_pos / true_len are TRACED: one compiled program per chunk
+    # bucket no matter where in the prompt the chunk starts
+    assert san["summary"].get("python_scalar_args", 0) == 0
+    assert san["summary"].get("baked_const_bytes", 0) == 0
+
+
 def test_serving_decode_slot_state_fully_donated(decode_report):
     """The donation discipline the slot pool depends on: every state leaf
     (KV pool, cursors, rng, sampling knobs — 11 arrays) aliases an output,

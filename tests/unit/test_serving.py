@@ -393,9 +393,10 @@ def test_serving_tp_mesh_parity(devices8):
 def test_bench_serving_qps_smoke(tmp_path, paged):
     """tools/bench_serving.py --qps emits the throughput–latency artifact on
     the tiny preset under JAX_PLATFORMS=cpu (tier-1 smoke, incl. overload
-    shed accounting) — both the dense default and, with --paged, the
-    kv_pool block the committed artifact carries (occupancy, fragmentation,
-    prefix hit rate, shed histogram)."""
+    shed accounting). Both rows run THROUGH THE ROUTER (the artifact always
+    carries a router block); the paged row additionally exercises
+    --replicas 2 + --chunk-size + --session-affinity and the kv_pool block
+    the committed artifact carries."""
     out = tmp_path / "serving_load.json"
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     cmd = [sys.executable, os.path.join(REPO, "tools", "bench_serving.py"),
@@ -404,7 +405,9 @@ def test_bench_serving_qps_smoke(tmp_path, paged):
            "--new-tokens", "6", "--slots", "2", "--queue-depth", "3",
            "--seed", "0", "--output", str(out)]
     if paged:
-        cmd += ["--paged", "--kv-block-size", "8", "--shared-prefix", "8"]
+        cmd += ["--paged", "--kv-block-size", "8", "--shared-prefix", "8",
+                "--replicas", "2", "--chunk-size", "8",
+                "--session-affinity"]
     proc = subprocess.run(cmd, cwd=REPO, env=env, capture_output=True,
                           text=True, timeout=300)
     assert proc.returncode == 0, proc.stdout + proc.stderr
@@ -417,7 +420,18 @@ def test_bench_serving_qps_smoke(tmp_path, paged):
     assert art["tokens_per_s"] > 0
     assert art["compile_counts"]["decode"] == 1
     assert art["numerics"]["nonfinite_logit_steps"] == 0
+    # the router block is always present: per-replica routing/occupancy,
+    # affinity hit rates, rebalances + drain counts
+    router = art["router"]
+    assert router["replicas"] == (2 if paged else 1)
+    assert sum(router["per_replica_routed"]) == router["routed"]
+    assert router["routed"] == art["completed"]
+    assert "affinity_hit_rate" in router and "rebalances" in router
+    assert "drains" in router and router["drains"] == 0
     if paged:
+        assert art["replicas"] == 2
+        assert router["session_hits"] > 0  # sticky sessions engaged
+        assert len(art["compile_counts_per_replica"]) == 2
         kv = art["kv_pool"]
         assert kv["n_blocks"] > 1 and kv["block_size"] == 8
         assert 0.0 <= kv["occupancy"] <= 1.0

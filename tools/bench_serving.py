@@ -156,7 +156,7 @@ def run_open_loop(args):
     latency JSON artifact (p50/p99 TTFT, TPOT, tokens/s, shed rate)."""
     import jax
 
-    from deepspeed_tpu.serving import Request, percentile
+    from deepspeed_tpu.serving import Request, Router, ServingEngine, percentile
 
     size = args.sizes.split(",")[0]
     mode = args.modes.split(",")[0]
@@ -167,7 +167,11 @@ def run_open_loop(args):
     if args.paged:
         serving_kw["kv_pool"] = {
             "enabled": True, "block_size": args.kv_block_size,
-            "n_blocks": args.kv_blocks, "kv_dtype": args.kv_dtype}
+            "n_blocks": args.kv_blocks, "kv_dtype": args.kv_dtype,
+            "on_demand_growth": bool(args.kv_growth)}
+    if args.chunk_size:
+        serving_kw["chunked_prefill"] = {"enabled": True,
+                                         "chunk_size": args.chunk_size}
     engine._config.serving = engine._config.serving.replace(**serving_kw)
 
     rng = np.random.RandomState(args.seed)
@@ -186,18 +190,45 @@ def run_open_loop(args):
                            (max(plen - len(shared), 1),)).astype(np.int32)
         requests.append(Request(
             prompt=np.concatenate([shared, tail])[:max(plen, 1)],
-            max_new_tokens=new, arrival_time=float(arrivals[i])))
+            max_new_tokens=new, arrival_time=float(arrivals[i]),
+            # --session-affinity: a small pool of sticky sessions, so the
+            # router's session map actually gets exercised under load
+            session_id=f"sess{i % 4}" if args.session_affinity else None))
+
+    # the router path is the production topology: N ServingEngine replicas
+    # over ONE weight set behind the load-aware dispatcher (N=1 still goes
+    # through the router, so the artifact always carries the router block)
+    replicas = [ServingEngine(engine) for _ in range(max(args.replicas, 1))]
+    router = Router(replicas)
 
     # compile outside the measured window (the reference's capture-at-init):
-    # one prefill per prompt bucket + the decode/insert pool programs
-    engine.serving.run([Request(
-        prompt=rng.randint(0, vocab, (p,)).astype(np.int32),
-        max_new_tokens=2) for p in prompts])
-    engine.serving.metrics.reset_window()  # warmup out of the tokens/s window
+    # one prefill per prompt bucket + the decode/insert pool programs,
+    # warmed PER REPLICA (each owns its own slot-pool programs)
+    for rep in replicas:
+        rep.run([Request(
+            prompt=rng.randint(0, vocab, (p,)).astype(np.int32),
+            max_new_tokens=2) for p in prompts])
+        rep.metrics.reset_window()  # warmup out of the tokens/s window
 
     t0 = time.perf_counter()
-    finished, rejected, metrics_snap = engine.serving.run(requests)
+    finished, rejected, router_snap = router.run(requests)
     wall_s = time.perf_counter() - t0
+    metrics_snap = replicas[0].metrics.snapshot()
+    # fleet-aggregated health/shed blocks (the ServingMetrics partition,
+    # summed over replicas)
+    agg_health = {
+        k: sum(r["health"][k] for r in router_snap["replicas"])
+        for k in ("nonfinite_logit_steps", "unhealthy_slots")}
+    agg_shed = {}
+    for r in router_snap["replicas"]:
+        for k, v in r["shed"].items():
+            agg_shed[k] = agg_shed.get(k, 0) + v
+    # router-level sheds never reach a replica's metrics — fold them in so
+    # the shed histogram still partitions every turned-away request
+    n_sat = router_snap["router"]["shed_all_replicas_saturated"]
+    if n_sat:
+        agg_shed["all_replicas_saturated"] = \
+            agg_shed.get("all_replicas_saturated", 0) + n_sat
 
     # unhealthy_slot sheds come back FINISHED too — keep their latencies
     # out of the artifact, same partition ServingMetrics enforces
@@ -232,21 +263,30 @@ def run_open_loop(args):
         "wall_s": round(wall_s, 3),
         "ttft_ms": {"p50": pct(ttfts, 50), "p99": pct(ttfts, 99)},
         "tpot_ms": {"p50": pct(tpots, 50), "p99": pct(tpots, 99)},
-        "compile_counts": engine.serving.compile_counts(),
+        "replicas": len(replicas),
+        "compile_counts": replicas[0].compile_counts(),
+        # the router block: per-replica routing/occupancy, affinity hit
+        # rates, rebalances and drain counts — how the fleet actually
+        # balanced, next to the throughput it earned
+        "router": router_snap["router"],
         # numerics self-incrimination next to the run stamp: a throughput
         # number earned while slots were shedding non-finite logits (or
-        # steps were silently unhealthy) carries its own evidence
-        "numerics": metrics_snap.get("health", {}),
+        # steps were silently unhealthy) carries its own evidence —
+        # aggregated over the fleet
+        "numerics": agg_health,
         "n_params_m": round(n_params / 1e6, 1),
     }
+    if len(replicas) > 1:
+        artifact["compile_counts_per_replica"] = router.compile_counts()
     if "kv_pool" in metrics_snap:
         # paged-pool accounting next to the run stamp / numerics blocks: a
         # tokens/s number means something different at 30% vs 95% block
         # occupancy, and the shed histogram says WHY work was turned away
+        # (replica 0's pool; per-replica occupancy lives in the router block)
         artifact["kv_pool"] = dict(
             metrics_snap["kv_pool"],
             kv_dtype=args.kv_dtype or "engine",
-            shed_reasons=dict(metrics_snap.get("shed", {})))
+            shed_reasons=agg_shed)
     from _common import stamp_record
 
     stamp_record(artifact, config={
@@ -256,12 +296,16 @@ def run_open_loop(args):
         "new_tokens": args.new_tokens, "seed": args.seed,
         "paged": bool(args.paged), "kv_block_size": args.kv_block_size,
         "kv_blocks": args.kv_blocks, "kv_dtype": args.kv_dtype,
-        "shared_prefix": args.shared_prefix})
+        "shared_prefix": args.shared_prefix, "replicas": len(replicas),
+        "chunk_size": args.chunk_size,
+        "session_affinity": bool(args.session_affinity),
+        "kv_growth": bool(args.kv_growth)})
     print(json.dumps(artifact), flush=True)
     if args.output:
         with open(args.output, "w") as f:
             json.dump(artifact, f, indent=1)
         print(f"artifact written to {args.output}", flush=True)
+    router.destroy()
     engine.destroy()
     return 0
 
@@ -293,6 +337,23 @@ def main():
     ap.add_argument("--shared-prefix", type=int, default=0,
                     help="open every prompt with this many IDENTICAL "
                          "system-prompt tokens (exercises the prefix cache)")
+    ap.add_argument("--replicas", type=int, default=1,
+                    help="open-loop mode over N ServingEngine replicas "
+                         "behind the load-aware Router (serving/router.py); "
+                         "the artifact gains a router block (per-replica "
+                         "occupancy, affinity hit rate, rebalances, drains)")
+    ap.add_argument("--chunk-size", type=int, default=0,
+                    help="chunked prefill: split prompt prefill into chunks "
+                         "of this many tokens interleaved with decode steps "
+                         "(0 = off) — bounds co-batched TPOT under long "
+                         "prompts")
+    ap.add_argument("--session-affinity", action="store_true",
+                    help="tag requests with a small pool of session ids so "
+                         "the router's sticky-session map is exercised")
+    ap.add_argument("--kv-growth", action="store_true",
+                    help="paged pool reserves prompt blocks only and grows "
+                         "decode blocks on demand (preempt-to-queue on "
+                         "exhaustion)")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--output", default=None,
                     help="write the open-loop JSON artifact here")

@@ -100,6 +100,46 @@ def lint_decode(args):
     return report
 
 
+def lint_prefill_chunked(args):
+    """The chunked suffix-prefill program (serving/engine.py suffix
+    programs): one full chunk's bucket written at a traced start position
+    against a donated partial b=1 cache — the program every chunk (and every
+    shared-prefix suffix hit) dispatches. Gate with
+    ``--budget serving-prefill-chunked/8/bf16``."""
+    import jax.numpy as jnp
+
+    if REPO not in sys.path:
+        sys.path.insert(0, REPO)
+    from scale_projection import PRESETS
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models import CausalLM, TransformerConfig
+
+    preset = dict(PRESETS[args.preset])
+    max_len = args.serving_max_len or preset["seq"]
+    model = CausalLM(TransformerConfig(
+        vocab_size=preset["vocab_size"], max_seq_len=max_len,
+        n_layers=preset["n_layers"], n_heads=preset["n_heads"],
+        d_model=preset["d_model"], d_ff=preset["d_ff"],
+        compute_dtype=jnp.bfloat16))
+    serving = {"n_slots": args.slots, "max_len": max_len,
+               "virtual_clock": True,
+               "chunked_prefill": {"enabled": True,
+                                   "chunk_size": args.chunk_size}}
+    engine = deepspeed_tpu.init_inference(
+        model=model,
+        config={"dtype": "bfloat16", "max_tokens": max_len,
+                "serving": serving})
+    report = engine.prefill_chunk_report(args.chunk_size)
+    report.update({"preset": args.preset, "devices": args.devices,
+                   "n_slots": args.slots, "serving_max_len": max_len,
+                   "chunk_size": args.chunk_size,
+                   "n_params": engine.module.num_parameters
+                   if hasattr(engine.module, "num_parameters") else None})
+    engine.destroy()
+    return report
+
+
 def _planted_program(clean=False):
     """A small program with one planted defect per sanitizer rule (or its
     clean twin): f32 dot leak, missing donation, host transfer, replicated
@@ -219,6 +259,8 @@ def child(args):
         programs["train"] = lint_train(args)
     if args.program in ("decode", "all"):
         programs["decode"] = lint_decode(args)
+    if args.program in ("prefill-chunked", "all"):
+        programs["prefill-chunked"] = lint_prefill_chunked(args)
     if args.program == "planted":
         programs["planted"] = _planted_program(clean=False)
     if args.program == "clean":
@@ -233,7 +275,8 @@ def child(args):
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--program", default="all",
-                    choices=["train", "decode", "all", "planted", "clean"])
+                    choices=["train", "decode", "prefill-chunked", "all",
+                             "planted", "clean"])
     ap.add_argument("--preset", default="tiny-test")
     ap.add_argument("--devices", type=int, default=8)
     ap.add_argument("--micro", type=int, default=1)
@@ -251,6 +294,9 @@ def main():
                          "gate with --budget serving-decode-paged/8/bf16")
     ap.add_argument("--kv-block-size", type=int, default=16)
     ap.add_argument("--kv-dtype", default="", choices=["", "int8"])
+    ap.add_argument("--chunk-size", type=int, default=16,
+                    help="chunked-prefill chunk (tokens) the "
+                         "prefill-chunked program is linted at")
     ap.add_argument("--budget", default=None,
                     help="key into tools/collective_budgets.json; applies "
                          "to every linted program, violations exit 2")
@@ -285,7 +331,8 @@ def main():
            "--gather-impl", args.gather_impl,
            "--grad-reduce-dtype", args.grad_reduce_dtype,
            "--slots", str(args.slots),
-           "--kv-block-size", str(args.kv_block_size)]
+           "--kv-block-size", str(args.kv_block_size),
+           "--chunk-size", str(args.chunk_size)]
     if args.paged:
         cmd += ["--paged"]
     if args.kv_dtype:
