@@ -413,6 +413,42 @@ class RouterConfig(ConfigModel):
                 f"{self.rebalance_margin}")
 
 
+class SLOConfig(ConfigModel):
+    """Serving latency objectives (``serving.slo``): P99 targets graded
+    against the streaming latency digests (``telemetry/digest.py``) that
+    ``ServingMetrics`` and the Router maintain per replica and
+    fleet-aggregated. A target of 0 disables that objective. When any
+    target is set, the metrics cadence emits ``Serving/ttft_p99_ms``-style
+    scalars plus a structured ``slo/violation`` trace event with the
+    burn rate (fraction of requests over target / the 1% error budget a
+    P99 objective grants) whenever the observed P99 exceeds its target;
+    ``tools/fleet_report.py --fail-on slo`` turns the same grade into an
+    exit code."""
+
+    # P99 targets in milliseconds (virtual-clock units x1e3 under a
+    # VirtualClock); 0 = objective off
+    ttft_p99_ms: float = 0.0
+    tpot_p99_ms: float = 0.0
+    queue_wait_p99_ms: float = 0.0
+
+    def _validate(self):
+        for field in ("ttft_p99_ms", "tpot_p99_ms", "queue_wait_p99_ms"):
+            if getattr(self, field) < 0:
+                raise ConfigError(
+                    f"slo.{field} must be >= 0 (0 disables), got "
+                    f"{getattr(self, field)}")
+
+    def targets_ms(self):
+        """The evaluate_slo() input dict (keys carry the _p99_ms suffix)."""
+        return {"ttft_p99_ms": self.ttft_p99_ms,
+                "tpot_p99_ms": self.tpot_p99_ms,
+                "queue_wait_p99_ms": self.queue_wait_p99_ms}
+
+    @property
+    def armed(self):
+        return any(v > 0 for v in self.targets_ms().values())
+
+
 class ServingConfig(ConfigModel):
     """Continuous-batching serving (Orca-style slot scheduler over ONE jitted
     decode program; DeepSpeed-Inference's serving-side batching layer,
@@ -452,6 +488,9 @@ class ServingConfig(ConfigModel):
     # multi-replica router policy (serving/router.py reads this block off
     # its first replica's config unless given one explicitly)
     router: RouterConfig = None
+    # latency SLO targets graded against the streaming digests (per replica
+    # and fleet-aggregated); 0 targets = no objective
+    slo: SLOConfig = None
     # head-of-line bypass under block-aware admission: when the queue head's
     # KV footprint cannot fit, up to this many later requests that DO fit may
     # be admitted past it before admissions stop until the head clears
@@ -465,6 +504,8 @@ class ServingConfig(ConfigModel):
             self.chunked_prefill = ChunkedPrefillConfig()
         if self.router is None:
             self.router = RouterConfig()
+        if self.slo is None:
+            self.slo = SLOConfig()
         if self.hol_bypass_limit < 0:
             raise ConfigError(
                 f"serving.hol_bypass_limit must be >= 0, got "

@@ -127,7 +127,8 @@ class ServingEngine:
                                       monitor=monitor,
                                       interval=self.cfg.monitor_interval,
                                       kv_pool=self.pool_mgr.stats
-                                      if self.paged else None)
+                                      if self.paged else None,
+                                      slo=self.cfg.slo)
         # numerics watchdog (the serving leg of telemetry/health.py): the
         # decode program ALWAYS emits the per-slot nonfinite-logit count
         # (so the sanitizer budget audits the real program); the shed hook
@@ -144,6 +145,8 @@ class ServingEngine:
             getattr(engine.config, "telemetry", None), clock=self.clock.now,
             meta={"process": "serving", "n_slots": self.n_slots,
                   "max_len": self.max_len})
+        # the structured slo/violation events ride the request tracer
+        self.metrics.tracer = self.tracer
 
         self._slots = {}              # slot index -> running Request
         self._free_slots = list(range(self.n_slots - 1, -1, -1))  # pop() -> 0 first
@@ -555,6 +558,11 @@ class ServingEngine:
         if req.request_id is None:
             req.request_id = self._next_id
             self._next_id += 1
+        if req.trace_id is None:
+            # a Router stamps its own fleet-global trace id before this;
+            # the standalone engine mints one so single-replica traces are
+            # mergeable by the same machinery
+            req.trace_id = f"req-{req.request_id:06d}"
         req.submit_time = self.clock.now()
         if req.arrival_time is not None and not req.arrival_resolved:
             # direct submit(): arrival_time is an offset from now (same
@@ -569,15 +577,14 @@ class ServingEngine:
             self.metrics.record_submit()
             self.tracer.instant(
                 "request/queued", cat="serving", request_id=req.request_id,
-                prompt_len=req.prompt_len,
-                # TTFT's zero point, exactly as Request.ttft defines it:
-                # resolved arrival if the request carried one, else submit
-                start=req.arrival_time if req.arrival_time is not None
-                else req.submit_time)
+                trace_id=req.trace_id, prompt_len=req.prompt_len,
+                # TTFT's zero point, exactly as Request.ttft defines it
+                start=req.start_time)
         else:
             self.metrics.record_shed(reason)
             self.tracer.instant("request/shed", cat="serving",
-                                request_id=req.request_id, reason=reason)
+                                request_id=req.request_id,
+                                trace_id=req.trace_id, reason=reason)
         return req
 
     # ------------------------------------------------------------- the loop
@@ -629,8 +636,9 @@ class ServingEngine:
             if self.growth:
                 # reserve-as-you-decode: admission pays only the prefilled
                 # positions (prompt, or prompt + replayed tokens on resume)
+                # PLUS the first decode write — see _growth_admission_len
                 need = self.pool_mgr.blocks_for_prefill(
-                    self._prefill_len(req))
+                    self._growth_admission_len(req))
             else:
                 need = self.pool_mgr.blocks_for(req.prompt_len,
                                                 req.max_new_tokens)
@@ -648,6 +656,21 @@ class ServingEngine:
         preemption resume — every already-generated token except the last
         (which decode re-feeds at the cursor)."""
         return req.prompt_len + max(len(req.tokens) - 1, 0)
+
+    def _growth_admission_len(self, req):
+        """Positions a growth-mode admission must cover: the prefill PLUS
+        the first decode write (at position ``prefill_len``) whenever the
+        request will decode at all. Sizing only the prefill is a LIVELOCK:
+        a resumed request re-enters exactly at a block boundary, so it
+        must grow before producing a single token — and with the queue
+        head's admission reservation holding the pool's last blocks, the
+        grow fails, the request preempts itself, and the two ping-pong
+        forever with zero progress (caught by the fleet-observability
+        preemption workload, tier-1-pinned in test_fleet_obs). Covering
+        the first write restores the progress guarantee: every admission
+        nets at least one token before any preemption."""
+        will_decode = bool(req.tokens) or req.max_new_tokens > 1
+        return self._prefill_len(req) + (1 if will_decode else 0)
 
     def _unreserve(self, req):
         """Cancel an admission-time block reservation (early finish / shed
@@ -688,11 +711,22 @@ class ServingEngine:
                 [req.prompt, np.asarray(req.tokens[:-1], np.int32)])
         else:
             ids_full = req.prompt
+        if req.prefill_start_time is None:
+            # queue-wait window closes at the FIRST slot grant (a resume
+            # replay keeps the original endpoint — its wait was decided when
+            # it first left the queue)
+            req.prefill_start_time = self.clock.now()
+            self.metrics.record_queue_wait(req)
         shared_len, shared_blocks = 0, []
         if self.paged:
             # take refs on matched prefix blocks NOW so an eviction between
             # here and the slot insert can't dangle them
             shared_len, shared_blocks = self.pool_mgr.acquire_prefix(ids_full)
+        if shared_len and not resume:
+            # positions the prefix-cache hit never dispatches: reported in
+            # the goodput block (work avoided, not part of the frac)
+            req.prefix_saved_tokens += shared_len
+            self.metrics.prefix_saved_tokens += shared_len
         chunk = self.cfg.chunked_prefill.chunk_size
         if resume or (self.chunked and len(ids_full) - shared_len > chunk):
             # multi-step prefill (chunked and/or resume replay): reserve the
@@ -728,8 +762,11 @@ class ServingEngine:
             # exactly that before this cap)
             padded = self.engine._bucket_prompt_len(
                 len(suffix), self.max_len - shared_len)
+            req.padding_tokens += padded - len(suffix)
+            self.metrics.record_prefill_work(padded, len(suffix))
             with self.tracer.span("prefill", cat="serving",
                                   request_id=req.request_id,
+                                  trace_id=req.trace_id, n=len(suffix),
                                   padded_len=padded, shared_len=shared_len):
                 cache = self._seed_cache_jit(self._state, jnp.asarray(row))
                 ids = np.zeros((1, padded), np.int32)
@@ -747,8 +784,11 @@ class ServingEngine:
             # the generation region — one bucket serves every max_new_tokens
             padded = self.engine._bucket_prompt_len(req.prompt_len,
                                                     self.max_len)
+            req.padding_tokens += padded - req.prompt_len
+            self.metrics.record_prefill_work(padded, req.prompt_len)
             with self.tracer.span("prefill", cat="serving",
                                   request_id=req.request_id,
+                                  trace_id=req.trace_id, n=req.prompt_len,
                                   padded_len=padded):
                 ids = np.zeros((1, padded), np.int32)
                 ids[0, :req.prompt_len] = req.prompt
@@ -790,6 +830,7 @@ class ServingEngine:
             self.metrics.record_unhealthy()
             self.tracer.instant("request/unhealthy", cat="serving", ts=now,
                                 request_id=req.request_id,
+                                trace_id=req.trace_id,
                                 nonfinite_logits=int(nf))
             self._finish(req, FINISH_UNHEALTHY, now)
             events.append(TokenEvent(req.request_id, -1, 0, True,
@@ -802,7 +843,8 @@ class ServingEngine:
         self.metrics.record_tokens(1)
         self.metrics.record_first_token(req)
         self.tracer.instant("request/first_token", cat="serving", ts=now,
-                            request_id=req.request_id)
+                            request_id=req.request_id,
+                            trace_id=req.trace_id)
 
         eos = req.eos_token_id
         if (eos is not None and t == eos) or t in req.stop_token_ids \
@@ -858,8 +900,18 @@ class ServingEngine:
         # guard as the shared-prefix suffix path: a bucket past max_len
         # would make XLA clamp the q-block write start)
         padded = self.engine._bucket_prompt_len(n, self.max_len - job.pos)
+        req = job.req
+        req.chunks += 1
+        req.padding_tokens += padded - n
+        if job.resume:
+            # every replayed position is device work a preemption burned:
+            # it was prefilled (prompt) or decoded (generated) once already
+            req.replay_tokens += n
+        self.metrics.record_prefill_work(padded, n,
+                                         replay=n if job.resume else 0)
         with self.tracer.span("prefill_chunk", cat="serving",
-                              request_id=job.req.request_id,
+                              request_id=req.request_id,
+                              trace_id=req.trace_id, n=n,
                               padded_len=padded, start=job.pos,
                               resume=job.resume):
             ids = np.zeros((1, padded), np.int32)
@@ -911,8 +963,12 @@ class ServingEngine:
                 np.int32(-1 if eos is None else eos))
         self.tracer.instant("request/resumed", cat="serving",
                             ts=self.clock.now(), request_id=req.request_id,
+                            trace_id=req.trace_id,
                             n_tokens=len(req.tokens),
-                            preemptions=req.preemptions)
+                            preemptions=req.preemptions,
+                            # positions this resume re-prefilled (the wide
+                            # event's replay attribution per round trip)
+                            replay_tokens=len(job.ids) - job.shared_len)
 
     # ------------------------------------------------- on-demand growth
     def _grow_or_preempt(self):
@@ -941,6 +997,7 @@ class ServingEngine:
             if preempted_self:
                 continue
             bid = mgr.grow_slot(slot, live_tokens=pos + 1)
+            req.kv_blocks_peak = max(req.kv_blocks_peak, j + 1)
             self._state = self._grow_jit(self._state, np.int32(slot),
                                          np.int32(j), np.int32(bid))
 
@@ -961,6 +1018,7 @@ class ServingEngine:
         self.queue.push_front(req)
         self.tracer.instant("request/preempted", cat="serving",
                             ts=self.clock.now(), request_id=req.request_id,
+                            trace_id=req.trace_id,
                             n_tokens=len(req.tokens))
 
     def _insert_paged(self, req, slot, cache, shared_len, shared_blocks,
@@ -974,7 +1032,8 @@ class ServingEngine:
         ``_grow_or_preempt`` as the cursor advances."""
         mgr = self.pool_mgr
         prefill_len = self._prefill_len(req)
-        needed = mgr.blocks_for_prefill(prefill_len) if self.growth \
+        needed = mgr.blocks_for_prefill(self._growth_admission_len(req)) \
+            if self.growth \
             else mgr.blocks_for(req.prompt_len, req.max_new_tokens)
         # the scheduler's can_admit reserved this; alloc may still evict
         self._unreserve(req)
@@ -996,8 +1055,9 @@ class ServingEngine:
             chain_key, np.float32(s.temperature), np.int32(s.top_k),
             np.float32(s.top_p), np.int32(-1 if eos is None else eos))
         mgr.bind_slot(slot, blocks,
-                      prefill_len if self.growth
+                      self._growth_admission_len(req) if self.growth
                       else req.prompt_len + req.max_new_tokens - 1)
+        req.kv_blocks_peak = max(req.kv_blocks_peak, len(blocks))
         mgr.register_prefix(req.prompt, blocks)
 
     def _decode_once(self, events):
@@ -1025,7 +1085,7 @@ class ServingEngine:
                 self.metrics.record_unhealthy()
                 self.tracer.instant(
                     "request/unhealthy", cat="serving", ts=now,
-                    request_id=req.request_id,
+                    request_id=req.request_id, trace_id=req.trace_id,
                     nonfinite_logits=int(nonfinite[slot]))
                 self._finish(req, FINISH_UNHEALTHY, now, deactivate=True)
                 events.append(TokenEvent(req.request_id, -1, len(req.tokens),
@@ -1033,6 +1093,7 @@ class ServingEngine:
                 continue
             req.tokens.append(t)
             self.metrics.record_tokens(1)
+            self.metrics.record_decode_tokens(1)
             if bool(done_now[slot]):
                 reason = FINISH_EOS if (req.eos_token_id is not None
                                         and t == req.eos_token_id) \
@@ -1073,9 +1134,27 @@ class ServingEngine:
                                                 np.int32(req.slot))
             req.slot = None
         self.metrics.record_finish(req)
+        start = req.start_time
+        # the per-request goodput/lifecycle rollup rides the finish instant
+        # verbatim, so the fleet merger's wide event needs no cross-stream
+        # reconstruction of engine-side counters. admit_wait splits the
+        # queue wait: arrival -> scheduler admission (waiting for a slot /
+        # KV blocks) vs admission -> prefill dispatch.
         self.tracer.instant("request/finish", cat="serving", ts=now,
-                            request_id=req.request_id, reason=reason,
-                            n_tokens=len(req.tokens))
+                            request_id=req.request_id,
+                            trace_id=req.trace_id, reason=reason,
+                            n_tokens=len(req.tokens),
+                            prompt_len=req.prompt_len,
+                            queue_wait=req.queue_wait,
+                            admit_wait=None
+                            if req.admit_time is None or start is None
+                            else req.admit_time - start,
+                            chunks=req.chunks,
+                            preemptions=req.preemptions,
+                            replay_tokens=req.replay_tokens,
+                            padding_tokens=req.padding_tokens,
+                            prefix_saved_tokens=req.prefix_saved_tokens,
+                            kv_blocks_peak=req.kv_blocks_peak)
 
     # ------------------------------------------------------------- frontends
     def serve(self, requests=None, yield_rejections=True):
@@ -1115,8 +1194,12 @@ class ServingEngine:
         finally:
             # a consumer that breaks mid-stream (GeneratorExit) or a step()
             # exception must still land the lifecycle events on disk — this
-            # is the only flush on the streaming path before destroy()
+            # is the only flush on the streaming path before destroy().
+            # The terminal metrics emit closes the rate-limited monitor
+            # cadence: short runs lose no tail interval (the Router does the
+            # same fleet-wide).
             self.tracer.flush()
+            self.metrics.emit_events()
 
     def run(self, requests):
         """Non-streaming convenience: serve ``requests`` to completion and

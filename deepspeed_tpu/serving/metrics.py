@@ -9,6 +9,7 @@ the load bench commits as its throughput–latency artifact.
 
 import collections
 
+from ..telemetry.digest import LatencyDigest, evaluate_slo
 from .request import FINISH_UNHEALTHY
 
 
@@ -21,9 +22,46 @@ def percentile(samples, q):
     return s[idx]
 
 
+def slo_digest_events(digests, goodput_frac, slo, step, tracer=None,
+                      counter=None):
+    """Digest-derived P99 + goodput scalars and the slo_violation event —
+    shared by the per-replica ServingMetrics cadence and the Router's
+    fleet-merged cadence (same names, different monitor). The emitted P99
+    IS the digest quantile: tier-1 pins it equal to the snapshot and to a
+    digest rebuilt from the merged trace. ``counter``: object whose
+    ``slo_violations`` tallies emit intervals with a violated target."""
+    events = []
+    for name, d in digests.items():
+        p99 = d.quantile_ms(99)
+        if p99 is not None:
+            events.append((f"Serving/{name}_p99_ms", p99, step))
+    events.append(("Serving/goodput_frac", float(goodput_frac), step))
+    targets = slo.targets_ms() if slo is not None else {}
+    grade = evaluate_slo(targets, digests)
+    if grade["configured"]:
+        burn = max(grade["burn_rate"].values(), default=0.0)
+        events.append(("Serving/slo_burn_rate", burn, step))
+        if not grade["pass"]:
+            if counter is not None:
+                counter.slo_violations += 1
+            if tracer is not None:
+                for metric, bad in grade["violated"].items():
+                    if not bad:
+                        continue
+                    tracer.instant(
+                        "slo/violation", cat="serving", metric=metric,
+                        observed_p99_ms=grade["observed_p99_ms"][metric],
+                        target_ms=grade["targets_ms"][metric],
+                        burn_rate=grade["burn_rate"][metric])
+        if counter is not None:
+            events.append(("Serving/slo_violations",
+                           float(counter.slo_violations), step))
+    return events
+
+
 class ServingMetrics:
     def __init__(self, n_slots, clock, monitor=None, interval=32,
-                 kv_pool=None):
+                 kv_pool=None, slo=None, tracer=None):
         self.n_slots = n_slots
         self.clock = clock
         self.monitor = monitor
@@ -56,6 +94,31 @@ class ServingMetrics:
         # on-demand growth: requests preempted back to the queue on pool
         # exhaustion (they resume; NOT part of the shed/finished partition)
         self.preempted = 0
+        # streaming SLO percentiles: mergeable fixed-bucket digests next to
+        # the exact sample lists (the lists stay the PR 4 trace==metrics
+        # currency; the digests are what rolls up across replicas and what
+        # the Serving/*_p99_ms events and serving.slo grading read)
+        self.ttft_digest = LatencyDigest()
+        self.tpot_digest = LatencyDigest()
+        self.queue_wait_digest = LatencyDigest()
+        # serving.slo block (None/unarmed = no objectives) + the tracer the
+        # structured slo/violation events ride (set by the engine after its
+        # tracer exists)
+        self.slo = slo
+        self.tracer = tracer
+        self.slo_violations = 0   # emit intervals with >=1 violated target
+        self.window_resets = 0    # reset_window() calls (warmup exclusion)
+        # goodput accounting, in DEVICE TOKENS of work (the virtual cost
+        # model's currency: one prefill dispatch costs its padded length,
+        # one decode step yields one token per active slot). useful = fresh
+        # prefill positions + decode tokens; wasted = preemption replay +
+        # bucket padding; prefix-cache savings are work NEVER dispatched
+        # (reported, not part of the frac).
+        self.prefill_device_tokens = 0
+        self.replay_tokens = 0
+        self.padding_tokens = 0
+        self.prefix_saved_tokens = 0
+        self.decode_tokens = 0
 
     # -- recording ----------------------------------------------------------
     def _mark_started(self):
@@ -66,11 +129,27 @@ class ServingMetrics:
             self._started = True
 
     def reset_window(self):
-        """Re-open the throughput window (e.g. after a warmup run): tokens/s
-        reflects tokens since this call. Cumulative counters are kept."""
+        """Re-open the measured window (e.g. after a warmup run): tokens/s
+        reflects tokens since this call, and the streaming latency digests
+        + goodput counters restart — a warmup's compile-time TTFTs would
+        otherwise sit in the SLO grade forever (digests cannot age samples
+        out). Cumulative counters (submitted/finished/shed/samples) keep
+        the engine's lifetime story."""
         self.start_time = self.clock.now()
         self._started = True
         self._window_tokens = 0
+        self.ttft_digest = LatencyDigest()
+        self.tpot_digest = LatencyDigest()
+        self.queue_wait_digest = LatencyDigest()
+        self.prefill_device_tokens = 0
+        self.replay_tokens = 0
+        self.padding_tokens = 0
+        self.prefix_saved_tokens = 0
+        self.decode_tokens = 0
+        # recorded so trace readers know the live digests no longer cover
+        # the whole trace (fleet_report downgrades its digest-coherence
+        # gate to informational when a reset happened mid-run)
+        self.window_resets += 1
 
     def record_submit(self):
         self._mark_started()
@@ -87,6 +166,8 @@ class ServingMetrics:
     def record_first_token(self, request):
         if request.ttft is not None:
             self.ttft_samples.append(request.ttft)
+            self.ttft_digest.add(request.ttft)
+            request.ttft_epoch = self.window_resets
 
     def record_finish(self, request):
         if request.finish_reason == FINISH_UNHEALTHY:
@@ -94,15 +175,47 @@ class ServingMetrics:
             # as finished (the shed/finished split partitions offered
             # requests) and its latency samples are poison — including the
             # TTFT recorded at first-token time, before the poisoning showed
+            # the wide-event partition excludes unhealthy requests from
+            # EVERY latency field — the live digests must match or the
+            # trace==digest coherence gate false-alarms. Epoch guards: a
+            # sample recorded BEFORE a reset_window() lives in a discarded
+            # digest; retracting it from the fresh one would decrement a
+            # different (healthy) request's same-bucket sample instead.
             if request.ttft is not None:
                 try:
                     self.ttft_samples.remove(request.ttft)
                 except ValueError:
                     pass
+                if request.ttft_epoch == self.window_resets:
+                    self.ttft_digest.remove(request.ttft)
+            if request.queue_wait is not None \
+                    and request.queue_wait_epoch == self.window_resets:
+                self.queue_wait_digest.remove(request.queue_wait)
             return
         self.finished += 1
         if request.tpot is not None:
             self.tpot_samples.append(request.tpot)
+            self.tpot_digest.add(request.tpot)
+
+    def record_queue_wait(self, request):
+        """Arrival -> first prefill dispatch (recorded once per request, at
+        its FIRST start; preemption resumes don't reopen the window)."""
+        if request.queue_wait is not None:
+            self.queue_wait_digest.add(request.queue_wait)
+            request.queue_wait_epoch = self.window_resets
+
+    def record_prefill_work(self, padded_len, true_len, replay=0):
+        """One prefill dispatch: ``padded_len`` device tokens paid, of which
+        ``true_len`` were real positions (``replay`` of those re-computing
+        work a preemption threw away) and the rest bucket padding.
+        (``prefix_saved_tokens`` is bumped at the hit site — it is work
+        never dispatched, so it has no padded/true split.)"""
+        self.prefill_device_tokens += int(padded_len)
+        self.padding_tokens += int(padded_len) - int(true_len)
+        self.replay_tokens += int(replay)
+
+    def record_decode_tokens(self, n):
+        self.decode_tokens += int(n)
 
     def record_health_step(self, n_bad_slots):
         """Once per decode step (or poisoned prefill): how many ACTIVE
@@ -141,6 +254,39 @@ class ServingMetrics:
         return sum(self.shed.values())
 
     @property
+    def goodput_frac(self):
+        """Useful device tokens / total device tokens. Useful = fresh
+        prefill positions + decode tokens; wasted = preemption replay +
+        prefill bucket padding. 1.0 before any work."""
+        total = self.prefill_device_tokens + self.decode_tokens
+        if total == 0:
+            return 1.0
+        useful = total - self.replay_tokens - self.padding_tokens
+        return useful / total
+
+    def goodput_snapshot(self):
+        return {
+            "prefill_device_tokens": self.prefill_device_tokens,
+            "decode_tokens": self.decode_tokens,
+            "replay_tokens": self.replay_tokens,
+            "padding_tokens": self.padding_tokens,
+            "prefix_saved_tokens": self.prefix_saved_tokens,
+            "wasted_tokens": self.replay_tokens + self.padding_tokens,
+            "goodput_frac": round(self.goodput_frac, 4),
+        }
+
+    def latency_digests(self):
+        """The metric->digest map evaluate_slo and the fleet rollup read."""
+        return {"ttft": self.ttft_digest, "tpot": self.tpot_digest,
+                "queue_wait": self.queue_wait_digest}
+
+    def slo_eval(self):
+        """Grade the digests against serving.slo (configured: False block
+        when no slo config / no targets)."""
+        targets = self.slo.targets_ms() if self.slo is not None else {}
+        return evaluate_slo(targets, self.latency_digests())
+
+    @property
     def shed_rate(self):
         # offered = admitted + admission-time sheds; unhealthy_slot sheds
         # were ALREADY admitted (counted in submitted), so they move a
@@ -165,6 +311,13 @@ class ServingMetrics:
                 "p50": to_ms(percentile(self.tpot_samples, 50)),
                 "p99": to_ms(percentile(self.tpot_samples, 99)),
             },
+            # streaming-digest percentiles (mergeable across replicas; the
+            # SAME numbers the Serving/*_p99_ms events and slo grade carry)
+            "percentiles": {
+                name + "_ms": d.percentiles_ms()
+                for name, d in self.latency_digests().items()},
+            "goodput": self.goodput_snapshot(),
+            "slo": self.slo_eval(),
             "steps": self.steps,
             "queue_depth": self._queue_depth,
             "slot_occupancy": self._active_slots / max(self.n_slots, 1),
@@ -213,4 +366,7 @@ class ServingMetrics:
         p50t = percentile(self.tpot_samples, 50)
         if p50t is not None:
             events.append(("Serving/tpot_ms", p50t * 1e3, self.steps))
+        events.extend(slo_digest_events(
+            self.latency_digests(), self.goodput_frac, self.slo, self.steps,
+            tracer=self.tracer, counter=self))
         self.monitor.write_events(events)

@@ -66,6 +66,10 @@ class Request:
     # router session affinity: requests sharing a session_id stick to one
     # replica (None = stateless, routed purely on load/prefix affinity)
     session_id: typing.Optional[str] = None
+    # cross-replica trace id: every span/instant this request produces on
+    # any replica carries it, so the fleet merger can stitch one lifecycle
+    # from N per-replica streams (assigned at router/engine submit if None)
+    trace_id: typing.Optional[str] = None
 
     # -- scheduler-owned runtime fields -------------------------------------
     state: RequestState = RequestState.QUEUED
@@ -87,6 +91,26 @@ class Request:
     # first slot-bind order (preemption victim = newest; a resumed request
     # keeps its original seniority)
     admit_seq: int = -1
+    # scheduler admission time (next_admissions stamp) and first prefill
+    # dispatch time — queue_wait's endpoint; survives preemption (a resume
+    # replay does not reopen the queue-wait window)
+    admit_time: typing.Optional[float] = None
+    prefill_start_time: typing.Optional[float] = None
+    # digest window epochs: ServingMetrics.window_resets at the moment each
+    # latency sample was recorded, so an unhealthy-shed retraction after a
+    # reset_window() cannot decrement a fresh digest's (different) sample
+    ttft_epoch: int = -1
+    queue_wait_epoch: int = -1
+    # goodput accounting (summed into ServingMetrics.goodput, emitted in
+    # the request/finish instant so the wide event carries them verbatim):
+    # positions re-prefilled after a preemption, prefill bucket padding
+    # beyond the true token count, positions skipped via prefix-cache hits,
+    # prefill chunk dispatches, and the KV-block high-water mark
+    replay_tokens: int = 0
+    padding_tokens: int = 0
+    prefix_saved_tokens: int = 0
+    chunks: int = 0
+    kv_blocks_peak: int = 0
 
     def __post_init__(self):
         self.prompt = np.asarray(self.prompt, np.int32).reshape(-1)
@@ -100,14 +124,28 @@ class Request:
         return int(self.prompt.shape[0])
 
     @property
+    def start_time(self):
+        """The latency zero point every per-request metric shares: resolved
+        arrival if the request carried one, else submit time."""
+        return self.arrival_time if self.arrival_time is not None \
+            else self.submit_time
+
+    @property
     def ttft(self):
         """Time from arrival (resolved by serve()) or submit to first token —
         queueing delay counts, as a serving frontend's user would see it."""
         if self.first_token_time is None:
             return None
-        start = self.arrival_time if self.arrival_time is not None \
-            else self.submit_time
-        return self.first_token_time - start
+        return self.first_token_time - self.start_time
+
+    @property
+    def queue_wait(self):
+        """Arrival (or submit) to the first prefill dispatch — the pure
+        queueing component of TTFT (TTFT = queue_wait + prefill +
+        first-token sample, all on the scheduler clock)."""
+        if self.prefill_start_time is None:
+            return None
+        return self.prefill_start_time - self.start_time
 
     @property
     def tpot(self):

@@ -31,10 +31,12 @@ one process.
 """
 
 import collections
+import os
 
+from ..telemetry.digest import LatencyDigest, evaluate_slo
 from .clock import VirtualClock
 from .kv_pool import prefix_chain_keys
-from .metrics import percentile
+from .metrics import percentile, slo_digest_events
 from .request import (REJECT_ALL_REPLICAS_SATURATED, RequestState, TokenEvent,
                       as_request)
 
@@ -92,12 +94,45 @@ class RouterMetrics:
         self.rejoins = 0
         self.per_replica_routed = collections.Counter()
         self._events_emitted = 0
+        # fleet-level SLO bookkeeping (emit intervals with >=1 violated
+        # target, mirroring ServingMetrics.slo_violations per replica)
+        self.slo_violations = 0
 
     @property
     def affinity_hit_rate(self):
         """Prefix-affinity hit rate: routed-by-prefix / prefix lookups."""
         return self.prefix_hits / self.prefix_lookups \
             if self.prefix_lookups else 0.0
+
+    # ------------------------------------------------- fleet-merged rollups
+    def fleet_digests(self):
+        """Fleet latency digests: the EXACT merge of every replica's
+        (integer bucket addition — associative, so the fleet percentile is
+        independent of replica count and merge order)."""
+        reps = self._router._replicas
+        return {name: LatencyDigest.merged(
+            [r.sv.metrics.latency_digests()[name] for r in reps])
+            for name in ("ttft", "tpot", "queue_wait")}
+
+    def fleet_goodput(self):
+        """Fleet goodput: replica token counters summed (same currency)."""
+        reps = self._router._replicas
+        keys = ("prefill_device_tokens", "decode_tokens", "replay_tokens",
+                "padding_tokens", "prefix_saved_tokens")
+        tot = {k: sum(getattr(r.sv.metrics, k) for r in reps) for k in keys}
+        total = tot["prefill_device_tokens"] + tot["decode_tokens"]
+        wasted = tot["replay_tokens"] + tot["padding_tokens"]
+        tot["wasted_tokens"] = wasted
+        tot["goodput_frac"] = round((total - wasted) / total, 4) \
+            if total else 1.0
+        return tot
+
+    def fleet_slo(self, digests=None):
+        """``digests``: pass an already-merged ``fleet_digests()`` result to
+        avoid re-merging (snapshot() runs on per-replica hooks)."""
+        return evaluate_slo(
+            self._router._slo.targets_ms() if self._router._slo is not None
+            else {}, digests if digests is not None else self.fleet_digests())
 
     def snapshot(self):
         reps = self._router._replicas
@@ -153,13 +188,21 @@ class RouterMetrics:
         for i, occ in enumerate(snap["per_replica_occupancy"]):
             events.append((f"Serving/router_r{i}_occupancy", float(occ),
                            step))
+        # fleet-merged digest P99s / goodput / SLO grade, same event names
+        # as the per-replica cadence (this monitor sees the FLEET numbers —
+        # the acceptance pin reads Serving/ttft_p99_ms here)
+        goodput = self.fleet_goodput()
+        events.extend(slo_digest_events(
+            self.fleet_digests(), goodput["goodput_frac"],
+            self._router._slo, step, tracer=self._router.tracer,
+            counter=self))
         self.monitor.write_events(events)
 
 
 class Router:
     """Load-aware dispatcher over N ``ServingEngine`` replicas."""
 
-    def __init__(self, replicas, config=None, monitor=None):
+    def __init__(self, replicas, config=None, monitor=None, tracer=None):
         if not replicas:
             raise ValueError("Router needs at least one replica")
         self.cfg = config if config is not None else replicas[0].cfg.router
@@ -168,11 +211,114 @@ class Router:
         self._prefix_index = collections.OrderedDict()  # chain key -> idx
         self._rr_next = 0
         self._next_id = 0
+        # fleet SLO targets: the serving.slo block (homogeneous fleet — the
+        # first replica's config speaks for all, like cfg.router above)
+        self._slo = replicas[0].cfg.slo
         self.metrics = RouterMetrics(self, monitor=monitor)
+        self.tracer, self._fleet_dir = self._setup_tracing(tracer)
+        self._rehome_replica_monitors()
         for rep in self._replicas:
             # per-replica snapshots gain the cross-replica view (coherent
             # with the Serving/router_* events, asserted tier-1)
             rep.sv.metrics.router = self.metrics.snapshot
+
+    def _setup_tracing(self, tracer):
+        """Arm fleet tracing when the replicas trace. Replicas built from
+        one shared telemetry config all point at the SAME output dir (their
+        flushes would clobber each other) — re-home each to
+        ``<base>/replica<i>``, put the router's own decision stream at
+        ``<base>/router``, and reserve ``<base>`` itself for the MERGED
+        fleet files (trace.json / spans.jsonl / requests.jsonl /
+        fleet.json, written by ``write_fleet_trace``). Replicas the caller
+        pointed at DISTINCT dirs are deliberate — leave them untouched and
+        skip the automatic fleet write (``write_fleet_trace(output_dir)``
+        still merges on demand)."""
+        from ..telemetry import SpanTracer
+
+        dirs = [r.sv.tracer.output_dir for r in self._replicas
+                if r.sv.tracer.enabled and r.sv.tracer.output_dir]
+        # the fleet base (merged files + auto write) requires the common
+        # shared-config case: every enabled tracer on ONE dir. Mixed
+        # configs still get COLLIDING groups re-homed (same-path flushes
+        # truncate each other) — just no automatic fleet dir.
+        base = dirs[0] if dirs and len(set(dirs)) == 1 else None
+        by_dir = {}
+        for i, rep in enumerate(self._replicas):
+            t = rep.sv.tracer
+            if t.enabled and t.output_dir:
+                by_dir.setdefault(t.output_dir, []).append((i, rep))
+        for d, group in by_dir.items():
+            if len(group) < 2 and base is None:
+                continue  # unique dir in a mixed config: deliberate
+            for i, rep in group:
+                rep.sv.tracer.output_dir = os.path.join(d, f"replica{i}")
+        if tracer is None:
+            # the router's clock is the fleet frontier: route decisions
+            # happen at the newest clock any replica has reached
+            tracer = SpanTracer(
+                enabled=bool(dirs), clock=self._frontier,
+                output_path=base or "", job_name="router",
+                chrome_trace=False, meta={"process": "router"})
+        return tracer, base
+
+    def _frontier(self):
+        return max(r.sv.clock.now() for r in self._replicas)
+
+    def _rehome_replica_monitors(self):
+        """N replicas auto-built from ONE shared engine config each carry
+        their own MonitorMaster over the SAME file paths: their Serving/*
+        series would interleave in one CSV / scalars.jsonl with duplicate
+        step counters. Re-home colliding file-backed backends to
+        ``<path>/replica<i>`` (mirroring the tracer re-homing); writer-
+        holding backends (TensorBoard/W&B) cannot be re-pointed — warn
+        once. Distinct monitor OBJECTS only: a single master deliberately
+        shared across replicas is left alone."""
+        from ..monitor.monitor import CSVMonitor, TraceFileMonitor
+        from ..utils.logging import logger
+
+        by_path = {}
+        unmovable = collections.Counter()
+        for i, rep in enumerate(self._replicas):
+            m = rep.sv.metrics.monitor
+            for b in getattr(m, "backends", []):
+                if not b.enabled:
+                    continue
+                if isinstance(b, CSVMonitor) and b.output_path:
+                    by_path.setdefault(("csv", b.output_path), {})[id(b)] = \
+                        (i, b)
+                elif isinstance(b, TraceFileMonitor) and b.path:
+                    by_path.setdefault(("scalars", b.path), {})[id(b)] = \
+                        (i, b)
+                elif type(b).__name__ in ("TensorBoardMonitor",
+                                          "WandbMonitor"):
+                    # writer-holding backends can't be re-pointed; a real
+                    # collision means replicas share ONE engine config
+                    # (deliberately-distinct configs don't warn)
+                    unmovable[(type(b).__name__,
+                               id(rep.sv.engine.config))] += 1
+        for (kind, path), items in by_path.items():
+            if len(items) < 2:
+                continue
+            for i, b in items.values():
+                if kind == "csv":
+                    b.output_path = os.path.join(path, f"replica{i}")
+                    os.makedirs(b.output_path, exist_ok=True)
+                else:
+                    d = os.path.join(os.path.dirname(path), f"replica{i}")
+                    os.makedirs(d, exist_ok=True)
+                    b.path = os.path.join(d, "scalars.jsonl")
+                    # fresh run, fresh stream (write_events appends): a
+                    # rerun into the same dir must not concatenate two
+                    # runs' series — TraceFileMonitor.__init__ truncates
+                    # its original path for exactly this reason
+                    open(b.path, "w").close()
+        shared = max(unmovable.values(), default=0)
+        if shared > 1:
+            logger.warning(
+                "Router: %d replicas write TensorBoard/W&B streams from one "
+                "shared config; their Serving/* series will interleave — "
+                "give replicas distinct job names or monitor at the router "
+                "only", shared)
 
     # ------------------------------------------------------------- dispatch
     def submit(self, request):
@@ -189,14 +335,29 @@ class Router:
             # router-global ids: replicas must not hand out colliding ones
             req.request_id = self._next_id
             self._next_id += 1
+        if req.trace_id is None:
+            # fleet-global trace id: every span/instant on every replica
+            # inherits it, so the merger stitches one cross-replica journey
+            req.trace_id = f"req-{req.request_id:06d}"
+        now = req.arrival_time if req.arrival_resolved else self._frontier()
         live = [i for i, r in enumerate(self._replicas)
                 if not r.draining and not r.saturated]
         if not live:
             req.state = RequestState.REJECTED
             req.reject_reason = REJECT_ALL_REPLICAS_SATURATED
             self.metrics.shed_saturated += 1
+            self.tracer.instant("route/shed", cat="router", ts=now,
+                                request_id=req.request_id,
+                                trace_id=req.trace_id,
+                                reason=REJECT_ALL_REPLICAS_SATURATED)
             return req
-        idx = self._route(req, live)
+        idx, decision = self._route(req, live)
+        # the route/decision instant: full score breakdown + why this
+        # replica — the wide event's "routing" block, recorded BEFORE the
+        # replica touches the request so a replica-side shed still has it
+        self.tracer.instant("route/decision", cat="router", ts=now,
+                            request_id=req.request_id,
+                            trace_id=req.trace_id, replica=idx, **decision)
         self._replicas[idx].sv.submit(req)
         if req.state is RequestState.REJECTED:
             # request-intrinsic shed (prompt_too_long / no_free_blocks):
@@ -212,8 +373,16 @@ class Router:
 
     def _route(self, req, live):
         """Pick a replica index from ``live``: affinity target if healthy,
-        else the load-policy choice (overriding affinity = a rebalance)."""
+        else the load-policy choice (overriding affinity = a rebalance).
+        Returns ``(index, decision)`` — the decision dict is the
+        ``route/decision`` instant's score breakdown (per-replica load
+        scores, affinity kind honored, rebalance flag), i.e. WHY this
+        replica, postmortem-readable."""
         scores = {i: self._replicas[i].load_score(self.cfg) for i in live}
+        decision = {"policy": self.cfg.policy,
+                    "scores": {str(i): round(s, 6)
+                               for i, s in scores.items()},
+                    "affinity": None, "rebalanced": False}
         if self.cfg.policy == "round_robin":
             # round_robin ignores load AND affinity (no lookups, no hit
             # counting) — it is the baseline the affinity/load policies are
@@ -222,8 +391,8 @@ class Router:
                 cand = self._rr_next % len(self._replicas)
                 self._rr_next += 1
                 if cand in scores:
-                    return cand
-            return live[0]
+                    return cand, decision
+            return live[0], decision
         target = kind = None
         if self.cfg.session_affinity and req.session_id is not None:
             t = self._sessions.get(req.session_id)
@@ -242,10 +411,13 @@ class Router:
                     self.metrics.session_hits += 1
                 else:
                     self.metrics.prefix_hits += 1
-                return target
+                decision["affinity"] = kind
+                return target, decision
             # affinity would pile onto an overloaded replica: rebalance
             self.metrics.rebalances += 1
-        return best
+            decision["rebalanced"] = True
+            decision["affinity_overridden"] = kind
+        return best, decision
 
     def _prefix_lookup(self, req, scores):
         """Longest prefix-chain-key hit among live replicas (the paged
@@ -380,8 +552,18 @@ class Router:
                             yield ev
                 self.metrics.maybe_emit()
         finally:
+            # serve() completing (or dying) is the fleet's terminal edge:
+            # flush EVERY tracer (replica tail spans would otherwise only
+            # land at destroy()) and force one final metrics interval —
+            # the rate-limited maybe_emit cadence must not swallow a short
+            # run's only (or last) window of events
             for rep in self._replicas:
                 rep.sv.tracer.flush()
+                rep.sv.metrics.emit_events()
+            self.metrics.emit_events()
+            self.tracer.flush()
+            if self._fleet_dir is not None:
+                self.write_fleet_trace()
 
     def _dispatch(self, req, yield_rejections):
         # an idle target's clock may lag the arrival: idle time passes
@@ -429,6 +611,7 @@ class Router:
         tpot = [s for r in self._replicas
                 for s in r.sv.metrics.tpot_samples]
         to_ms = lambda v: None if v is None else v * 1e3
+        digests = self.metrics.fleet_digests()
         return {
             "router": self.metrics.snapshot(),
             "replicas": reps,
@@ -438,12 +621,43 @@ class Router:
                         "p99": to_ms(percentile(ttft, 99))},
             "tpot_ms": {"p50": to_ms(percentile(tpot, 50)),
                         "p99": to_ms(percentile(tpot, 99))},
+            # fleet-merged streaming digests: percentile rollup + the raw
+            # bucket snapshots (so fleet.json readers can rebuild and
+            # compare digests exactly), the SLO grade, goodput accounting
+            "percentiles": {name + "_ms": d.percentiles_ms()
+                            for name, d in digests.items()},
+            "digests": {name: d.snapshot() for name, d in digests.items()},
+            "slo": self.metrics.fleet_slo(digests),
+            "goodput": self.metrics.fleet_goodput(),
+            # >0 means the live digests were restarted mid-run (warmup
+            # exclusion) and no longer cover the whole trace
+            "window_resets": sum(r.sv.metrics.window_resets
+                                 for r in self._replicas),
             "makespan": max(r.sv.clock.now() for r in self._replicas),
         }
+
+    def write_fleet_trace(self, output_dir=None):
+        """Merge the router + per-replica span streams into the fleet dir
+        (``telemetry/fleet.py``): Chrome ``trace.json`` with one process
+        lane per source, merged ``spans.jsonl``, per-request wide events
+        (``requests.jsonl``) and the live ``fleet.json`` rollup. Defaults
+        to the telemetry base dir the replicas were re-homed under."""
+        out = output_dir if output_dir is not None else self._fleet_dir
+        if out is None:
+            raise ValueError(
+                "no fleet output dir: enable telemetry on the replicas or "
+                "pass output_dir")
+        from ..telemetry.fleet import write_fleet_trace
+
+        sources = [("router", self.tracer.events)]
+        sources += [(f"replica{i}", rep.sv.tracer.events)
+                    for i, rep in enumerate(self._replicas)]
+        return write_fleet_trace(out, sources, fleet=self.snapshot())
 
     def compile_counts(self):
         return [r.sv.compile_counts() for r in self._replicas]
 
     def destroy(self):
+        self.tracer.flush()
         for rep in self._replicas:
             rep.sv.destroy()

@@ -59,12 +59,18 @@ class ServingScheduler:
                 out.append(bypassed)
                 budget -= 1
                 continue
-            if self._hol_head is head.request_id:
+            if self._hol_head == head.request_id:
                 # the stuck head finally fits: its starvation window closes
                 self._hol_head = None
                 self._hol_bypasses = 0
             out.append(self.queue.pop())
             budget -= 1
+        for req in out:
+            # admission stamp for the wide-event queue-wait breakdown; a
+            # preemption-resume re-admission keeps the ORIGINAL stamp (its
+            # queue-wait window closed at the first prefill)
+            if req.admit_time is None:
+                req.admit_time = now
         return out
 
     def _try_bypass(self, now, can_admit):

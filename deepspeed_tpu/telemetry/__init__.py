@@ -9,6 +9,10 @@ checkpoint save/resume; analyzed by ``tools/trace_summary.py``.
 
 from .analysis import (counters_by_step, load_jsonl, phase_table,
                        request_metrics)
+from .digest import LatencyDigest, evaluate_slo
+from .fleet import (build_wide_events, digest_from_wide_events,
+                    fleet_chrome_trace, latency_rollup, load_wide_events,
+                    merge_fleet_events, slowest_requests, write_fleet_trace)
 from .health import (HEALTH_STAT_KEYS, HealthHalted, HealthMonitor,
                      batch_fingerprint, derive_group_names,
                      group_health_stats, load_dump, record_from_stats,
@@ -17,6 +21,16 @@ from .tracer import SpanTracer
 
 __all__ = [
     "SpanTracer",
+    "LatencyDigest",
+    "evaluate_slo",
+    "merge_fleet_events",
+    "fleet_chrome_trace",
+    "build_wide_events",
+    "digest_from_wide_events",
+    "load_wide_events",
+    "latency_rollup",
+    "slowest_requests",
+    "write_fleet_trace",
     "load_jsonl",
     "request_metrics",
     "phase_table",

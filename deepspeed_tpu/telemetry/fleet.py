@@ -1,0 +1,275 @@
+"""Fleet trace merging: N per-replica span streams -> one trace + wide events.
+
+The Router runs N ServingEngine replicas, each tracing its own request
+lifecycle against its own (virtual or wall) clock, plus the router's own
+``route/decision`` stream. This module aligns them into:
+
+- **fleet trace.json** — one Chrome-trace file with one *process* row per
+  source (router + each replica), loadable in Perfetto: the cross-replica
+  request journey reads left to right on one shared timeline. Under virtual
+  clocks the per-replica streams are already on one timeline (the router's
+  discrete-event loop aligns their zero and steps the laggard), so merging
+  is a sort, not a re-clocking.
+- **merged spans.jsonl** — every event from every source, tagged with its
+  ``replica`` label, time-ordered (the ``tools/trace_summary.py`` fleet
+  input).
+- **requests.jsonl** — one postmortem-grade WIDE EVENT per request that
+  entered the fleet: the routing decision (score breakdown, affinity,
+  rebalance), lifecycle timing (queue-wait/TTFT/TPOT and the
+  queue/prefill/decode/preemption breakdown), chunk count, preemptions and
+  replay tokens, KV-block high-water — everything "where did this
+  request's latency go" needs, in one JSON object.
+
+Wide-event TTFT/TPOT carry the exact contracts of ``Request.ttft``/
+``.tpot`` (PR 4 pins trace == metrics under the virtual clock), so a
+``LatencyDigest`` rebuilt from requests.jsonl is bucket-identical to the
+live fleet digest — the tier-1 trace == digest == monitor-event pin.
+"""
+
+import json
+import os
+
+from .digest import LatencyDigest
+from .tracer import event_to_chrome
+
+# request lifecycle + routing instants the wide-event builder consumes
+_LIFECYCLE = ("route/decision", "route/shed", "request/queued",
+              "request/shed", "request/first_token", "request/preempted",
+              "request/resumed", "request/unhealthy", "request/finish")
+
+
+def merge_fleet_events(sources):
+    """``sources``: list of ``(label, events)`` (a SpanTracer's in-memory
+    event dicts, or events loaded from its spans.jsonl). Returns one
+    time-ordered stream, each event copied and tagged ``replica=<label>``
+    (ties broken by source order then per-source sequence, so the merge is
+    deterministic)."""
+    merged = []
+    for si, (label, events) in enumerate(sources):
+        for e in events:
+            ev = dict(e)
+            ev["replica"] = label
+            merged.append((float(e.get("ts", 0.0)), si,
+                           int(e.get("seq", 0)), ev))
+    merged.sort(key=lambda t: t[:3])
+    return [m[3] for m in merged]
+
+
+def fleet_chrome_trace(sources, meta=None):
+    """Chrome Trace Event Format over every source: pid = source index,
+    process_name = the source label (Perfetto shows one lane per replica)."""
+    out = []
+    for pid, (label, events) in enumerate(sources):
+        out.append({"ph": "M", "pid": pid, "tid": 0, "name": "process_name",
+                    "args": {"name": str(label)}})
+        out.extend(event_to_chrome(e, pid=pid) for e in events)
+    return {"traceEvents": out, "displayTimeUnit": "ms",
+            "otherData": dict(meta or {}, merged_sources=[
+                str(label) for label, _ in sources])}
+
+
+def build_wide_events(merged_events):
+    """Per-request wide events from a merged fleet stream.
+
+    Returns ``{request_id: wide_event_dict}``. Timing fields are in clock
+    units (multiply by 1e3 for the ms display convention); goodput fields
+    (replay/padding/prefix-saved tokens, chunks, kv_blocks_peak) come
+    verbatim from the engine's ``request/finish`` args — the merger
+    reconstructs the journey, never re-derives engine counters."""
+    reqs = {}
+
+    def rec(rid):
+        return reqs.setdefault(rid, {
+            "request_id": rid, "trace_id": None, "state": None,
+            "replica": None, "routing": None, "shed_reason": None,
+            "finish_reason": None, "prompt_len": None, "n_tokens": None,
+            "chunks": 0, "preemptions": 0, "replay_tokens": 0,
+            "padding_tokens": 0, "prefix_saved_tokens": 0,
+            "kv_blocks_peak": 0, "queue_wait": None, "admit_wait": None,
+            "ttft": None,
+            "tpot": None, "breakdown": None,
+            "_start": None, "_first": None, "_finish": None,
+            "_prefill_dur": 0.0, "_prefill_ts": [],
+            "_preempt_ts": [], "_resume_ts": [],
+        })
+
+    for e in merged_events:
+        args = e.get("args", {})
+        rid = args.get("request_id")
+        if rid is None:
+            continue
+        name = e.get("name", "")
+        if e.get("ph") == "X":
+            if name in ("prefill", "prefill_chunk"):
+                r = rec(rid)
+                r["_prefill_ts"].append(e["ts"])
+                # resume-replay chunks run INSIDE the preempted->resumed
+                # stall window: their time is already attributed to
+                # "preempted", and counting it here too would break the
+                # breakdown's partition of finish - start
+                if not args.get("resume"):
+                    r["_prefill_dur"] += e.get("dur", 0.0)
+            continue
+        if name not in _LIFECYCLE:
+            continue
+        r = rec(rid)
+        if args.get("trace_id") is not None:
+            r["trace_id"] = args["trace_id"]
+        if name == "route/decision":
+            r["routing"] = {k: args.get(k) for k in
+                            ("replica", "scores", "affinity", "rebalanced",
+                             "policy")}
+        elif name in ("route/shed", "request/shed"):
+            r["state"] = "shed"
+            r["shed_reason"] = args.get("reason")
+        elif name == "request/queued":
+            r["_start"] = args.get("start", e["ts"])
+            r["prompt_len"] = args.get("prompt_len")
+            r["replica"] = e.get("replica")
+        elif name == "request/first_token":
+            r["_first"] = e["ts"]
+        elif name == "request/preempted":
+            r["_preempt_ts"].append(e["ts"])
+        elif name == "request/resumed":
+            r["_resume_ts"].append(e["ts"])
+        elif name == "request/finish":
+            r["state"] = "finished"
+            r["_finish"] = e["ts"]
+            r["replica"] = e.get("replica", r["replica"])
+            for k in ("finish_reason", "n_tokens", "prompt_len",
+                      "queue_wait", "admit_wait", "chunks", "preemptions",
+                      "replay_tokens", "padding_tokens",
+                      "prefix_saved_tokens", "kv_blocks_peak"):
+                src = "reason" if k == "finish_reason" else k
+                if args.get(src) is not None:
+                    r[k] = args[src]
+
+    for r in reqs.values():
+        start, first = r.pop("_start"), r.pop("_first")
+        finish = r.pop("_finish")
+        prefill_ts = r.pop("_prefill_ts")
+        prefill_dur = r.pop("_prefill_dur")
+        pre, res = r.pop("_preempt_ts"), r.pop("_resume_ts")
+        if first is not None and start is not None:
+            r["ttft"] = first - start
+        if finish is not None and first is not None \
+                and (r["n_tokens"] or 0) >= 2:
+            r["tpot"] = (finish - first) / (r["n_tokens"] - 1)
+        if r["queue_wait"] is None and prefill_ts and start is not None:
+            r["queue_wait"] = min(prefill_ts) - start
+        # preemption stall: preempted -> resumed windows (the resume replay
+        # prefill runs inside the window; an unresumed tail is open-ended
+        # and attributed up to finish)
+        stall = sum(b - a for a, b in zip(pre, res))
+        if len(pre) > len(res) and finish is not None:
+            stall += finish - pre[len(res)]
+        r["start"], r["finish"] = start, finish
+        if finish is not None and start is not None:
+            r["breakdown"] = {
+                "queue_wait": r["queue_wait"] or 0.0,
+                "prefill": prefill_dur,
+                "preempted": stall,
+                # elapsed decode attribution (co-batched wall share):
+                # first token -> finish, minus preemption stalls
+                "decode": max((finish - (first if first is not None
+                                         else start)) - stall, 0.0),
+            }
+    return reqs
+
+
+def load_wide_events(path):
+    """Wide events from a fleet dir's ``requests.jsonl`` (or a bare file)
+    -> ``{request_id: wide_event}``. The one parser every consumer
+    (``tools/fleet_report.py``, ``tools/trace_summary.py``, tests) shares."""
+    if os.path.isdir(path):
+        path = os.path.join(path, "requests.jsonl")
+    wide = {}
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                r = json.loads(line)
+                wide[r["request_id"]] = r
+    return wide
+
+
+def digest_from_wide_events(wide_events, field="ttft"):
+    """Rebuild a ``LatencyDigest`` from wide events, under the SAME
+    partition the live metrics enforce (unhealthy sheds' latencies are
+    poison and were retracted live; router/queue sheds never had one)."""
+    d = LatencyDigest()
+    for r in wide_events.values():
+        if r.get("finish_reason") == "unhealthy_slot":
+            continue
+        v = r.get(field)
+        if v is not None:
+            d.add(v)
+    return d
+
+
+def latency_rollup(wide_events):
+    """Aggregate latency attribution over finished requests (clock units):
+    where the fleet's time went — queue wait vs prefill vs decode vs
+    preemption stalls. Shared by fleet_report and trace_summary so both
+    CLIs attribute identically."""
+    rollup = {k: 0.0 for k in ("queue_wait", "prefill", "decode",
+                               "preempted")}
+    for r in wide_events.values():
+        if r.get("state") != "finished":
+            continue
+        for k, v in (r.get("breakdown") or {}).items():
+            rollup[k] = rollup.get(k, 0.0) + v
+    return rollup
+
+
+def slowest_requests(wide_events, top_k=5):
+    """Top-K slowest requests by TTFT, enriched for critical-path display
+    (ms fields, dominant breakdown component, routing decision, goodput
+    counters) — the one shape both CLIs render."""
+    rows = sorted((r for r in wide_events.values()
+                   if r.get("ttft") is not None),
+                  key=lambda r: -r["ttft"])[:top_k]
+    out = []
+    for r in rows:
+        b = r.get("breakdown") or {}
+        total = None
+        if r.get("finish") is not None and r.get("start") is not None:
+            total = (r["finish"] - r["start"]) * 1e3
+        out.append({
+            "request_id": r["request_id"], "trace_id": r.get("trace_id"),
+            "replica": r.get("replica"), "routing": r.get("routing"),
+            "ttft_ms": r["ttft"] * 1e3, "total_ms": total,
+            "breakdown_ms": {k: v * 1e3 for k, v in b.items()},
+            "dominant": max(b, key=b.get) if b else None,
+            "preemptions": r.get("preemptions") or 0,
+            "replay_tokens": r.get("replay_tokens") or 0,
+            "chunks": r.get("chunks") or 0,
+            "kv_blocks_peak": r.get("kv_blocks_peak") or 0,
+        })
+    return out
+
+
+def write_fleet_trace(output_dir, sources, fleet=None):
+    """Write the merged fleet dir: ``trace.json`` (Chrome/Perfetto),
+    ``spans.jsonl`` (merged + replica-tagged), ``requests.jsonl`` (wide
+    events, one line per request), ``fleet.json`` (the live rollup the
+    caller passes — Router.snapshot(): router block, per-replica metrics,
+    fleet percentiles/slo/goodput/digests). Returns a small manifest."""
+    os.makedirs(output_dir, exist_ok=True)
+    merged = merge_fleet_events(sources)
+    with open(os.path.join(output_dir, "trace.json"), "w") as f:
+        json.dump(fleet_chrome_trace(
+            sources, meta={"process": "fleet"}), f)
+    with open(os.path.join(output_dir, "spans.jsonl"), "w") as f:
+        for e in merged:
+            f.write(json.dumps(e) + "\n")
+    wide = build_wide_events(merged)
+    with open(os.path.join(output_dir, "requests.jsonl"), "w") as f:
+        for rid in sorted(wide):
+            f.write(json.dumps(wide[rid]) + "\n")
+    if fleet is not None:
+        with open(os.path.join(output_dir, "fleet.json"), "w") as f:
+            json.dump(fleet, f, indent=1, default=str)
+    return {"output_dir": output_dir, "events": len(merged),
+            "requests": len(wide),
+            "sources": [str(label) for label, _ in sources]}

@@ -54,6 +54,23 @@ class _NullSpan:
 _NULL_SPAN = _NullSpan()
 
 
+def event_to_chrome(e, pid=0):
+    """One internal event dict -> Trace Event Format (seconds -> us).
+    Shared by ``SpanTracer.to_chrome_trace`` and the fleet merger
+    (``telemetry/fleet.py``), which assigns one pid per source so N
+    replica streams render as N process lanes."""
+    ev = {"ph": e["ph"], "name": e["name"], "cat": e.get("cat", ""),
+          "ts": e["ts"] * 1e6, "pid": pid, "tid": e.get("tid", 0),
+          "args": e.get("args", {})}
+    if e["ph"] == "X":
+        ev["dur"] = e.get("dur", 0.0) * 1e6
+    elif e["ph"] == "i":
+        ev["s"] = "t"
+    elif e["ph"] == "C":
+        ev["args"] = {e["name"]: e.get("args", {}).get("value", 0.0)}
+    return ev
+
+
 class _Span:
     __slots__ = ("tracer", "name", "cat", "sync", "args", "t0", "_fence")
 
@@ -234,17 +251,7 @@ class SpanTracer:
         """The Trace Event Format dict Perfetto/chrome://tracing load."""
         out = [{"ph": "M", "pid": 0, "tid": 0, "name": "process_name",
                 "args": {"name": self.meta.get("process", "deepspeed_tpu")}}]
-        for e in self.events:
-            ev = {"ph": e["ph"], "name": e["name"], "cat": e["cat"],
-                  "ts": e["ts"] * 1e6, "pid": 0, "tid": e["tid"],
-                  "args": e["args"]}
-            if e["ph"] == "X":
-                ev["dur"] = e["dur"] * 1e6
-            elif e["ph"] == "i":
-                ev["s"] = "t"
-            elif e["ph"] == "C":
-                ev["args"] = {e["name"]: e["args"].get("value", 0.0)}
-            out.append(ev)
+        out.extend(event_to_chrome(e) for e in self.events)
         return {"traceEvents": out, "displayTimeUnit": "ms",
                 "otherData": dict(self.meta, dropped_events=self.dropped)}
 

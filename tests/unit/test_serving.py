@@ -428,6 +428,11 @@ def test_bench_serving_qps_smoke(tmp_path, paged):
     assert router["routed"] == art["completed"]
     assert "affinity_hit_rate" in router and "rebalances" in router
     assert "drains" in router and router["drains"] == 0
+    # fleet digest / SLO / goodput blocks ride every open-loop artifact
+    assert art["percentiles"]["ttft_ms"]["p99"] is not None
+    assert art["slo"]["configured"] is False and art["slo"]["pass"] is True
+    assert 0.0 < art["goodput"]["goodput_frac"] <= 1.0
+    assert art["goodput"]["replay_tokens"] == 0
     if paged:
         assert art["replicas"] == 2
         assert router["session_hits"] > 0  # sticky sessions engaged

@@ -172,6 +172,9 @@ def run_open_loop(args):
     if args.chunk_size:
         serving_kw["chunked_prefill"] = {"enabled": True,
                                          "chunk_size": args.chunk_size}
+    if args.slo_ttft_p99_ms or args.slo_tpot_p99_ms:
+        serving_kw["slo"] = {"ttft_p99_ms": args.slo_ttft_p99_ms,
+                             "tpot_p99_ms": args.slo_tpot_p99_ms}
     engine._config.serving = engine._config.serving.replace(**serving_kw)
 
     rng = np.random.RandomState(args.seed)
@@ -269,6 +272,14 @@ def run_open_loop(args):
         # rates, rebalances and drain counts — how the fleet actually
         # balanced, next to the throughput it earned
         "router": router_snap["router"],
+        # streaming-digest percentiles (fleet-merged, EXACT across replica
+        # count), the SLO grade against the --slo-* targets, and the
+        # goodput accounting (useful vs replay/padding device tokens) —
+        # the same numbers the Serving/*_p99_ms / goodput_frac events and
+        # tools/fleet_report.py carry
+        "percentiles": router_snap["percentiles"],
+        "slo": router_snap["slo"],
+        "goodput": router_snap["goodput"],
         # numerics self-incrimination next to the run stamp: a throughput
         # number earned while slots were shedding non-finite logits (or
         # steps were silently unhealthy) carries its own evidence —
@@ -299,7 +310,9 @@ def run_open_loop(args):
         "shared_prefix": args.shared_prefix, "replicas": len(replicas),
         "chunk_size": args.chunk_size,
         "session_affinity": bool(args.session_affinity),
-        "kv_growth": bool(args.kv_growth)})
+        "kv_growth": bool(args.kv_growth),
+        "slo_ttft_p99_ms": args.slo_ttft_p99_ms,
+        "slo_tpot_p99_ms": args.slo_tpot_p99_ms})
     print(json.dumps(artifact), flush=True)
     if args.output:
         with open(args.output, "w") as f:
@@ -354,6 +367,12 @@ def main():
                     help="paged pool reserves prompt blocks only and grows "
                          "decode blocks on demand (preempt-to-queue on "
                          "exhaustion)")
+    ap.add_argument("--slo-ttft-p99-ms", type=float, default=0.0,
+                    help="open-loop mode: serving.slo TTFT P99 target (ms; "
+                         "0 = no objective) — the artifact's slo block "
+                         "grades the fleet digests against it")
+    ap.add_argument("--slo-tpot-p99-ms", type=float, default=0.0,
+                    help="open-loop mode: serving.slo TPOT P99 target (ms)")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--output", default=None,
                     help="write the open-loop JSON artifact here")

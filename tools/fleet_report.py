@@ -1,0 +1,325 @@
+"""Fleet observability report: per-replica/fleet latency + goodput tables,
+slowest-request critical paths, and an SLO exit-code gate — read from the
+merged fleet trace dir a ``Router`` writes (``Router.write_fleet_trace``:
+``requests.jsonl`` wide events + ``fleet.json`` live rollup).
+
+    # triage a fleet run:
+    python tools/fleet_report.py traces/myjob/fleet
+
+    # CI-shaped gate: exit 3 when a configured SLO target is violated
+    python tools/fleet_report.py traces/myjob/fleet --fail-on slo
+
+    # override / supply targets at read time (re-grade an old run):
+    python tools/fleet_report.py traces/myjob/fleet --ttft-p99-ms 250 \
+        --fail-on slo --json fleet_report.json
+
+    # the planted/clean self-test pair (the health_report idiom):
+    python tools/fleet_report.py --selftest planted --fail-on slo  # exit 3
+    python tools/fleet_report.py --selftest clean --fail-on slo    # exit 0
+
+The report recomputes every percentile through the SAME mergeable
+fixed-bucket digest (``telemetry/digest.py``) the live metrics maintain,
+and — when ``fleet.json`` carries the live digest snapshots — verifies the
+trace-derived digest matches them bucket for bucket (the tier-1
+trace == digest == monitor-event discipline; a mismatch exits 2, like a
+torn health dump).
+
+Exit codes: 0 clean, 2 digest coherence failure, 3 SLO findings with
+``--fail-on slo``, 1 infrastructure failure (unreadable input).
+"""
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from deepspeed_tpu.telemetry import (LatencyDigest,  # noqa: E402
+                                     digest_from_wide_events, evaluate_slo,
+                                     load_wide_events, slowest_requests)
+
+
+def load_fleet_dir(path):
+    """(wide_events, fleet_json_or_None) from a fleet dir or a bare
+    requests.jsonl file."""
+    fleet = None
+    if os.path.isdir(path):
+        req_file = os.path.join(path, "requests.jsonl")
+        fj = os.path.join(path, "fleet.json")
+        if os.path.exists(fj):
+            with open(fj) as f:
+                fleet = json.load(f)
+    else:
+        req_file = path
+    if not os.path.exists(req_file):
+        raise FileNotFoundError(f"no requests.jsonl at {req_file}")
+    return load_wide_events(req_file), fleet
+
+
+def _digests_for(wide):
+    return {m: digest_from_wide_events(wide, m)
+            for m in ("ttft", "tpot", "queue_wait")}
+
+
+def _goodput(rows):
+    keys = ("replay_tokens", "padding_tokens", "prefix_saved_tokens")
+    return {k: sum(r.get(k) or 0 for r in rows) for k in keys}
+
+
+def _row(label, rows):
+    wide = {r["request_id"]: r for r in rows}
+    d = _digests_for(wide)
+    fin = [r for r in rows if r.get("state") == "finished"]
+    gp = _goodput(fin)
+    ms = lambda v: "-" if v is None else f"{v:.1f}"
+    return {
+        "label": label, "requests": len(rows), "finished": len(fin),
+        "shed": sum(1 for r in rows if r.get("state") == "shed"),
+        "preemptions": sum(r.get("preemptions") or 0 for r in fin),
+        **gp,
+        "ttft_p50_ms": d["ttft"].quantile_ms(50),
+        "ttft_p99_ms": d["ttft"].quantile_ms(99),
+        "tpot_p99_ms": d["tpot"].quantile_ms(99),
+        "queue_wait_p99_ms": d["queue_wait"].quantile_ms(99),
+        "_fmt": lambda r: (
+            f"| {r['label']} | {r['requests']} | {r['finished']} "
+            f"| {r['shed']} | {ms(r['ttft_p50_ms'])} "
+            f"| {ms(r['ttft_p99_ms'])} | {ms(r['tpot_p99_ms'])} "
+            f"| {ms(r['queue_wait_p99_ms'])} | {r['preemptions']} "
+            f"| {r['replay_tokens']} | {r['padding_tokens']} |"),
+    }
+
+
+def summarize(wide, fleet=None, targets_ms=None, top_k=5):
+    """The machine-readable report the tables print from."""
+    rows = list(wide.values())
+    by_replica = {}
+    for r in rows:
+        by_replica.setdefault(r.get("replica") or "?", []).append(r)
+    replica_rows = [_row(label, rs)
+                    for label, rs in sorted(by_replica.items())]
+    fleet_row = _row("fleet", rows)
+
+    digests = _digests_for(wide)
+    # digest coherence vs the live snapshots the Router recorded: the
+    # trace-derived and live digests must agree bucket for bucket
+    coherence = None
+    if fleet and fleet.get("digests"):
+        resets = int(fleet.get("window_resets") or 0)
+        coherence = {}
+        for m, snap in fleet["digests"].items():
+            try:
+                live = LatencyDigest.from_snapshot(snap)
+                if live.counts == digests[m].counts:
+                    coherence[m] = True
+                elif resets:
+                    # the live digests were restarted mid-run (warmup
+                    # exclusion via reset_window): the trace still holds
+                    # the pre-reset requests, so a count mismatch is
+                    # EXPECTED, not a torn artifact — informational only
+                    coherence[m] = "reset-window (live digests restarted " \
+                                   "mid-run; trace covers more)"
+                else:
+                    coherence[m] = False
+            except ValueError as e:
+                coherence[m] = f"unreadable: {e}"
+
+    if targets_ms is None:
+        targets_ms = (fleet or {}).get("slo", {}).get("targets_ms", {})
+        # fleet.json records targets keyed by metric; evaluate_slo wants
+        # the config-file key form
+        targets_ms = {f"{k}_p99_ms" if not k.endswith("_p99_ms") else k: v
+                      for k, v in (targets_ms or {}).items()}
+    slo = evaluate_slo(targets_ms, digests)
+
+    critical = slowest_requests(wide, top_k=top_k)
+
+    strip = lambda r: {k: v for k, v in r.items() if not k.startswith("_")}
+    return {
+        "requests": len(rows),
+        "replicas": [strip(r) for r in replica_rows],
+        "fleet": strip(fleet_row),
+        "goodput": (fleet or {}).get("goodput") or _goodput(rows),
+        "slo": slo,
+        "digest_coherence": coherence,
+        "critical_paths": critical,
+        "_replica_rows": replica_rows, "_fleet_row": fleet_row,
+    }
+
+
+def print_report(summary):
+    print("| replica | reqs | finished | shed | ttft p50 ms | ttft p99 ms "
+          "| tpot p99 ms | queue p99 ms | preempt | replay tok | pad tok |")
+    print("|---|---|---|---|---|---|---|---|---|---|---|")
+    for r in summary["_replica_rows"]:
+        print(r["_fmt"](r))
+    fr = summary["_fleet_row"]
+    print(fr["_fmt"](fr))
+
+    gp = summary["goodput"]
+    if "goodput_frac" in gp:
+        print(f"\ngoodput: {gp['goodput_frac']:.4f} "
+              f"(replay {gp['replay_tokens']} + padding "
+              f"{gp['padding_tokens']} wasted tokens; prefix cache saved "
+              f"{gp['prefix_saved_tokens']})")
+
+    slo = summary["slo"]
+    if slo["configured"]:
+        for m, target in slo["targets_ms"].items():
+            obs = slo["observed_p99_ms"].get(m)
+            verdict = "VIOLATED" if slo["violated"].get(m) else "ok"
+            print(f"slo {m}_p99: observed "
+                  f"{'-' if obs is None else f'{obs:.1f}'} ms vs target "
+                  f"{target:.1f} ms -> {verdict} "
+                  f"(burn rate {slo['burn_rate'].get(m, 0.0):.2f})")
+    else:
+        print("slo: no targets configured")
+
+    if summary["digest_coherence"] is not None:
+        vals = summary["digest_coherence"]
+        bad = {m: v for m, v in vals.items()
+               if v is False or (isinstance(v, str)
+                                 and v.startswith("unreadable"))}
+        soft = {m for m, v in vals.items()
+                if isinstance(v, str) and v.startswith("reset-window")}
+        print("digest coherence (trace vs live): "
+              + ("OK" if not bad and not soft
+                 else f"MISMATCH {bad}" if bad
+                 else f"not comparable (reset_window mid-run: {sorted(soft)})"))
+
+    if summary["critical_paths"]:
+        print("\nslowest requests (critical path):")
+        for c in summary["critical_paths"]:
+            b = c["breakdown_ms"]
+            parts = " + ".join(f"{k} {v:.1f}" for k, v in b.items())
+            route = c.get("routing") or {}
+            total = "" if c["total_ms"] is None \
+                else f", total {c['total_ms']:.1f} ms"
+            print(f"  req {c['request_id']} @ {c['replica']} "
+                  f"(routed: {route.get('affinity') or route.get('policy')}"
+                  f"{', rebalanced' if route.get('rebalanced') else ''}): "
+                  f"ttft {c['ttft_ms']:.1f} ms{total} = {parts} "
+                  f"[dominant: {c['dominant']}; {c['preemptions']} "
+                  f"preemptions, {c['replay_tokens']} replay tok, "
+                  f"{c['chunks']} chunks, kv peak {c['kv_blocks_peak']}]")
+
+
+def _selftest_wide_events(planted):
+    """Deterministic synthetic fleet: 2 replicas x 20 requests with smooth
+    sub-target latencies. The planted twin grows a slow tail on replica1 —
+    queue-wait-dominated TTFTs far over the 2000 ms target plus a
+    preemption replay burst — so ``--fail-on slo`` exits 3; the clean twin
+    exits 0. (The program_lint/health_report planted/clean idiom.)"""
+    wide = {}
+    rid = 0
+    for rep in range(2):
+        for i in range(20):
+            ttft = 0.4 + 0.02 * ((i * 7 + rep * 3) % 10)   # 400-600 ms
+            queue = 0.1 + 0.01 * (i % 5)
+            preempted = 0.0
+            preemptions = replay = 0
+            if planted and rep == 1 and i >= 16:
+                # the planted defect: a preemption-thrashed tail
+                ttft = 6.0 + 0.5 * i
+                queue = 4.0
+                preempted = 1.5
+                preemptions, replay = 2, 48
+            wide[rid] = {
+                "request_id": rid, "trace_id": f"req-{rid:06d}",
+                "state": "finished", "replica": f"replica{rep}",
+                "routing": {"replica": rep, "policy": "least_loaded",
+                            "scores": {"0": 0.1, "1": 0.2},
+                            "affinity": None, "rebalanced": False},
+                "finish_reason": "length", "prompt_len": 16,
+                "n_tokens": 8, "chunks": 2, "preemptions": preemptions,
+                "replay_tokens": replay, "padding_tokens": 4,
+                "prefix_saved_tokens": 8, "kv_blocks_peak": 3,
+                "queue_wait": queue, "ttft": ttft,
+                "tpot": 0.05 + 0.001 * (i % 7),
+                "breakdown": {"queue_wait": queue, "prefill": 0.2,
+                              "preempted": preempted,
+                              "decode": max(ttft - queue - 0.2, 0.05)},
+                "start": float(i), "finish": float(i) + ttft + 1.0,
+            }
+            rid += 1
+    return wide
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("path", nargs="?", default=None,
+                    help="fleet trace dir (requests.jsonl [+ fleet.json]) "
+                         "or a bare requests.jsonl")
+    ap.add_argument("--selftest", choices=["planted", "clean"], default=None,
+                    help="run over the built-in synthetic fleet instead of "
+                         "a file (targets: ttft p99 2000 ms)")
+    ap.add_argument("--fail-on", default="none", choices=["slo", "none"],
+                    help="exit 3 when a configured SLO target is violated")
+    ap.add_argument("--ttft-p99-ms", type=float, default=None,
+                    help="override/supply the TTFT P99 target at read time")
+    ap.add_argument("--tpot-p99-ms", type=float, default=None)
+    ap.add_argument("--queue-wait-p99-ms", type=float, default=None)
+    ap.add_argument("--top-k", type=int, default=5,
+                    help="slowest-request critical paths shown")
+    ap.add_argument("--json", default=None,
+                    help="also write the stamped machine-readable summary")
+    args = ap.parse_args(argv)
+
+    if args.selftest:
+        wide = _selftest_wide_events(planted=args.selftest == "planted")
+        fleet = None
+        source = f"selftest:{args.selftest}"
+        if args.ttft_p99_ms is None:
+            args.ttft_p99_ms = 2000.0
+    elif args.path:
+        try:
+            wide, fleet = load_fleet_dir(args.path)
+        except (OSError, ValueError) as e:
+            print(f"cannot load {args.path}: {e}", file=sys.stderr)
+            return 1
+        source = args.path
+    else:
+        ap.error("give a fleet dir or --selftest")
+
+    targets = None
+    overrides = {"ttft_p99_ms": args.ttft_p99_ms,
+                 "tpot_p99_ms": args.tpot_p99_ms,
+                 "queue_wait_p99_ms": args.queue_wait_p99_ms}
+    if any(v is not None for v in overrides.values()):
+        targets = {k: v for k, v in overrides.items() if v is not None}
+
+    summary = summarize(wide, fleet, targets_ms=targets, top_k=args.top_k)
+    print(f"## fleet report: {source} ({summary['requests']} requests)")
+    print_report(summary)
+
+    if args.json:
+        sys.path.insert(0, os.path.join(REPO, "tools"))
+        from _common import stamp_record
+
+        out = {k: v for k, v in summary.items() if not k.startswith("_")}
+        stamp_record(out, config={"source": source, "targets": targets})
+        with open(args.json, "w") as f:
+            json.dump(out, f, indent=1)
+        print(f"\nwrote {args.json}")
+
+    coherence = summary["digest_coherence"]
+    if coherence is not None and any(
+            v is False or (isinstance(v, str) and v.startswith("unreadable"))
+            for v in coherence.values()):
+        # a "reset-window" entry is expected divergence, not a failure
+        print("DIGEST COHERENCE FAILED: trace-derived digests do not match "
+              "the live fleet.json snapshots", file=sys.stderr)
+        return 2
+    if args.fail_on == "slo" and summary["slo"]["configured"] \
+            and not summary["slo"]["pass"]:
+        bad = [m for m, v in summary["slo"]["violated"].items() if v]
+        print(f"FAIL: SLO violated for {bad}", file=sys.stderr)
+        return 3
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

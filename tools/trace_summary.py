@@ -15,12 +15,23 @@ and prints:
 - a serving rollup (request count, p50/p99 TTFT/TPOT) when the trace holds
   ``request/*`` lifecycle events.
 
+A MERGED FLEET dir (``Router.write_fleet_trace``: replica-tagged
+``spans.jsonl`` + ``requests.jsonl`` wide events) switches to fleet mode:
+per-replica phase table (prefill / prefill_chunk / decode_step time by
+replica), the request critical-path rollup (where fleet latency went —
+queue wait vs prefill chunks vs decode vs preemption stalls, aggregate and
+top-5 slowest), and ``--max-ttft-p99-ms`` flagging of the digest-derived
+fleet TTFT P99.
+
 Exit code 3 when any step is flagged and ``--fail-on-flag`` is set (the CI
-teeth: an overlap regression shows up as a step whose exposed share jumped).
+teeth: an overlap regression shows up as a step whose exposed share jumped;
+a serving regression as a fleet P99 over its flag threshold).
 
     python tools/trace_summary.py traces/MyJob
     python tools/trace_summary.py traces/MyJob --budget tiny-test/8/bf16 \
         --fail-on-flag --json trace_summary.json
+    python tools/trace_summary.py traces/MyJob/fleet \
+        --max-ttft-p99-ms 250 --fail-on-flag
 """
 
 import argparse
@@ -31,8 +42,12 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
-from deepspeed_tpu.telemetry import (counters_by_step, load_jsonl,  # noqa: E402
-                                     phase_table, request_metrics)
+from deepspeed_tpu.telemetry import (LatencyDigest,  # noqa: E402
+                                     counters_by_step,
+                                     digest_from_wide_events, latency_rollup,
+                                     load_jsonl, load_wide_events,
+                                     phase_table, request_metrics,
+                                     slowest_requests)
 
 
 def percentile(samples, q):
@@ -98,6 +113,102 @@ def summarize(events, scalars, max_exposed_frac=None):
     return summary
 
 
+def load_fleet(path):
+    """(merged_span_events, wide_events) from a fleet dir (or None if the
+    path is not one — no requests.jsonl)."""
+    if not os.path.isdir(path):
+        return None
+    req_file = os.path.join(path, "requests.jsonl")
+    if not os.path.exists(req_file):
+        return None
+    spans_file = os.path.join(path, "spans.jsonl")
+    events = load_jsonl(spans_file) if os.path.exists(spans_file) else []
+    return events, load_wide_events(req_file)
+
+
+def summarize_fleet(events, wide, max_ttft_p99_ms=None, top_k=5):
+    """Fleet rollup: per-replica phase totals, the critical-path
+    attribution of fleet latency, digest percentiles + P99 flagging."""
+    # per-replica phase table: span time by (replica, span name)
+    per_replica = {}
+    for e in events:
+        if e.get("ph") != "X":
+            continue
+        row = per_replica.setdefault(e.get("replica", "?"), {})
+        row[e["name"]] = row.get(e["name"], 0.0) + e.get("dur", 0.0)
+    phases = []
+    for row in per_replica.values():
+        for name in row:
+            if name not in phases:
+                phases.append(name)
+
+    digests = {m: digest_from_wide_events(wide, m)
+               for m in ("ttft", "tpot", "queue_wait")}
+    p99 = digests["ttft"].quantile_ms(99)
+    # bucket-granularity comparison, same rule as evaluate_slo: the
+    # reported P99 is a bucket UPPER edge, so comparing it raw against the
+    # threshold would flag runs whose every sample is under it
+    p99_bucket = digests["ttft"].quantile_bucket(99)
+    flagged = (max_ttft_p99_ms is not None and p99_bucket is not None
+               and p99_bucket
+               > LatencyDigest.bucket_index(max_ttft_p99_ms / 1e3))
+
+    return {
+        "mode": "fleet",
+        "requests": len(wide),
+        "finished": sum(1 for r in wide.values()
+                        if r.get("state") == "finished"),
+        "shed": sum(1 for r in wide.values() if r.get("state") == "shed"),
+        "phases": phases,
+        "per_replica_phase_s": {rep: row
+                                for rep, row in sorted(per_replica.items())},
+        # shared rollup/slowest helpers (telemetry/fleet.py) — same
+        # attribution arithmetic as tools/fleet_report.py by construction
+        "critical_path_s": latency_rollup(wide),
+        "percentiles_ms": {m: {"p50": d.quantile_ms(50),
+                               "p99": d.quantile_ms(99)}
+                           for m, d in digests.items()},
+        "slowest": slowest_requests(wide, top_k=top_k),
+        "max_ttft_p99_ms": max_ttft_p99_ms,
+        "ttft_p99_ms": p99,
+        "flagged_steps": ["fleet_ttft_p99"] if flagged else [],
+    }
+
+
+def print_fleet_summary(summary):
+    phases = summary["phases"]
+    print(f"fleet trace: {summary['requests']} requests "
+          f"({summary['finished']} finished, {summary['shed']} shed)")
+    if phases:
+        print("\n| replica | " + " | ".join(f"{p} ms" for p in phases)
+              + " |")
+        print("|" + "---|" * (len(phases) + 1))
+        for rep, row in summary["per_replica_phase_s"].items():
+            cells = [rep] + [
+                "-" if p not in row else f"{row[p] * 1e3:.2f}"
+                for p in phases]
+            print("| " + " | ".join(cells) + " |")
+    cp = summary["critical_path_s"]
+    total = sum(cp.values()) or 1.0
+    print("\nrequest latency attribution (fleet total): "
+          + ", ".join(f"{k} {v * 1e3:.1f} ms ({100 * v / total:.0f}%)"
+                      for k, v in cp.items()))
+    pct = summary["percentiles_ms"]
+    fmt = lambda v: "-" if v is None else f"{v:.1f}"
+    print("percentiles: " + ", ".join(
+        f"{m} p50 {fmt(d['p50'])} / p99 {fmt(d['p99'])} ms"
+        for m, d in pct.items()))
+    for s in summary["slowest"]:
+        parts = " + ".join(f"{k} {v:.1f}"
+                           for k, v in s["breakdown_ms"].items())
+        print(f"  slow: req {s['request_id']} @ {s['replica']} ttft "
+              f"{s['ttft_ms']:.1f} ms = {parts} ({s['preemptions']} "
+              f"preemptions, {s['chunks']} chunks)")
+    if summary["flagged_steps"]:
+        print(f"\nFLAGGED: fleet TTFT p99 {summary['ttft_p99_ms']:.1f} ms "
+              f"exceeds --max-ttft-p99-ms {summary['max_ttft_p99_ms']}")
+
+
 def print_summary(summary):
     phases = summary["phases"]
     if summary["steps"]:
@@ -146,6 +257,9 @@ def main(argv=None):
                     help="scalars.jsonl path (defaults to the trace dir's)")
     ap.add_argument("--max-exposed-frac", type=float, default=None,
                     help="flag steps whose Comm/exposed_frac exceeds this")
+    ap.add_argument("--max-ttft-p99-ms", type=float, default=None,
+                    help="fleet mode: flag when the digest-derived fleet "
+                         "TTFT P99 exceeds this (ms)")
     ap.add_argument("--budget", default=None,
                     help="key into tools/collective_budgets.json; uses its "
                          "exposed_fraction_max as the flag threshold")
@@ -166,9 +280,25 @@ def main(argv=None):
         threshold = budgets[args.budget].get("exposed_fraction_max",
                                              threshold)
 
-    events, scalars = load_trace(args.trace, args.scalars)
-    summary = summarize(events, scalars, max_exposed_frac=threshold)
-    print_summary(summary)
+    fleet = load_fleet(args.trace)
+    if fleet is not None:
+        if args.budget or args.max_exposed_frac is not None:
+            # a merged fleet dir has no Comm/exposed_frac scalars: silently
+            # entering fleet mode would skip the exposed-budget gate the
+            # caller asked for — fail loudly instead
+            print("fleet dir: --budget/--max-exposed-frac do not apply "
+                  "(no step scalars in a merged fleet trace); use "
+                  "--max-ttft-p99-ms, or point at a per-replica trace dir",
+                  file=sys.stderr)
+            return 1
+        events, wide = fleet
+        summary = summarize_fleet(events, wide,
+                                  max_ttft_p99_ms=args.max_ttft_p99_ms)
+        print_fleet_summary(summary)
+    else:
+        events, scalars = load_trace(args.trace, args.scalars)
+        summary = summarize(events, scalars, max_exposed_frac=threshold)
+        print_summary(summary)
     if args.json:
         sys.path.insert(0, os.path.join(REPO, "tools"))
         from _common import stamp_record
