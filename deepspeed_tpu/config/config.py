@@ -413,6 +413,61 @@ class RouterConfig(ConfigModel):
                 f"{self.rebalance_margin}")
 
 
+class SpeculativeConfig(ConfigModel):
+    """Speculative decoding on the serving stack (``serving/speculative.py``
+    + the verify program in ``models/decoding.py``): a drafter proposes up
+    to ``k`` tokens per greedy slot, ONE target forward over k+1 positions
+    verifies them against the paged cache, and the longest agreeing prefix
+    is accepted (greedy acceptance, arXiv:2211.17192 — bitwise-checkable
+    against ``generate()``). Rejected candidates roll back by cursor
+    decrement; blocks left entirely past the cursor are released/scrubbed
+    at block granularity. Requires ``serving.kv_pool.enabled`` (rollback
+    rides the block machinery). Sampled (temperature > 0) requests never
+    speculate — their per-slot rng streams advance exactly once per
+    dispatched step either way, so enabling/disabling speculation cannot
+    perturb a seeded stream."""
+
+    enabled: bool = False
+    # "ngram" = prompt-lookup drafting, zero extra weights: match the last
+    # ``ngram`` tokens against the request's own prompt+generated history
+    # and propose the continuation of the most recent earlier occurrence.
+    # "model" = a small draft model sharing the mesh (separate params, its
+    # own tiny dense KV cache; see ``draft_model``).
+    drafter: str = "ngram"
+    # max draft tokens per verify step; the verify program is shaped by k
+    # (drafts pad to k), so it compiles exactly once per k
+    k: int = 4
+    # match length for the ngram drafter
+    ngram: int = 2
+    # TransformerConfig overrides for the draft model (vocab_size and
+    # max_seq_len are pinned to the target's); default = a 1-layer copy of
+    # the target config
+    draft_model: dict = {}
+    # draft-model init seed (the drafter only shapes PROPOSALS — accepted
+    # output is provably the target's own greedy stream either way)
+    draft_seed: int = 0
+    # virtual-clock cost per PROPOSED token for the model drafter (the
+    # ngram drafter is free); the verify itself costs one decode step —
+    # it is one target forward, which is the whole latency play
+    virtual_draft_cost_per_token: float = 0.0
+
+    def _validate(self):
+        if self.drafter not in ("ngram", "model"):
+            raise ConfigError(
+                f"speculative.drafter must be 'ngram' or 'model', got "
+                f"{self.drafter!r}")
+        if self.k < 1:
+            raise ConfigError(
+                f"speculative.k must be >= 1, got {self.k}")
+        if self.ngram < 1:
+            raise ConfigError(
+                f"speculative.ngram must be >= 1, got {self.ngram}")
+        if self.virtual_draft_cost_per_token < 0:
+            raise ConfigError(
+                f"speculative.virtual_draft_cost_per_token must be >= 0, "
+                f"got {self.virtual_draft_cost_per_token}")
+
+
 class SLOConfig(ConfigModel):
     """Serving latency objectives (``serving.slo``): P99 targets graded
     against the streaming latency digests (``telemetry/digest.py``) that
@@ -496,6 +551,9 @@ class ServingConfig(ConfigModel):
     # be admitted past it before admissions stop until the head clears
     # (bounded starvation). 0 = strict FCFS, nothing ever overtakes the head.
     hol_bypass_limit: int = 0
+    # speculative decoding: drafter + one-forward verify + rollback-safe
+    # greedy acceptance over the paged pool (speculative.enabled)
+    speculative: SpeculativeConfig = None
 
     def _validate(self):
         if self.kv_pool is None:
@@ -506,6 +564,13 @@ class ServingConfig(ConfigModel):
             self.router = RouterConfig()
         if self.slo is None:
             self.slo = SLOConfig()
+        if self.speculative is None:
+            self.speculative = SpeculativeConfig()
+        if self.speculative.enabled and not self.kv_pool.enabled:
+            raise ConfigError(
+                "serving.speculative.enabled requires serving.kv_pool."
+                "enabled: acceptance rollback (cursor decrement + stale-"
+                "block release/scrub) rides the paged-pool block machinery")
         if self.hol_bypass_limit < 0:
             raise ConfigError(
                 f"serving.hol_bypass_limit must be >= 0, got "

@@ -528,6 +528,28 @@ class InferenceEngine:
                 report["sanitizer"], sanitize_jaxpr(jaxpr, config=cfg))
         return report
 
+    def verify_program_report(self, spec_k=None):
+        """Static audit of the speculative verify program (one target
+        forward over k+1 positions per slot against the donated paged pool
+        state) — the serving-side fence for speculative decoding, enforced
+        via the ``serving-verify/8/bf16`` budget
+        (``tools/program_lint.py --program verify``)."""
+        from ..profiling.collectives import audit_lowered
+        from ..profiling.sanitizer import (ATTENTION_F32_ALLOW,
+                                           merge_reports, sanitize_jaxpr)
+
+        sv = self.serving
+        dtype = {jnp.bfloat16: "bf16", jnp.float16: "f16"}.get(
+            self.dtype, "f32")
+        cfg = {"compute_dtype": dtype, "allow": list(ATTENTION_F32_ALLOW)}
+        n = max(self.mesh.devices.size, 1)
+        lowered, jaxpr = sv.trace_verify(spec_k)
+        report = audit_lowered(lowered, n, sanitizer_config=cfg)
+        if jaxpr is not None:
+            report["sanitizer"] = merge_reports(
+                report["sanitizer"], sanitize_jaxpr(jaxpr, config=cfg))
+        return report
+
     @property
     def config(self):
         return self._config

@@ -119,16 +119,24 @@ def _paged_view(kc, sc, table, view_dtype):
     return g.reshape(s_dim, per_slot * bs, kvh, dh)
 
 
-def _paged_writeback(kc, sc, view, table, pos, block_size):
+def _paged_writeback(kc, sc, view, table, pos, block_size, valid=None):
     """Scatter the row each slot just wrote (at its cursor) from the dense
     view back into the pool at (table[s, pos // bs], pos % bs). Freed slots
     carry an all-garbage-block table row, so their dead writes land in the
-    reserved garbage block instead of corrupting a reallocated block."""
+    reserved garbage block instead of corrupting a reallocated block.
+
+    ``valid`` ([S] bool, optional): rows whose write must instead be
+    redirected to the reserved garbage block 0 (speculative verify's padded
+    draft rows — they can lie past the slot's bound blocks or the KV window,
+    and a clamped block index would silently corrupt a REAL block)."""
     s_dim = pos.shape[0]
     rows = jax.vmap(
         lambda c, p: jax.lax.dynamic_slice(
             c, (p, 0, 0), (1,) + c.shape[1:]))(view, pos)[:, 0]  # [S, kvh, dh]
-    bi = jnp.take_along_axis(table, (pos // block_size)[:, None], axis=1)[:, 0]
+    j = jnp.clip(pos // block_size, 0, table.shape[1] - 1)
+    bi = jnp.take_along_axis(table, j[:, None], axis=1)[:, 0]
+    if valid is not None:
+        bi = jnp.where(valid, bi, 0)  # block 0 = the reserved garbage block
     off = pos % block_size
     if sc is not None:
         from ..comm.collectives import quantize_blockwise
@@ -139,7 +147,7 @@ def _paged_writeback(kc, sc, view, table, pos, block_size):
 
 
 def forward_with_paged_cache(model, params, input_ids, pool, table, pos,
-                             block_size):
+                             block_size, draft_len=None):
     """One decode step ([S, 1] tokens) reading/writing KV through a TRACED
     block table — the paged twin of ``forward_with_cache``'s per-row decode.
 
@@ -149,16 +157,35 @@ def forward_with_paged_cache(model, params, input_ids, pool, table, pos,
     into the pool. Because the gathered view is bit-identical to the dense
     cache at every unmasked position and the math in between is the same
     program, greedy paged decode is bitwise-equal to the dense slot pool
-    (tier-1 pins it). Returns (logits [S, 1, vocab], new pool)."""
+    (tier-1 pins it). Returns (logits [S, 1, vocab], new pool).
+
+    ``draft_len`` [S] switches the program into speculative VERIFY mode
+    (see ``verify_with_paged_cache``): ``input_ids`` becomes [S, k+1]
+    (the slot's last token + k draft candidates at per-slot cursors), all
+    k+1 rows are written and all k+1 logit rows returned. Row i's write
+    could ever become live only while ``i <= draft_len`` and the position
+    is inside the KV window — padded rows compute garbage that the causal
+    mask hides in-view and whose pool writeback redirects to the garbage
+    block, and the in-view writes run in reverse row order so a
+    window-clamped padded write can never shadow a real row."""
     cfg = model.config
     b, q_len = input_ids.shape
     int8 = "k_scale" in pool
     view_dtype = cfg.compute_dtype
     positions = pos[:, None] + jnp.arange(q_len)[None, :]
     kv_len = table.shape[1] * block_size
+    if draft_len is not None:
+        valid = (jnp.arange(q_len)[None, :] <= draft_len[:, None]) \
+            & (positions < kv_len)                    # [S, q]
+        row_writes = "reverse"
+    else:
+        valid = None
+        row_writes = "block"
 
     x = L.embedding_apply(params["wte"], input_ids, cfg.compute_dtype)
     if cfg.position_embedding == "learned":
+        # jnp.take clamps out-of-range (padded-row) positions; those rows'
+        # embeddings are garbage by design and masked/redirected above
         x = x + jnp.take(params["wpe"]["weight"].astype(cfg.compute_dtype),
                          positions, axis=0)
     rope = None
@@ -170,9 +197,15 @@ def forward_with_paged_cache(model, params, input_ids, pool, table, pos,
         kview = _paged_view(kc, ks, table, view_dtype)
         vview = _paged_view(vc, vs, table, view_dtype)
         h, kview, vview = _block_cached(cfg, p_i, h, kview, vview, pos,
-                                        kv_len, rope=rope, is_local=loc)
-        kc, ks = _paged_writeback(kc, ks, kview, table, pos, block_size)
-        vc, vs = _paged_writeback(vc, vs, vview, table, pos, block_size)
+                                        kv_len, rope=rope, is_local=loc,
+                                        row_writes=row_writes)
+        for i in range(q_len):
+            p_row = pos if i == 0 else pos + i
+            v_row = None if valid is None else valid[:, i]
+            kc, ks = _paged_writeback(kc, ks, kview, table, p_row,
+                                      block_size, valid=v_row)
+            vc, vs = _paged_writeback(vc, vs, vview, table, p_row,
+                                      block_size, valid=v_row)
         return h, kc, vc, ks, vs
 
     scales = (pool["k_scale"], pool["v_scale"]) if int8 else None
@@ -215,6 +248,30 @@ def forward_with_paged_cache(model, params, input_ids, pool, table, pos,
     if int8:
         new_pool["k_scale"], new_pool["v_scale"] = new[2], new[3]
     return logits, new_pool
+
+
+def verify_with_paged_cache(model, params, input_ids, pool, table, pos,
+                            block_size, draft_len):
+    """One speculative-decoding VERIFY step against the paged cache: feed
+    ``input_ids`` [S, k+1] (each slot's last sampled token + its k draft
+    candidates) at per-slot cursors ``pos``, write the candidate KV rows,
+    and return ALL k+1 logit rows — the single target forward classic
+    speculative decoding needs (arXiv:2211.17192). Row i's logits give the
+    target's next-token distribution after consuming row i, so greedy
+    acceptance is: take drafts while ``draft[i] == argmax(logits[:, i])``.
+
+    This IS ``forward_with_paged_cache`` with ``draft_len`` set — the same
+    gather/attention/writeback scaffold as the decode program, so the
+    logits at every accepted position are bitwise what sequential decode
+    would have produced there (the multi-position == sequential property
+    the suffix-prefill/chunked paths already pin). Rejected candidates'
+    rows stay in the pool PAST the rolled-back cursor — causally masked,
+    overwritten before they could become visible; the serving engine
+    additionally releases/scrubs fully-stale blocks at block granularity.
+
+    Returns (logits [S, k+1, vocab], new pool)."""
+    return forward_with_paged_cache(model, params, input_ids, pool, table,
+                                    pos, block_size, draft_len=draft_len)
 
 
 def insert_block_kv(pool, dense_cache, block_id, src_start, block_size):
@@ -273,7 +330,7 @@ def gather_slot_cache(cfg, pool, table_row, dtype):
 
 
 def _attn_with_cache(cfg, p_attn, h, k_cache, v_cache, pos, kv_len, rope=None,
-                     is_local=None, prefill=False):
+                     is_local=None, prefill=False, row_writes="block"):
     """Attention for q block [b, q, d] against cache[:, :kv_len] after writing the
     new k/v at ``pos``. Returns (out [b, q, d], new k_cache, new v_cache).
 
@@ -283,6 +340,14 @@ def _attn_with_cache(cfg, p_attn, h, k_cache, v_cache, pos, kv_len, rope=None,
     length (mask handles the rest).
     ``prefill``: static caller promise that pos == 0 and the q block IS the
     whole visible window — enables the flash fast path below (scalar pos only).
+    ``row_writes`` (per-row pos only): "block" writes the whole q block with
+    one update per row; "reverse" writes one position at a time, LAST
+    position first — required when pos + q may legitimately overrun the
+    window (speculative verify's padded draft rows): an overrunning write
+    clamps onto the final row, and the reverse order guarantees the valid
+    write at any clamp target lands last, so clamped garbage can never
+    shadow a real row (the PR 7 overrun class, closed by ordering instead
+    of a bucket cap because here the overrun is by design).
     """
     b, q_len, d = h.shape
     per_row = jnp.ndim(pos) == 1
@@ -301,8 +366,17 @@ def _attn_with_cache(cfg, p_attn, h, k_cache, v_cache, pos, kv_len, rope=None,
         # vmapped dynamic_update_slice lowers to a per-row scatter
         row_update = jax.vmap(
             lambda c, blk, p: jax.lax.dynamic_update_slice(c, blk, (p, 0, 0)))
-        k_cache = row_update(k_cache, k.astype(k_cache.dtype), pos)
-        v_cache = row_update(v_cache, v.astype(v_cache.dtype), pos)
+        if row_writes == "reverse":
+            for i in reversed(range(q_len)):
+                k_cache = row_update(k_cache,
+                                     k[:, i:i + 1].astype(k_cache.dtype),
+                                     pos + i)
+                v_cache = row_update(v_cache,
+                                     v[:, i:i + 1].astype(v_cache.dtype),
+                                     pos + i)
+        else:
+            k_cache = row_update(k_cache, k.astype(k_cache.dtype), pos)
+            v_cache = row_update(v_cache, v.astype(v_cache.dtype), pos)
     else:
         k_cache = jax.lax.dynamic_update_slice(k_cache, k.astype(k_cache.dtype),
                                                (0, pos, 0, 0))
@@ -400,7 +474,7 @@ def _mlp(cfg, p, h):
 
 
 def _block_cached(cfg, p, x, k_cache, v_cache, pos, kv_len, rope=None,
-                  is_local=None, prefill=False):
+                  is_local=None, prefill=False, row_writes="block"):
     """One block with cache. x: [b, q, d] compute dtype."""
     cast = lambda a: a.astype(cfg.compute_dtype) \
         if jnp.issubdtype(a.dtype, jnp.floating) else a
@@ -414,7 +488,7 @@ def _block_cached(cfg, p, x, k_cache, v_cache, pos, kv_len, rope=None,
     def attn(h):
         return _attn_with_cache(cfg, p_cast["attn"], h, k_cache, v_cache, pos,
                                 kv_len, rope=rope, is_local=is_local,
-                                prefill=prefill)
+                                prefill=prefill, row_writes=row_writes)
 
     if cfg.parallel_attn_mlp:
         h = _norm_apply(cfg, p_cast["ln_1"], x)
@@ -434,7 +508,7 @@ def _block_cached(cfg, p, x, k_cache, v_cache, pos, kv_len, rope=None,
 
 
 def forward_with_cache(model, params, input_ids, cache, pos, kv_len,
-                       prefill=False):
+                       prefill=False, row_writes="block"):
     """Run the model on ``input_ids`` [b, q] writing k/v into ``cache`` at ``pos``.
 
     Used for both prefill (q = prompt length, pos = 0) and decode (q = 1,
@@ -444,6 +518,8 @@ def forward_with_cache(model, params, input_ids, cache, pos, kv_len,
     ``prefill=True`` is the caller's static promise that pos == 0 and the
     whole visible window is this q block — it unlocks the flash fast path
     (callers with pos > 0 must leave it False).
+    ``row_writes="reverse"`` (per-row pos only) makes multi-row writes safe
+    against by-design window overruns — see ``_attn_with_cache``.
     """
     cfg = model.config
     b, q_len = input_ids.shape
@@ -472,7 +548,7 @@ def forward_with_cache(model, params, input_ids, cache, pos, kv_len,
             p_i, kc, vc, loc = layer
             h, kc, vc = _block_cached(cfg, p_i, h, kc, vc, pos, kv_len,
                                       rope=rope, is_local=loc,
-                                      prefill=prefill)
+                                      prefill=prefill, row_writes=row_writes)
             return h, (kc, vc)
 
         h, (k_new, v_new) = jax.lax.scan(
@@ -483,7 +559,8 @@ def forward_with_cache(model, params, input_ids, cache, pos, kv_len,
             h = carry
             p_i, kc, vc = layer
             h, kc, vc = _block_cached(cfg, p_i, h, kc, vc, pos, kv_len,
-                                      rope=rope, prefill=prefill)
+                                      rope=rope, prefill=prefill,
+                                      row_writes=row_writes)
             return h, (kc, vc)
 
         h, (k_new, v_new) = jax.lax.scan(

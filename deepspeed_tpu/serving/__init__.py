@@ -17,6 +17,7 @@ from .request import (FINISH_EOS, FINISH_LENGTH, FINISH_UNHEALTHY,
                       RequestState, SamplingParams, TokenEvent, as_request)
 from .router import Router, RouterMetrics
 from .scheduler import ServingScheduler, simulate_static_batching
+from .speculative import ModelDrafter, NgramDrafter
 
 __all__ = [
     "ServingEngine",
@@ -36,6 +37,8 @@ __all__ = [
     "GARBAGE_BLOCK",
     "Router",
     "RouterMetrics",
+    "NgramDrafter",
+    "ModelDrafter",
     "prefix_chain_keys",
     "FINISH_EOS",
     "FINISH_LENGTH",

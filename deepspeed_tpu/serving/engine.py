@@ -32,7 +32,7 @@ from ..models.decoding import (forward_with_cache, forward_with_paged_cache,
                                gather_slot_cache, init_cache,
                                init_paged_cache, insert_block_kv,
                                insert_slot_kv, reset_block_kv, reset_slot_kv,
-                               sample_token)
+                               sample_token, verify_with_paged_cache)
 from ..utils.logging import log_dist
 from .clock import VirtualClock, WallClock
 from .kv_pool import GARBAGE_BLOCK, KVPoolManager
@@ -108,6 +108,18 @@ class ServingEngine:
         self._prefill_jobs = collections.deque()
         self._decode_steps_since_chunk = 1 << 30  # first chunk never waits
         self._admit_seq = 0    # admission order (preemption victim = newest)
+        # speculative decoding (serving/speculative.py): a drafter proposes
+        # up to k tokens per greedy slot, ONE verify forward checks them,
+        # the longest agreeing prefix is accepted. Requires the paged pool
+        # (config-validated): rollback rides the block machinery.
+        self.spec = bool(self.cfg.speculative.enabled)
+        self.spec_k = int(self.cfg.speculative.k)
+        self._spec_on = self.spec   # runtime toggle (set_speculation)
+        self._drafter = None
+        if self.spec:
+            from .speculative import build_drafter
+
+            self._drafter = build_drafter(self)
         self.queue = RequestQueue(self.cfg.max_queue_depth)
         self.scheduler = ServingScheduler(
             self.queue, self.n_slots,
@@ -147,6 +159,9 @@ class ServingEngine:
                   "max_len": self.max_len})
         # the structured slo/violation events ride the request tracer
         self.metrics.tracer = self.tracer
+        # arms the Serving/spec_* monitor events (coherent with
+        # snapshot()["speculative"], the PR 4 trace==metrics discipline)
+        self.metrics.speculative_armed = self.spec
 
         self._slots = {}              # slot index -> running Request
         self._free_slots = list(range(self.n_slots - 1, -1, -1))  # pop() -> 0 first
@@ -162,6 +177,7 @@ class ServingEngine:
         self._scrub_jit = None           # paged: zero one physical block
         self._fresh_cache_jit = None     # chunked: zeroed dense b=1 cache
         self._grow_jit = None            # growth: append one table-row block
+        self._verify_jit = None          # speculative: one-forward verify
         # ONE sharding for the pool state, pinned as out_shardings on every
         # pool program: kv heads over the model axis (TP), everything else
         # replicated. Without the pin, insert and decode outputs would carry
@@ -199,7 +215,9 @@ class ServingEngine:
                 f"tokens ({cap / self.max_len:.1f} max-len-equivalent slots"
                 f", kv_dtype={self.cfg.kv_pool.kv_dtype or 'engine'}, "
                 f"prefix_cache={'on' if self.cfg.kv_pool.prefix_cache else 'off'}), "
-                f"queue depth {self.cfg.max_queue_depth}, "
+                + (f"speculative={self.cfg.speculative.drafter}/k="
+                   f"{self.spec_k}, " if self.spec else "")
+                + f"queue depth {self.cfg.max_queue_depth}, "
                 f"clock={'virtual' if isinstance(self.clock, VirtualClock) else 'wall'}",
                 ranks=[0])
         else:
@@ -337,6 +355,70 @@ class ServingEngine:
                 new_state["table"] = state["table"]
             return (nxt, done_now, nonfinite), new_state
 
+        def verify(params, state, drafts, draft_len):
+            # speculative decoding's ONE target forward: k+1 positions per
+            # slot — row 0 is the decode every active slot was owed, rows
+            # 1..k check the drafts. Greedy acceptance, cursor advance,
+            # remaining/eos bookkeeping all happen IN-GRAPH, so a verify
+            # step is exactly one dispatch (the program the serving-verify
+            # sanitizer budget audits) and the rng splits exactly once —
+            # a co-batched sampled slot cannot tell verify from decode.
+            split = jax.vmap(jax.random.split)(state["rng"])
+            ids = jnp.concatenate([state["tok"][:, None], drafts], axis=1)
+            logits, cache = verify_with_paged_cache(
+                model, params, ids, {k: state[k] for k in pool_keys},
+                state["table"], state["pos"], bs, draft_len)
+            active = state["active"]
+            kk = drafts.shape[1]
+            # column 0 samples with the slot's key (greedy rows are exact
+            # argmax inside sample_token); columns 1..k are greedy targets
+            # — only greedy rows ever carry drafts (engine eligibility)
+            first = sample_token(logits[:, 0], split[:, 0],
+                                 temperature=state["temp"],
+                                 top_k=state["top_k"], top_p=state["top_p"])
+            tgt = jnp.argmax(logits.astype(jnp.float32),
+                             axis=-1).astype(jnp.int32)
+            out_toks = jnp.concatenate([first[:, None], tgt[:, 1:]], axis=1)
+            # accept the longest prefix where draft == target argmax
+            matches = (drafts == out_toks[:, :kk]) \
+                & (jnp.arange(kk)[None, :] < draft_len[:, None])
+            accepted = jnp.sum(
+                jnp.cumprod(matches.astype(jnp.int32), axis=1), axis=1)
+            # emit candidate j while j <= accepted, tokens are still owed,
+            # and no earlier emitted token hit eos
+            js = jnp.arange(kk + 1)[None, :]
+            remaining = state["remaining"]
+            cand = (js <= accepted[:, None]) & (js < remaining[:, None])
+            is_eos = (state["eos"][:, None] >= 0) \
+                & (out_toks == state["eos"][:, None])
+            hit = (cand & is_eos).astype(jnp.int32)
+            eos_before = (jnp.cumsum(hit, axis=1) - hit) > 0
+            emit = cand & jnp.logical_not(eos_before) & active[:, None]
+            n_emit = jnp.sum(emit.astype(jnp.int32), axis=1)
+            # in-graph health guard over the EMITTED logit rows only (freed
+            # slots decode garbage by design; rejected rows never stream)
+            nonfinite = jnp.sum(
+                jnp.logical_not(jnp.isfinite(logits)) & emit[:, :, None],
+                axis=(1, 2)).astype(jnp.int32)
+            new_tok = jnp.take_along_axis(
+                out_toks, jnp.clip(n_emit - 1, 0, kk)[:, None], axis=1)[:, 0]
+            new_tok = jnp.where(n_emit > 0, new_tok, state["tok"])
+            remaining = remaining - n_emit
+            hit_eos = jnp.any(emit & is_eos, axis=1)
+            done_now = active & (hit_eos | (remaining <= 0))
+            new_state = dict(cache, **{
+                "table": state["table"],
+                "pos": state["pos"] + n_emit,
+                "tok": new_tok,
+                "active": active & jnp.logical_not(done_now),
+                "remaining": remaining,
+                "rng": split[:, 1],
+                "temp": state["temp"], "top_k": state["top_k"],
+                "top_p": state["top_p"], "eos": state["eos"],
+            })
+            return (out_toks, n_emit, accepted, done_now,
+                    nonfinite), new_state
+
         def insert(state, slot, k_slot, v_slot, tok, pos, remaining, rng,
                    temp, top_k, top_p, eos):
             # slot index is TRACED: one compiled insert covers every slot
@@ -466,6 +548,10 @@ class ServingEngine:
                 if self.growth:
                     self._grow_jit = jax.jit(grow, donate_argnums=(0,),
                                              out_shardings=st)
+                if self.spec:
+                    self._verify_jit = jax.jit(
+                        verify, donate_argnums=(1,),
+                        out_shardings=((rep, rep, rep, rep, rep), st))
             else:
                 self._insert_jit = jax.jit(insert, donate_argnums=(0,),
                                            out_shardings=st)
@@ -519,6 +605,28 @@ class ServingEngine:
             return t.lower(), t.jaxpr
         return fn.lower(*args), None
 
+    def trace_verify(self, spec_k=None):
+        """``(lowered, jaxpr-or-None)`` of the speculative verify program —
+        the ``program_lint --program verify`` entry point, mirroring
+        ``trace_decode``. Traces the SAME jitted closure a verify step
+        dispatches: k+1 positions per slot against the donated paged pool
+        state, with the draft matrix and per-slot draft lengths traced (one
+        compiled program per k)."""
+        if not self.spec:
+            raise ConfigError(
+                "trace_verify: serving.speculative is not enabled")
+        if self._decode_jit is None:
+            self._build_pool_programs()
+        kk = int(spec_k or self.spec_k)
+        args = (self.engine.params, self._state,
+                jnp.zeros((self.n_slots, kk), jnp.int32),
+                jnp.zeros((self.n_slots,), jnp.int32))
+        trace = getattr(self._verify_jit, "trace", None)
+        if trace is not None:
+            t = trace(*args)
+            return t.lower(), t.jaxpr
+        return self._verify_jit.lower(*args), None
+
     def compile_counts(self):
         """Compiled-program census, pinned by the tier-1 no-recompile test:
         the decode step compiles exactly once per (model, slot-pool)
@@ -536,6 +644,9 @@ class ServingEngine:
             out["suffix_buckets"] = len(self._suffix_programs)
         if self.growth:
             out["grow"] = size(self._grow_jit)
+        if self.spec:
+            out["verify"] = size(self._verify_jit)
+            out.update(self._drafter.compile_counts())
         return out
 
     def _scrub_block(self, block_id):
@@ -606,7 +717,12 @@ class ServingEngine:
         if self.growth and self._slots:
             self._grow_or_preempt()
         if self._slots:
-            self._decode_once(events)
+            drafts = self._collect_drafts() \
+                if (self.spec and self._spec_on) else None
+            if drafts:
+                self._verify_once(events, drafts)
+            else:
+                self._decode_once(events)
             self._decode_steps_since_chunk += 1
         elif not admitted and not self._prefill_jobs and self.queue.depth:
             # nothing running and the queue head hasn't arrived yet (direct
@@ -1014,6 +1130,8 @@ class ServingEngine:
         self._state = self._release_jit(self._state, np.int32(slot))
         self.pool_mgr.free_slot(slot)
         self._free_slots.append(slot)
+        if self._drafter is not None:
+            self._drafter.release(slot)
         req.slot = None
         self.queue.push_front(req)
         self.tracer.instant("request/preempted", cat="serving",
@@ -1060,12 +1178,190 @@ class ServingEngine:
         req.kv_blocks_peak = max(req.kv_blocks_peak, len(blocks))
         mgr.register_prefix(req.prompt, blocks)
 
+    # ------------------------------------------------- speculative decoding
+    def set_speculation(self, enabled):
+        """Toggle speculation at runtime (drafting is skipped when off; the
+        compiled verify program stays warm). Seeded sampled streams are
+        unaffected either way — the rng splits once per dispatched step in
+        both the decode and verify programs (tier-1 pins it)."""
+        self._spec_on = bool(enabled) and self.spec
+
+    def _collect_drafts(self):
+        """Ask the drafter for up to k candidates per eligible slot.
+
+        Eligibility: active, GREEDY (sampled slots never speculate — greedy
+        acceptance is an argmax identity, and a sampled slot's rng must
+        advance exactly once per dispatched step), and >= 2 tokens still
+        owed (a 1-token tail gains nothing from drafting). Draft length is
+        capped at tokens-owed - 1 (so every written candidate row stays
+        inside the request's block footprint) and, under on-demand growth,
+        by the coverage the pool can provide RIGHT NOW: a k-token verify
+        may cross a block boundary, and the grow must land before the
+        dispatch — exactly the admission-coverage bug class PR 13's
+        instrument caught, handled here by growing (never preempting) for
+        speculation and truncating the drafts when the pool is tight."""
+        wanted = {}
+        for slot, req in self._slots.items():
+            if req.sampling.temperature > 0:
+                continue
+            owed = req.max_new_tokens - len(req.tokens)
+            cap = min(self.spec_k, owed - 1)
+            if cap < 1:
+                continue
+            wanted[slot] = (np.concatenate(
+                [req.prompt, np.asarray(req.tokens, np.int32)]), cap)
+        if not wanted:
+            return None
+        proposals = self._drafter.propose(wanted)
+        out = {}
+        proposed = 0
+        for slot, toks in proposals.items():
+            toks = np.asarray(toks, np.int32).reshape(-1)[:wanted[slot][1]]
+            proposed += len(toks)
+            req = self._slots[slot]
+            if self.growth and len(toks):
+                toks = self._grow_for_verify(slot, req, toks)
+            if len(toks):
+                out[slot] = toks
+                req.drafted_tokens += len(toks)
+                self.metrics.record_draft(len(toks))
+        if proposed and self._drafter.name == "model":
+            self.clock.advance(
+                proposed * self.cfg.speculative.virtual_draft_cost_per_token)
+        return out or None
+
+    def _grow_for_verify(self, slot, req, toks):
+        """Under on-demand growth, candidate rows at positions
+        [cursor, cursor + len(toks)] must be block-covered BEFORE the
+        verify dispatches (padded rows redirect to the garbage block, but
+        rows that could be ACCEPTED must land in real blocks). Grows one
+        block at a time; when the pool cannot provide one, the drafts are
+        truncated to the existing coverage — speculation is opportunistic
+        and never preempts another request to make room for itself."""
+        mgr = self.pool_mgr
+        pos = req.prompt_len + len(req.tokens) - 1
+        while (pos + len(toks)) // mgr.block_size \
+                >= mgr.slot_block_count(slot):
+            if not mgr.can_allocate(1):
+                cover = mgr.slot_block_count(slot) * mgr.block_size
+                return toks[:max(cover - 1 - pos, 0)]
+            j = mgr.slot_block_count(slot)
+            bid = mgr.grow_slot(slot, live_tokens=pos + 1)
+            req.kv_blocks_peak = max(req.kv_blocks_peak, j + 1)
+            self._state = self._grow_jit(self._state, np.int32(slot),
+                                         np.int32(j), np.int32(bid))
+        return toks
+
+    def _verify_once(self, events, drafts):
+        """One verify dispatch over the whole pool: every active slot
+        advances >= 1 token (row 0 is its decode), speculating slots
+        advance by the accepted prefix + 1. Costs ONE decode step in
+        virtual time — that is the entire latency play, and why the worst
+        inter-token gap bound from chunked prefill is unchanged."""
+        kk = self.spec_k
+        dmat = np.zeros((self.n_slots, kk), np.int32)
+        dlen = np.zeros((self.n_slots,), np.int32)
+        for slot, toks in drafts.items():
+            dmat[slot, :len(toks)] = toks
+            dlen[slot] = len(toks)
+        with self.tracer.span("decode_step", cat="serving",
+                              active=len(self._slots), verify=True,
+                              drafted=int(dlen.sum())):
+            ((toks, n_emit, accepted, done_now, nonfinite),
+             self._state) = self._verify_jit(
+                self.engine.params, self._state, jnp.asarray(dmat),
+                jnp.asarray(dlen))
+            self.clock.advance(self.cfg.virtual_decode_step_cost)
+        toks = np.asarray(toks)
+        n_emit = np.asarray(n_emit)
+        accepted = np.asarray(accepted)
+        done_now = np.asarray(done_now)
+        nonfinite = np.asarray(nonfinite)
+        now = self.clock.now()
+        self.metrics.record_health_step(
+            sum(1 for s in self._slots if nonfinite[s] > 0))
+        self.metrics.record_verify_step()
+        self.metrics.record_decode_dispatch()
+        for slot in sorted(self._slots):
+            req = self._slots[slot]
+            pos0 = req.prompt_len + len(req.tokens) - 1  # this step's cursor
+            n, acc, d = int(n_emit[slot]), int(accepted[slot]), \
+                int(dlen[slot])
+            if d:
+                # booked BEFORE any shed below: the drafted == accepted +
+                # rolled_back invariant must balance on every exit path
+                req.accepted_tokens += acc
+                req.rolled_back_tokens += d - acc
+                self.metrics.record_accept(acc, d - acc)
+            if self._health_shed and nonfinite[slot] > 0:
+                self._shed_unhealthy(req, events, now, int(nonfinite[slot]))
+                continue
+            reason = None
+            for j in range(n):
+                t = int(toks[slot, j])
+                req.tokens.append(t)
+                self.metrics.record_tokens(1)
+                self.metrics.record_decode_tokens(1)
+                if j == n - 1 and bool(done_now[slot]):
+                    reason = FINISH_EOS if (req.eos_token_id is not None
+                                            and t == req.eos_token_id) \
+                        else FINISH_LENGTH
+                elif t in req.stop_token_ids:
+                    # host-side stop policy truncates the emitted run; the
+                    # device state is ahead but the slot is freed anyway
+                    reason = FINISH_STOP
+                events.append(TokenEvent(req.request_id, t,
+                                         len(req.tokens) - 1,
+                                         reason is not None, reason, now))
+                if reason is not None:
+                    break
+            if reason is not None:
+                self._finish(req, reason, now,
+                             deactivate=(reason == FINISH_STOP))
+                continue
+            if d >= n:
+                # candidate rows [pos0 + n, pos0 + d] were written but the
+                # cursor rolled back short of them — reclaim at block
+                # granularity
+                self._rollback_stale(slot, new_cursor=pos0 + n,
+                                     written_end=pos0 + d)
+
+    def _rollback_stale(self, slot, new_cursor, written_end):
+        """Rejected drafts rolled back: the in-graph verify already left
+        the cursor at the accepted end, so the rejected rows sit PAST it —
+        causally masked and overwritten before they could ever become
+        visible (the same guarantee freed-slot garbage rides). At block
+        granularity more is reclaimable: a block lying entirely past the
+        cursor holds ONLY stale rows, so under on-demand growth it is
+        released back to the pool (its scrub rides the normal last-ref
+        drop) and under whole-footprint reservation it is scrubbed in
+        place when the hygiene scrub is armed — both counted in
+        ``scrubbed_blocks``/``rolled_back_blocks``."""
+        mgr = self.pool_mgr
+        first_stale = -(-new_cursor // mgr.block_size)   # ceil
+        if self.growth:
+            for j in range(mgr.slot_block_count(slot) - 1, first_stale - 1,
+                           -1):
+                # table entry retreats to the garbage block BEFORE the
+                # allocator can hand the block to anyone else
+                self._state = self._grow_jit(self._state, np.int32(slot),
+                                             np.int32(j),
+                                             np.int32(GARBAGE_BLOCK))
+                mgr.shrink_slot(slot, live_tokens=new_cursor)
+        elif self.cfg.scrub_freed_slots:
+            last = min(written_end // mgr.block_size,
+                       mgr.slot_block_count(slot) - 1)
+            for j in range(first_stale, last + 1):
+                self._scrub_block(mgr.slot_block(slot, j))
+                mgr.scrubbed_blocks += 1
+
     def _decode_once(self, events):
         with self.tracer.span("decode_step", cat="serving",
                               active=len(self._slots)):
             ((toks, done_now, nonfinite),
              self._state) = self._decode_jit(self.engine.params, self._state)
             self.clock.advance(self.cfg.virtual_decode_step_cost)
+        self.metrics.record_decode_dispatch()
         toks = np.asarray(toks)
         done_now = np.asarray(done_now)
         nonfinite = np.asarray(nonfinite)
@@ -1076,20 +1372,7 @@ class ServingEngine:
             req = self._slots[slot]
             t = int(toks[slot])
             if self._health_shed and nonfinite[slot] > 0:
-                # the unhealthy_slot hook: this slot's logits went
-                # non-finite — its sampled token is poison, its KV rows are
-                # suspect. Shed the request with a reason (the admission-
-                # control discipline: fail loudly, never stream garbage) and
-                # free + deactivate the slot.
-                self.metrics.record_shed("unhealthy_slot")
-                self.metrics.record_unhealthy()
-                self.tracer.instant(
-                    "request/unhealthy", cat="serving", ts=now,
-                    request_id=req.request_id, trace_id=req.trace_id,
-                    nonfinite_logits=int(nonfinite[slot]))
-                self._finish(req, FINISH_UNHEALTHY, now, deactivate=True)
-                events.append(TokenEvent(req.request_id, -1, len(req.tokens),
-                                         True, FINISH_UNHEALTHY, now))
+                self._shed_unhealthy(req, events, now, int(nonfinite[slot]))
                 continue
             req.tokens.append(t)
             self.metrics.record_tokens(1)
@@ -1111,6 +1394,21 @@ class ServingEngine:
             events.append(TokenEvent(req.request_id, t, len(req.tokens) - 1,
                                      True, reason, now))
 
+    def _shed_unhealthy(self, req, events, now, n_bad):
+        """The unhealthy_slot hook, shared by the decode and verify paths:
+        this slot's logits went non-finite — its sampled token is poison,
+        its KV rows are suspect. Shed the request with a reason (the
+        admission-control discipline: fail loudly, never stream garbage)
+        and free + deactivate the slot."""
+        self.metrics.record_shed("unhealthy_slot")
+        self.metrics.record_unhealthy()
+        self.tracer.instant("request/unhealthy", cat="serving", ts=now,
+                            request_id=req.request_id,
+                            trace_id=req.trace_id, nonfinite_logits=n_bad)
+        self._finish(req, FINISH_UNHEALTHY, now, deactivate=True)
+        events.append(TokenEvent(req.request_id, -1, len(req.tokens),
+                                 True, FINISH_UNHEALTHY, now))
+
     def _finish(self, req, reason, now, deactivate=False):
         """``deactivate``: the device doesn't know this slot finished (host-
         side stop policy) — clear its active flag so decode stops advancing
@@ -1121,6 +1419,8 @@ class ServingEngine:
         if req.slot is not None:
             del self._slots[req.slot]
             self._free_slots.append(req.slot)
+            if self._drafter is not None:
+                self._drafter.release(req.slot)
             if self.paged:
                 # ALWAYS release under paging: the table row must retreat
                 # to the garbage block before the allocator reuses the
@@ -1154,7 +1454,13 @@ class ServingEngine:
                             replay_tokens=req.replay_tokens,
                             padding_tokens=req.padding_tokens,
                             prefix_saved_tokens=req.prefix_saved_tokens,
-                            kv_blocks_peak=req.kv_blocks_peak)
+                            kv_blocks_peak=req.kv_blocks_peak,
+                            # speculative accounting: the wide event's
+                            # drafted/accepted/rolled_back counts reconcile
+                            # with the fleet counters (tier-1-pinned)
+                            drafted_tokens=req.drafted_tokens,
+                            accepted_tokens=req.accepted_tokens,
+                            rolled_back_tokens=req.rolled_back_tokens)
 
     # ------------------------------------------------------------- frontends
     def serve(self, requests=None, yield_rejections=True):
@@ -1225,6 +1531,10 @@ class ServingEngine:
         self._scrub_jit = None
         self._fresh_cache_jit = None
         self._grow_jit = None
+        self._verify_jit = None
+        if self._drafter is not None and hasattr(self._drafter, "destroy"):
+            self._drafter.destroy()
+        self._drafter = None
         self._prefill_programs = OrderedDict()
         self._suffix_programs = OrderedDict()
         self._prefill_jobs = collections.deque()

@@ -106,6 +106,9 @@ class KVPoolManager:
         self.scrubbed_blocks = 0
         self.grown_blocks = 0       # on-demand-growth allocations mid-decode
         self.preempted_requests = 0  # preempt-to-queue on pool exhaustion
+        # speculative rollback: grown blocks released because every row
+        # they held belonged to rejected draft candidates
+        self.rolled_back_blocks = 0
         self._scrub = None          # engine-installed per-block scrub hook
         # admission-time reservations not yet consumed by a slot insert:
         # chunked prefill opens a multi-step window between can_admit and
@@ -225,8 +228,25 @@ class KVPoolManager:
         self.grown_blocks += 1
         return bid
 
+    def shrink_slot(self, slot, live_tokens):
+        """Speculative rollback under on-demand growth: drop the slot's
+        LAST bound block — it lies entirely past the rolled-back cursor,
+        so every row it holds belongs to rejected draft candidates. The
+        block returns to the allocator on its last-ref drop (and is
+        scrubbed there when the hygiene scrub is armed); the caller must
+        already have retreated the slot's table entry to the garbage
+        block."""
+        bid = self._slot_blocks[slot].pop()
+        self._slot_tokens[slot] = int(live_tokens)
+        self.rolled_back_blocks += 1
+        self._unref(bid)
+
     def slot_block_count(self, slot):
         return len(self._slot_blocks.get(slot, ()))
+
+    def slot_block(self, slot, j):
+        """Physical block id at table column ``j`` of ``slot``."""
+        return self._slot_blocks[slot][j]
 
     # -- shared prefixes ---------------------------------------------------
     def _candidate_keys(self, prompt, limit):
@@ -318,5 +338,6 @@ class KVPoolManager:
             "scrubbed_blocks": self.scrubbed_blocks,
             "grown_blocks": self.grown_blocks,
             "preempted_requests": self.preempted_requests,
+            "rolled_back_blocks": self.rolled_back_blocks,
             "reserved_blocks": self._pending,
         }

@@ -119,6 +119,19 @@ class ServingMetrics:
         self.padding_tokens = 0
         self.prefix_saved_tokens = 0
         self.decode_tokens = 0
+        # speculative decoding (serving/speculative.py): candidate tokens
+        # drafted, accepted by the one-forward verify, and rolled back,
+        # plus the dispatch counter accepted_tokens_per_step is measured
+        # against (decode + verify program dispatches — the denominator of
+        # the ">1 effective decode tokens per step" claim). Armed by the
+        # engine when serving.speculative is enabled (gates the
+        # Serving/spec_* monitor events).
+        self.speculative_armed = False
+        self.drafted_tokens = 0
+        self.accepted_tokens = 0
+        self.rolled_back_tokens = 0
+        self.verify_steps = 0
+        self.decode_dispatches = 0
 
     # -- recording ----------------------------------------------------------
     def _mark_started(self):
@@ -146,6 +159,14 @@ class ServingMetrics:
         self.padding_tokens = 0
         self.prefix_saved_tokens = 0
         self.decode_tokens = 0
+        # the speculative window restarts with the goodput window: the
+        # accepted-tokens-per-step ratio must cover the same steps as its
+        # decode_tokens numerator
+        self.drafted_tokens = 0
+        self.accepted_tokens = 0
+        self.rolled_back_tokens = 0
+        self.verify_steps = 0
+        self.decode_dispatches = 0
         # recorded so trace readers know the live digests no longer cover
         # the whole trace (fleet_report downgrades its digest-coherence
         # gate to informational when a reset happened mid-run)
@@ -216,6 +237,47 @@ class ServingMetrics:
 
     def record_decode_tokens(self, n):
         self.decode_tokens += int(n)
+
+    def record_decode_dispatch(self):
+        """One decode-program dispatch (plain decode OR speculative
+        verify): the denominator of ``accepted_tokens_per_step``."""
+        self.decode_dispatches += 1
+
+    def record_draft(self, n):
+        self.drafted_tokens += int(n)
+
+    def record_accept(self, accepted, rejected):
+        self.accepted_tokens += int(accepted)
+        self.rolled_back_tokens += int(rejected)
+
+    def record_verify_step(self):
+        self.verify_steps += 1
+
+    @property
+    def accept_rate(self):
+        """Accepted / drafted candidate tokens (0.0 before any draft)."""
+        return self.accepted_tokens / self.drafted_tokens \
+            if self.drafted_tokens else 0.0
+
+    @property
+    def accepted_tokens_per_step(self):
+        """Decode tokens emitted per decode-program dispatch (verify steps
+        included) — strictly > 1 exactly when acceptance is doing work:
+        the speculative multiplier on effective decode throughput."""
+        return self.decode_tokens / self.decode_dispatches \
+            if self.decode_dispatches else 0.0
+
+    def speculative_snapshot(self):
+        return {
+            "drafted_tokens": self.drafted_tokens,
+            "accepted_tokens": self.accepted_tokens,
+            "rolled_back_tokens": self.rolled_back_tokens,
+            "verify_steps": self.verify_steps,
+            "decode_dispatches": self.decode_dispatches,
+            "accept_rate": round(self.accept_rate, 4),
+            "accepted_tokens_per_step": round(
+                self.accepted_tokens_per_step, 4),
+        }
 
     def record_health_step(self, n_bad_slots):
         """Once per decode step (or poisoned prefill): how many ACTIVE
@@ -317,6 +379,7 @@ class ServingMetrics:
                 name + "_ms": d.percentiles_ms()
                 for name, d in self.latency_digests().items()},
             "goodput": self.goodput_snapshot(),
+            "speculative": self.speculative_snapshot(),
             "slo": self.slo_eval(),
             "steps": self.steps,
             "queue_depth": self._queue_depth,
@@ -360,6 +423,13 @@ class ServingMetrics:
                 ("Serving/prefix_hit_rate", float(kv["prefix_hit_rate"]),
                  self.steps),
             ]
+        if self.speculative_armed:
+            # coherent with snapshot()["speculative"] by construction (the
+            # PR 4 trace==metrics discipline, asserted tier-1)
+            events.append(("Serving/spec_accept_rate",
+                           float(self.accept_rate), self.steps))
+            events.append(("Serving/spec_accepted_tokens_per_step",
+                           float(self.accepted_tokens_per_step), self.steps))
         p50 = percentile(self.ttft_samples, 50)
         if p50 is not None:
             events.append(("Serving/ttft_ms", p50 * 1e3, self.steps))

@@ -111,6 +111,14 @@ class Request:
     prefix_saved_tokens: int = 0
     chunks: int = 0
     kv_blocks_peak: int = 0
+    # speculative decoding (serving/speculative.py): candidate tokens the
+    # drafter proposed for this request, how many were accepted by the
+    # one-forward verify, and how many rolled back — emitted verbatim in
+    # the request/finish instant so the fleet wide event reconciles with
+    # the Serving/spec_* counters
+    drafted_tokens: int = 0
+    accepted_tokens: int = 0
+    rolled_back_tokens: int = 0
 
     def __post_init__(self):
         self.prompt = np.asarray(self.prompt, np.int32).reshape(-1)

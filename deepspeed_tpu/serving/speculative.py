@@ -1,0 +1,266 @@
+"""Speculative decoding drafters (the proposal half of the subsystem).
+
+Classic speculative decoding (arXiv:2211.17192) on the serving stack: a
+cheap DRAFTER proposes up to ``k`` continuation tokens per greedy slot, the
+target model verifies all of them in ONE forward over k+1 positions against
+the paged KV cache (``models/decoding.py:verify_with_paged_cache``, wired
+into the slot pool by ``serving/engine.py``), and the longest prefix whose
+drafts equal the target's own argmax is accepted. Everything accepted IS
+the target's greedy stream — the drafter only decides how many tokens each
+dispatch may yield, never which tokens, so greedy parity with ``generate()``
+holds for ANY drafter (tier-1 pins it, including a deliberately-wrong one).
+
+Two drafters:
+
+- **NgramDrafter** (prompt lookup, zero extra weights): match the last
+  ``ngram`` tokens of the request's own prompt+generated history against
+  earlier history and propose the continuation of the most recent match.
+  Free, host-side, and strong exactly where speculation pays — repetitive
+  spans (quotes, code, structured output, cycles).
+- **ModelDrafter**: a small draft model sharing the target's mesh (separate
+  params, its own tiny dense per-slot KV cache). Proposals run as one
+  jitted k-step scan; history catch-up (tokens the target emitted since the
+  last proposal) feeds through one single-token program. Both programs
+  compile exactly once (tier-1 pins the census); proposal rows written past
+  the synced cursor are overwritten by the next catch-up, so a rejected
+  draft path needs no device-side rollback here either.
+
+The drafter interface is deliberately host-level: ``propose`` sees each
+slot's full token history and returns candidate arrays. The engine owns
+eligibility (greedy slots only, tokens still owed, block coverage) and all
+acceptance/rollback bookkeeping.
+"""
+
+import numpy as np
+
+_EMPTY = np.zeros((0,), np.int32)
+
+
+class Drafter:
+    """Interface. ``propose`` maps ``{slot: (history, cap)}`` — history =
+    prompt + every generated token, cap = max useful candidates — to
+    ``{slot: np.ndarray[int32]}`` (slots with nothing to propose omitted).
+    ``release`` is called whenever a slot stops running (finish, preempt,
+    unhealthy shed) so stateful drafters drop/resync their per-slot state.
+    """
+
+    name = "?"
+
+    def propose(self, wanted):
+        raise NotImplementedError
+
+    def release(self, slot):
+        pass
+
+    def compile_counts(self):
+        return {}
+
+
+class NgramDrafter(Drafter):
+    """Prompt-lookup drafting: the request's own history is the draft model.
+
+    Deterministic and stateless — a preemption/resume or a mid-run
+    speculation toggle cannot perturb anything, because there is nothing
+    to perturb."""
+
+    name = "ngram"
+
+    def __init__(self, cfg):
+        self.n = int(cfg.ngram)
+        self.k = int(cfg.k)
+
+    def propose(self, wanted):
+        out = {}
+        for slot, (hist, cap) in wanted.items():
+            d = self._lookup(np.asarray(hist, np.int64), min(cap, self.k))
+            if d.size:
+                out[slot] = d
+        return out
+
+    def _lookup(self, hist, cap):
+        n = self.n
+        if cap < 1 or len(hist) < n + 2:
+            return _EMPTY
+        pattern = hist[-n:]
+        # windows over hist[:-1]: every match has >= 1 continuation token,
+        # and the trailing occurrence of the pattern itself is excluded
+        windows = np.lib.stride_tricks.sliding_window_view(hist[:-1], n)
+        idx = np.flatnonzero(np.all(windows == pattern[None, :], axis=1))
+        if idx.size == 0:
+            return _EMPTY
+        i = int(idx[-1])  # most recent earlier occurrence
+        return hist[i + n:i + n + cap].astype(np.int32)
+
+
+class ModelDrafter(Drafter):
+    """Draft-model drafting: a small transformer sharing the target's mesh.
+
+    Separate params (``speculative.draft_model`` TransformerConfig
+    overrides over a 1-layer copy of the target; vocab/max_seq_len pinned),
+    a dense per-slot KV cache of its own, and a host-side per-slot cursor
+    ``_pos`` = history positions ingested. Catch-up (history the target
+    emitted since the last proposal, or the whole prompt at a slot's first
+    proposal) feeds through ONE multi-token ingest program —
+    ``INGEST_BLOCK`` positions per dispatch, so a fresh long prompt costs
+    O(len / block) dispatches, not O(len). A proposal then feeds the last
+    history token at the cursor and scans k argmax steps WITHOUT advancing
+    the cursor — the speculated rows are overwritten by the next catch-up
+    (accepted tokens re-feed the same positions; the causal mask hides the
+    rest), which is the draft-side rollback for free."""
+
+    name = "model"
+    # catch-up tokens fed per ingest dispatch (shapes the ingest program;
+    # per-slot shortfall pads with dead writes at positions the slot will
+    # overwrite at its own next real feed)
+    INGEST_BLOCK = 32
+
+    def __init__(self, serving):
+        import dataclasses
+
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ..models.decoding import forward_with_cache, init_cache
+        from ..models.layers import Param, split_params_axes
+        from ..models.transformer import CausalLM
+        from ..parallel import MODEL_AXIS
+        from ..parallel.sharding import named, param_partition_specs
+
+        engine = serving.engine
+        cfg = serving.cfg.speculative
+        self.k = int(cfg.k)
+        self.n_slots = serving.n_slots
+        self.max_len = serving.max_len
+        tgt = engine.module.config
+        overrides = dict(cfg.draft_model or {})
+        # vocab and position space MUST match the target: drafts are target
+        # token ids at target positions
+        overrides.pop("vocab_size", None)
+        overrides.pop("max_seq_len", None)
+        dcfg = dataclasses.replace(tgt, n_layers=1, **overrides)
+        self.model = CausalLM(dcfg)
+        mesh = engine.mesh
+        rng = jax.random.PRNGKey(int(cfg.draft_seed))
+        params_shape = jax.eval_shape(self.model.init, rng)
+        axes = jax.tree_util.tree_map(
+            lambda p: p.axes if isinstance(p, Param)
+            else (None,) * len(p.shape),
+            params_shape, is_leaf=lambda x: isinstance(x, Param))
+        shapes = jax.tree_util.tree_map(
+            lambda p: tuple((p.value if isinstance(p, Param) else p).shape),
+            params_shape, is_leaf=lambda x: isinstance(x, Param))
+        specs = param_partition_specs(axes, shapes, mesh, zero_stage=0)
+        shardings = named(mesh, specs)
+        init_fn = lambda r: jax.tree_util.tree_map(
+            lambda a: (a.value if isinstance(a, Param) else a)
+            .astype(engine.dtype),
+            self.model.init(r), is_leaf=lambda x: isinstance(x, Param))
+        with mesh:
+            self.params = jax.jit(init_fn, out_shardings=shardings)(rng)
+        kv_axis = MODEL_AXIS if dcfg.kv_heads % max(engine.mp_world_size,
+                                                    1) == 0 else None
+        cache_sharding = NamedSharding(mesh, P(None, None, None, kv_axis,
+                                               None))
+        rep = NamedSharding(mesh, P())
+        self._cache = jax.device_put(
+            init_cache(dcfg, self.n_slots, self.max_len, engine.dtype),
+            {"k": cache_sharding, "v": cache_sharding})
+        self._pos = np.zeros((self.n_slots,), np.int64)
+
+        model, max_len, k = self.model, self.max_len, self.k
+
+        def ingest(params, cache, toks, pos):
+            # catch-up: INGEST_BLOCK tokens per slot at its draft cursor,
+            # one dispatch. Per-slot shortfall/idle rows write garbage at
+            # positions their own next real feed overwrites; the reverse
+            # row order keeps window-clamped pad writes from shadowing a
+            # real row (same discipline as the verify program)
+            _, cache = forward_with_cache(model, params, toks, cache, pos,
+                                          max_len, row_writes="reverse")
+            return cache
+
+        def propose(params, cache, tok, pos):
+            # k argmax steps as ONE dispatch; cursor advance is in-graph
+            # only — the host cursor stays at the synced point, so the
+            # speculated rows are rolled back by simply being overwritten
+            def step(carry, _):
+                cache, tok, pos = carry
+                logits, cache = forward_with_cache(
+                    model, params, tok[:, None], cache, pos, max_len)
+                nxt = jnp.argmax(logits[:, 0].astype(jnp.float32),
+                                 axis=-1).astype(jnp.int32)
+                return (cache, nxt, pos + 1), nxt
+
+            (cache, _, _), toks = jax.lax.scan(step, (cache, tok, pos),
+                                               length=k)
+            return jnp.transpose(toks), cache  # [S, k]
+
+        with mesh:
+            self._ingest_jit = jax.jit(
+                ingest, donate_argnums=(1,),
+                out_shardings={"k": cache_sharding, "v": cache_sharding})
+            self._propose_jit = jax.jit(
+                propose, donate_argnums=(1,),
+                out_shardings=(rep, {"k": cache_sharding,
+                                     "v": cache_sharding}))
+
+    def release(self, slot):
+        # resync from scratch at the slot's next proposal: the cache rows
+        # are stale-but-masked, the cursor reset makes them unreachable
+        # until overwritten
+        self._pos[slot] = 0
+
+    def propose(self, wanted):
+        import jax.numpy as jnp
+
+        ib = self.INGEST_BLOCK
+        # catch-up rounds: INGEST_BLOCK tokens per dispatch until every
+        # wanted slot has ingested history[:-1] (usually one round of 1-k
+        # tokens — what the target emitted since the last proposal; a
+        # slot's FIRST proposal ingests its whole prompt in len/IB rounds)
+        while True:
+            feed = np.zeros((self.n_slots, ib), np.int32)
+            counts = np.zeros((self.n_slots,), np.int64)
+            for slot, (hist, _cap) in wanted.items():
+                pending = hist[self._pos[slot]:len(hist) - 1][:ib]
+                feed[slot, :len(pending)] = pending
+                counts[slot] = len(pending)
+            if not counts.any():
+                break
+            self._cache = self._ingest_jit(
+                self.params, self._cache, jnp.asarray(feed),
+                jnp.asarray(self._pos, jnp.int32))
+            self._pos += counts
+        tok = np.zeros((self.n_slots,), np.int32)
+        for slot, (hist, _cap) in wanted.items():
+            tok[slot] = hist[-1]
+        toks, self._cache = self._propose_jit(
+            self.params, self._cache, jnp.asarray(tok),
+            jnp.asarray(self._pos, jnp.int32))
+        toks = np.asarray(toks)
+        out = {}
+        for slot, (_hist, cap) in wanted.items():
+            cap = min(cap, self.k)
+            if cap > 0:
+                out[slot] = toks[slot, :cap].astype(np.int32)
+        return out
+
+    def compile_counts(self):
+        size = lambda f: f._cache_size() if f is not None else 0
+        return {"draft_ingest": size(self._ingest_jit),
+                "draft_propose": size(self._propose_jit)}
+
+    def destroy(self):
+        self.params = None
+        self._cache = None
+        self._ingest_jit = None
+        self._propose_jit = None
+
+
+def build_drafter(serving):
+    """Drafter for the serving engine's ``serving.speculative`` block."""
+    cfg = serving.cfg.speculative
+    if cfg.drafter == "model":
+        return ModelDrafter(serving)
+    return NgramDrafter(cfg)

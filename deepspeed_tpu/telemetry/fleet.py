@@ -85,7 +85,9 @@ def build_wide_events(merged_events):
             "finish_reason": None, "prompt_len": None, "n_tokens": None,
             "chunks": 0, "preemptions": 0, "replay_tokens": 0,
             "padding_tokens": 0, "prefix_saved_tokens": 0,
-            "kv_blocks_peak": 0, "queue_wait": None, "admit_wait": None,
+            "kv_blocks_peak": 0, "drafted_tokens": 0,
+            "accepted_tokens": 0, "rolled_back_tokens": 0,
+            "queue_wait": None, "admit_wait": None,
             "ttft": None,
             "tpot": None, "breakdown": None,
             "_start": None, "_first": None, "_finish": None,
@@ -139,7 +141,9 @@ def build_wide_events(merged_events):
             for k in ("finish_reason", "n_tokens", "prompt_len",
                       "queue_wait", "admit_wait", "chunks", "preemptions",
                       "replay_tokens", "padding_tokens",
-                      "prefix_saved_tokens", "kv_blocks_peak"):
+                      "prefix_saved_tokens", "kv_blocks_peak",
+                      "drafted_tokens", "accepted_tokens",
+                      "rolled_back_tokens"):
                 src = "reason" if k == "finish_reason" else k
                 if args.get(src) is not None:
                     r[k] = args[src]

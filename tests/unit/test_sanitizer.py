@@ -471,6 +471,53 @@ def test_serving_prefill_chunked_within_sanitizer_budget(
     assert san["summary"].get("baked_const_bytes", 0) == 0
 
 
+@pytest.fixture(scope="module")
+def verify_report(devices8):
+    """tools/program_lint.py --program verify geometry: the speculative
+    one-forward verify program (k+1 positions per slot against the paged
+    pool, drafts/draft_len traced) held to the checked-in
+    serving-verify/8/bf16 budget — the fence for the speculative-decoding
+    subsystem, enforced tier-1 alongside the decode/prefill gates."""
+    import jax.numpy as jnp
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models import CausalLM, TransformerConfig
+
+    model = CausalLM(TransformerConfig(
+        vocab_size=512, max_seq_len=64, n_layers=4, n_heads=4,
+        d_model=128, d_ff=256, compute_dtype=jnp.bfloat16))
+    engine = deepspeed_tpu.init_inference(
+        model=model,
+        config={"dtype": "bfloat16", "max_tokens": 64,
+                "serving": {"n_slots": 4, "max_len": 64,
+                            "virtual_clock": True,
+                            "kv_pool": {"enabled": True,
+                                        "block_size": 16},
+                            "speculative": {"enabled": True, "k": 4}}})
+    report = engine.verify_program_report()
+    yield report
+    engine.destroy()
+
+
+def test_serving_verify_within_sanitizer_budget(verify_report):
+    from deepspeed_tpu.profiling.collectives import check_budgets
+
+    v = check_budgets(verify_report, BUDGETS["serving-verify/8/bf16"])
+    assert not v, v
+    san = verify_report["sanitizer"]
+    assert count_at_or_above(san["findings"], "warning") == 0
+    # the donation pin speculation depends on: the verify step holds ONE
+    # copy of the paged pool state (same 12-leaf census as the paged
+    # decode program — pool k/v + block table + cursors/rng/knobs), with
+    # zero host transfers and the drafts/draft_len TRACED (one compiled
+    # program per k, no recompile per draft mix)
+    assert san["summary"]["n_aliased_params"] == 12
+    assert san["summary"]["undonated_candidate_bytes"] == 0
+    assert san["summary"]["transfer_count"] == 0
+    assert san["summary"].get("python_scalar_args", 0) == 0
+    assert san["summary"].get("baked_const_bytes", 0) == 0
+
+
 def test_serving_decode_slot_state_fully_donated(decode_report):
     """The donation discipline the slot pool depends on: every state leaf
     (KV pool, cursors, rng, sampling knobs — 11 arrays) aliases an output,

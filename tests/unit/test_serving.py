@@ -407,7 +407,8 @@ def test_bench_serving_qps_smoke(tmp_path, paged):
     if paged:
         cmd += ["--paged", "--kv-block-size", "8", "--shared-prefix", "8",
                 "--replicas", "2", "--chunk-size", "8",
-                "--session-affinity"]
+                "--session-affinity", "--spec-draft", "ngram",
+                "--spec-k", "4"]
     proc = subprocess.run(cmd, cwd=REPO, env=env, capture_output=True,
                           text=True, timeout=300)
     assert proc.returncode == 0, proc.stdout + proc.stderr
@@ -437,6 +438,14 @@ def test_bench_serving_qps_smoke(tmp_path, paged):
         assert art["replicas"] == 2
         assert router["session_hits"] > 0  # sticky sessions engaged
         assert len(art["compile_counts_per_replica"]) == 2
+        # speculative block next to percentiles/slo/goodput: the ngram
+        # drafter ran, acceptance reconciles, and the verify program is in
+        # the per-replica compile census
+        spec = art["speculative"]
+        assert spec["drafter"] == "ngram" and spec["spec_k"] == 4
+        assert spec["drafts"] == spec["accepted"] + spec["rollbacks"]
+        assert 0.0 <= spec["accept_rate"] <= 1.0
+        assert art["compile_counts"].get("verify", 0) <= 1
         kv = art["kv_pool"]
         assert kv["n_blocks"] > 1 and kv["block_size"] == 8
         assert 0.0 <= kv["occupancy"] <= 1.0
@@ -445,3 +454,5 @@ def test_bench_serving_qps_smoke(tmp_path, paged):
         assert sum(kv["shed_reasons"].values()) == art["shed"]
     else:
         assert "kv_pool" not in art  # dense path unchanged
+        assert art["speculative"]["drafter"] == "off"
+        assert art["speculative"]["drafts"] == 0

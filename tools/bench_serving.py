@@ -172,6 +172,14 @@ def run_open_loop(args):
     if args.chunk_size:
         serving_kw["chunked_prefill"] = {"enabled": True,
                                          "chunk_size": args.chunk_size}
+    if args.spec_draft:
+        if not args.paged:
+            print("--spec-draft requires --paged (speculative rollback "
+                  "rides the block machinery)", file=sys.stderr)
+            return 1
+        serving_kw["speculative"] = {"enabled": True,
+                                     "drafter": args.spec_draft,
+                                     "k": args.spec_k}
     if args.slo_ttft_p99_ms or args.slo_tpot_p99_ms:
         serving_kw["slo"] = {"ttft_p99_ms": args.slo_ttft_p99_ms,
                              "tpot_p99_ms": args.slo_tpot_p99_ms}
@@ -233,6 +241,30 @@ def run_open_loop(args):
         agg_shed["all_replicas_saturated"] = \
             agg_shed.get("all_replicas_saturated", 0) + n_sat
 
+    # speculative block, fleet-aggregated: how many candidate tokens were
+    # drafted, accepted and rolled back, and the effective decode tokens
+    # per dispatch they bought (the multiplier headline)
+    spec_keys = ("drafted_tokens", "accepted_tokens", "rolled_back_tokens",
+                 "verify_steps", "decode_dispatches")
+    agg_spec = {k: sum(r["speculative"][k]
+                       for r in router_snap["replicas"]) for k in spec_keys}
+    agg_dec = sum(r["goodput"]["decode_tokens"]
+                  for r in router_snap["replicas"])
+    speculative = {
+        "drafter": args.spec_draft or "off",
+        "spec_k": args.spec_k if args.spec_draft else 0,
+        "drafts": agg_spec["drafted_tokens"],
+        "accepted": agg_spec["accepted_tokens"],
+        "rollbacks": agg_spec["rolled_back_tokens"],
+        "verify_steps": agg_spec["verify_steps"],
+        "accept_rate": round(agg_spec["accepted_tokens"]
+                             / agg_spec["drafted_tokens"], 4)
+        if agg_spec["drafted_tokens"] else 0.0,
+        "accepted_tokens_per_step": round(
+            agg_dec / agg_spec["decode_dispatches"], 4)
+        if agg_spec["decode_dispatches"] else 0.0,
+    }
+
     # unhealthy_slot sheds come back FINISHED too — keep their latencies
     # out of the artifact, same partition ServingMetrics enforces
     from deepspeed_tpu.serving import FINISH_UNHEALTHY
@@ -280,6 +312,7 @@ def run_open_loop(args):
         "percentiles": router_snap["percentiles"],
         "slo": router_snap["slo"],
         "goodput": router_snap["goodput"],
+        "speculative": speculative,
         # numerics self-incrimination next to the run stamp: a throughput
         # number earned while slots were shedding non-finite logits (or
         # steps were silently unhealthy) carries its own evidence —
@@ -311,6 +344,7 @@ def run_open_loop(args):
         "chunk_size": args.chunk_size,
         "session_affinity": bool(args.session_affinity),
         "kv_growth": bool(args.kv_growth),
+        "spec_draft": args.spec_draft, "spec_k": args.spec_k,
         "slo_ttft_p99_ms": args.slo_ttft_p99_ms,
         "slo_tpot_p99_ms": args.slo_tpot_p99_ms})
     print(json.dumps(artifact), flush=True)
@@ -367,6 +401,14 @@ def main():
                     help="paged pool reserves prompt blocks only and grows "
                          "decode blocks on demand (preempt-to-queue on "
                          "exhaustion)")
+    ap.add_argument("--spec-draft", default="", choices=["", "ngram", "model"],
+                    help="speculative decoding (requires --paged): drafter "
+                         "proposing up to --spec-k tokens per greedy slot, "
+                         "verified in ONE target forward; the artifact "
+                         "gains a speculative block (accept_rate, "
+                         "accepted_tokens_per_step, drafts, rollbacks)")
+    ap.add_argument("--spec-k", type=int, default=4,
+                    help="max draft tokens per verify step")
     ap.add_argument("--slo-ttft-p99-ms", type=float, default=0.0,
                     help="open-loop mode: serving.slo TTFT P99 target (ms; "
                          "0 = no objective) — the artifact's slo block "

@@ -140,6 +140,49 @@ def lint_prefill_chunked(args):
     return report
 
 
+def lint_verify(args):
+    """The speculative-decoding verify program (serving/engine.py): one
+    target forward over ``--spec-k`` + 1 positions per slot against the
+    donated paged pool state, drafts and per-slot draft lengths traced —
+    the program every verify step dispatches. Gate with
+    ``--budget serving-verify/8/bf16``."""
+    import jax.numpy as jnp
+
+    if REPO not in sys.path:
+        sys.path.insert(0, REPO)
+    from scale_projection import PRESETS
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models import CausalLM, TransformerConfig
+
+    preset = dict(PRESETS[args.preset])
+    max_len = args.serving_max_len or preset["seq"]
+    model = CausalLM(TransformerConfig(
+        vocab_size=preset["vocab_size"], max_seq_len=max_len,
+        n_layers=preset["n_layers"], n_heads=preset["n_heads"],
+        d_model=preset["d_model"], d_ff=preset["d_ff"],
+        compute_dtype=jnp.bfloat16))
+    serving = {"n_slots": args.slots, "max_len": max_len,
+               "virtual_clock": True,
+               "kv_pool": {"enabled": True,
+                           "block_size": args.kv_block_size,
+                           "kv_dtype": args.kv_dtype},
+               "speculative": {"enabled": True, "k": args.spec_k}}
+    engine = deepspeed_tpu.init_inference(
+        model=model,
+        config={"dtype": "bfloat16", "max_tokens": max_len,
+                "serving": serving})
+    report = engine.verify_program_report(args.spec_k)
+    report.update({"preset": args.preset, "devices": args.devices,
+                   "n_slots": args.slots, "serving_max_len": max_len,
+                   "spec_k": args.spec_k,
+                   "kv_block_size": args.kv_block_size,
+                   "n_params": engine.module.num_parameters
+                   if hasattr(engine.module, "num_parameters") else None})
+    engine.destroy()
+    return report
+
+
 def _planted_program(clean=False):
     """A small program with one planted defect per sanitizer rule (or its
     clean twin): f32 dot leak, missing donation, host transfer, replicated
@@ -261,6 +304,8 @@ def child(args):
         programs["decode"] = lint_decode(args)
     if args.program in ("prefill-chunked", "all"):
         programs["prefill-chunked"] = lint_prefill_chunked(args)
+    if args.program in ("verify", "all"):
+        programs["verify"] = lint_verify(args)
     if args.program == "planted":
         programs["planted"] = _planted_program(clean=False)
     if args.program == "clean":
@@ -275,8 +320,8 @@ def child(args):
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--program", default="all",
-                    choices=["train", "decode", "prefill-chunked", "all",
-                             "planted", "clean"])
+                    choices=["train", "decode", "prefill-chunked", "verify",
+                             "all", "planted", "clean"])
     ap.add_argument("--preset", default="tiny-test")
     ap.add_argument("--devices", type=int, default=8)
     ap.add_argument("--micro", type=int, default=1)
@@ -297,6 +342,9 @@ def main():
     ap.add_argument("--chunk-size", type=int, default=16,
                     help="chunked-prefill chunk (tokens) the "
                          "prefill-chunked program is linted at")
+    ap.add_argument("--spec-k", type=int, default=4,
+                    help="draft tokens per verify step the speculative "
+                         "verify program is linted at (--program verify)")
     ap.add_argument("--budget", default=None,
                     help="key into tools/collective_budgets.json; applies "
                          "to every linted program, violations exit 2")
@@ -332,7 +380,8 @@ def main():
            "--grad-reduce-dtype", args.grad_reduce_dtype,
            "--slots", str(args.slots),
            "--kv-block-size", str(args.kv_block_size),
-           "--chunk-size", str(args.chunk_size)]
+           "--chunk-size", str(args.chunk_size),
+           "--spec-k", str(args.spec_k)]
     if args.paged:
         cmd += ["--paged"]
     if args.kv_dtype:
