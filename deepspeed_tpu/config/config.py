@@ -324,6 +324,15 @@ class KVPoolConfig(ConfigModel):
     # queue (resuming bitwise-identical) instead of OOM/shed. False = the
     # PR 7 whole-footprint reservation.
     on_demand_growth: bool = False
+    # decode-attention backend. "gather" (default): per-layer dense view of
+    # the pool through the block table, then the unchanged dense attention.
+    # "fused": the split-KV flash-decode Pallas kernel
+    # (ops/pallas/paged_attention.py) walks the block table IN-KERNEL — no
+    # dense view is materialized. Shape-probed at engine construction
+    # (fused_decode_supported); unsupported shapes warn once and fall back
+    # to "gather". Prefill/insert/speculative-verify always run the gather
+    # machinery either way.
+    attention_backend: str = "gather"
 
     def _validate(self):
         if self.block_size < 1:
@@ -335,6 +344,10 @@ class KVPoolConfig(ConfigModel):
         if self.kv_dtype not in ("", "int8"):
             raise ConfigError(
                 f"kv_pool.kv_dtype must be '' or 'int8', got {self.kv_dtype!r}")
+        if self.attention_backend not in ("gather", "fused"):
+            raise ConfigError(
+                f"kv_pool.attention_backend must be 'gather' or 'fused', "
+                f"got {self.attention_backend!r}")
 
 
 class ChunkedPrefillConfig(ConfigModel):

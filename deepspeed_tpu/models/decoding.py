@@ -129,10 +129,17 @@ def _paged_writeback(kc, sc, view, table, pos, block_size, valid=None):
     redirected to the reserved garbage block 0 (speculative verify's padded
     draft rows — they can lie past the slot's bound blocks or the KV window,
     and a clamped block index would silently corrupt a REAL block)."""
-    s_dim = pos.shape[0]
     rows = jax.vmap(
         lambda c, p: jax.lax.dynamic_slice(
             c, (p, 0, 0), (1,) + c.shape[1:]))(view, pos)[:, 0]  # [S, kvh, dh]
+    return _paged_writeback_rows(kc, sc, rows, table, pos, block_size,
+                                 valid=valid)
+
+
+def _paged_writeback_rows(kc, sc, rows, table, pos, block_size, valid=None):
+    """``_paged_writeback`` for callers that already hold the fresh
+    [S, kvh, dh] rows (the fused kernel path never materializes a view to
+    slice them from)."""
     j = jnp.clip(pos // block_size, 0, table.shape[1] - 1)
     bi = jnp.take_along_axis(table, j[:, None], axis=1)[:, 0]
     if valid is not None:
@@ -146,8 +153,52 @@ def _paged_writeback(kc, sc, view, table, pos, block_size, valid=None):
     return kc.at[bi, off].set(rows.astype(kc.dtype)), None
 
 
+def _project_qkv(cfg, p_attn, h, rope=None):
+    """The q/k/v projection + rotary application shared by every cached
+    attention path — ONE implementation, so the fused paged backend can
+    never diverge from the gather/dense path's projection semantics (the
+    bitwise-parity contract starts here)."""
+    b, q_len, _ = h.shape
+    q = L.linear_apply(p_attn["q"], h).reshape(b, q_len, cfg.n_heads,
+                                               cfg.head_dim)
+    k = L.linear_apply(p_attn["k"], h).reshape(b, q_len, cfg.kv_heads,
+                                               cfg.head_dim)
+    v = L.linear_apply(p_attn["v"], h).reshape(b, q_len, cfg.kv_heads,
+                                               cfg.head_dim)
+    if rope is not None:
+        cos, sin = rope
+        q = L.apply_rotary(q, cos, sin, cfg.rotary_dim,
+                           cfg.rotary_interleaved)
+        k = L.apply_rotary(k, cos, sin, cfg.rotary_dim,
+                           cfg.rotary_interleaved)
+    return q, k, v
+
+
+def _attn_paged_fused(cfg, p_attn, h, kc, vc, ks, vs, table, pos, rope=None):
+    """The fused-backend twin of ``_attn_with_cache`` for paged decode
+    (q_len == 1): project q/k/v for the current token, then attend straight
+    against the POOL through the split-KV flash-decode kernel — the block
+    table walks inside the kernel's index map, so no dense per-slot view is
+    ever materialized. Returns ``(out [S, 1, d], k_row, v_row)`` with the
+    fresh [S, kvh, dh] rows for the caller's pool writeback (the kernel
+    already folded them into the softmax in compute dtype, exactly the
+    value the gather path attends at the cursor)."""
+    from ..ops.pallas.paged_attention import paged_flash_decode
+
+    b, q_len, _ = h.shape
+    q, k, v = _project_qkv(cfg, p_attn, h, rope=rope)
+    slopes = L.alibi_slopes(cfg.n_heads) \
+        if cfg.position_embedding == "alibi" else None
+    out = paged_flash_decode(q[:, 0], k[:, 0], v[:, 0], kc, vc, table, pos,
+                             k_scale=ks, v_scale=vs, scale=cfg.attn_scale,
+                             alibi_slopes=slopes)
+    out = L.linear_apply(p_attn["o"], out.reshape(b, q_len, -1))
+    return out, k[:, 0], v[:, 0]
+
+
 def forward_with_paged_cache(model, params, input_ids, pool, table, pos,
-                             block_size, draft_len=None):
+                             block_size, draft_len=None,
+                             attention_backend="gather"):
     """One decode step ([S, 1] tokens) reading/writing KV through a TRACED
     block table — the paged twin of ``forward_with_cache``'s per-row decode.
 
@@ -167,11 +218,27 @@ def forward_with_paged_cache(model, params, input_ids, pool, table, pos,
     is inside the KV window — padded rows compute garbage that the causal
     mask hides in-view and whose pool writeback redirects to the garbage
     block, and the in-view writes run in reverse row order so a
-    window-clamped padded write can never shadow a real row."""
+    window-clamped padded write can never shadow a real row.
+
+    ``attention_backend="fused"`` replaces the per-layer gather + dense
+    attention + scatter with the split-KV flash-decode kernel
+    (``ops/pallas/paged_attention.py``): the block-table walk happens
+    inside the kernel's index map and the dense per-slot view is never
+    materialized. Decode-only (q_len == 1, no verify) — callers gate on
+    ``fused_decode_supported`` and fall back to the gather path."""
     cfg = model.config
     b, q_len = input_ids.shape
     int8 = "k_scale" in pool
     view_dtype = cfg.compute_dtype
+    fused = attention_backend == "fused"
+    if fused and (draft_len is not None or q_len != 1):
+        raise ValueError(
+            "attention_backend='fused' is decode-only (one query row per "
+            "slot); speculative verify runs the gather path")
+    if fused and cfg.local_attention_window > 0:
+        raise ValueError(
+            "attention_backend='fused' does not implement banded local-"
+            "attention masks (fused_decode_supported gates this)")
     positions = pos[:, None] + jnp.arange(q_len)[None, :]
     kv_len = table.shape[1] * block_size
     if draft_len is not None:
@@ -194,6 +261,19 @@ def forward_with_paged_cache(model, params, input_ids, pool, table, pos,
                                   cfg.rope_base)
 
     def block_step(h, p_i, kc, vc, ks, vs, loc):
+        if fused:
+            def attn_impl(p_attn, hh):
+                return _attn_paged_fused(cfg, p_attn, hh, kc, vc, ks, vs,
+                                         table, pos, rope=rope)
+
+            h, k_row, v_row = _block_cached(cfg, p_i, h, None, None, pos,
+                                            kv_len, rope=rope,
+                                            attn_impl=attn_impl)
+            kc, ks = _paged_writeback_rows(kc, ks, k_row, table, pos,
+                                           block_size)
+            vc, vs = _paged_writeback_rows(vc, vs, v_row, table, pos,
+                                           block_size)
+            return h, kc, vc, ks, vs
         kview = _paged_view(kc, ks, table, view_dtype)
         vview = _paged_view(vc, vs, table, view_dtype)
         h, kview, vview = _block_cached(cfg, p_i, h, kview, vview, pos,
@@ -351,15 +431,7 @@ def _attn_with_cache(cfg, p_attn, h, k_cache, v_cache, pos, kv_len, rope=None,
     """
     b, q_len, d = h.shape
     per_row = jnp.ndim(pos) == 1
-    q = L.linear_apply(p_attn["q"], h).reshape(b, q_len, cfg.n_heads, cfg.head_dim)
-    k = L.linear_apply(p_attn["k"], h).reshape(b, q_len, cfg.kv_heads, cfg.head_dim)
-    v = L.linear_apply(p_attn["v"], h).reshape(b, q_len, cfg.kv_heads, cfg.head_dim)
-    if rope is not None:
-        cos, sin = rope
-        q = L.apply_rotary(q, cos, sin, cfg.rotary_dim,
-                           cfg.rotary_interleaved)
-        k = L.apply_rotary(k, cos, sin, cfg.rotary_dim,
-                           cfg.rotary_interleaved)
+    q, k, v = _project_qkv(cfg, p_attn, h, rope=rope)
 
     if per_row:
         # each row writes its q block at its OWN cursor (slot-pool decode);
@@ -474,8 +546,15 @@ def _mlp(cfg, p, h):
 
 
 def _block_cached(cfg, p, x, k_cache, v_cache, pos, kv_len, rope=None,
-                  is_local=None, prefill=False, row_writes="block"):
-    """One block with cache. x: [b, q, d] compute dtype."""
+                  is_local=None, prefill=False, row_writes="block",
+                  attn_impl=None):
+    """One block with cache. x: [b, q, d] compute dtype.
+
+    ``attn_impl(p_attn_cast, h) -> (out, aux1, aux2)`` overrides the dense
+    ``_attn_with_cache`` (the fused paged backend routes the flash-decode
+    kernel through here so the norm/residual/MLP structure — and therefore
+    parity with the gather path — is shared by construction); the two aux
+    values replace the (k_cache, v_cache) return slots."""
     cast = lambda a: a.astype(cfg.compute_dtype) \
         if jnp.issubdtype(a.dtype, jnp.floating) else a
     p_cast = {
@@ -486,6 +565,8 @@ def _block_cached(cfg, p, x, k_cache, v_cache, pos, kv_len, rope=None,
     }
 
     def attn(h):
+        if attn_impl is not None:
+            return attn_impl(p_cast["attn"], h)
         return _attn_with_cache(cfg, p_cast["attn"], h, k_cache, v_cache, pos,
                                 kv_len, rope=rope, is_local=is_local,
                                 prefill=prefill, row_writes=row_writes)

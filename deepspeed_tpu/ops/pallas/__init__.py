@@ -1,0 +1,12 @@
+"""Pallas TPU kernels.
+
+Shared jax-version shim: jax < 0.5 spells the Mosaic params class
+``TPUCompilerParams``; newer jax renamed it ``CompilerParams``. Every kernel
+module imports the resolved name from here so the next rename is a one-line
+fix instead of four.
+"""
+
+from jax.experimental.pallas import tpu as _pltpu
+
+CompilerParams = getattr(_pltpu, "CompilerParams", None) \
+    or getattr(_pltpu, "TPUCompilerParams")

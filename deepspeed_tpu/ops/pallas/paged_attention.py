@@ -1,0 +1,331 @@
+"""Split-KV paged flash-decode Pallas kernel: decode attention that walks the
+block table IN-KERNEL.
+
+The serving decode hot path is 1 query row per slot against a long KV window
+stored as a paged pool (``serving/kv_pool.py``: ``[n_blocks, block_size, kvh,
+dh]`` physical blocks + a per-slot block table). The gather path
+(``models/decoding.py:_paged_view``) materializes a dense per-slot view of
+that pool per layer — correct and compile-once, but pure transient HBM
+traffic: every decode step writes (and immediately re-reads) an
+``[S, NB*bs, kvh, dh]`` tensor whose only purpose is to look like the dense
+cache. This kernel deletes that view: the block-table indirection happens in
+the BlockSpec index map (scalar-prefetched table + cursors, so the DMA
+engine chases ``table[s, j]`` directly), and the online-softmax inner loop
+masks each slot's ragged cursor in-register — DeepSpeed-Inference's fused
+decode attention play (arXiv:2207.00032), TPU-native.
+
+Shape/structure notes (the TPU way, same idioms as
+``ops/pallas/flash_attention.py``):
+
+- grid = (slots, kv_heads, kv_splits, blocks_per_split). The kv-head
+  dimension rides the grid so GQA costs nothing: each cell runs the
+  ``n_heads // kv_heads`` query rows of ONE kv head against that head's
+  slice of the pool — the q block is ``[hq, dh]``, dense in the MXU.
+- split-KV: each of the ``kv_splits`` grid cells owns a contiguous run of
+  table columns and produces a PARTIAL (max, sum, accumulator) triple; the
+  partials combine outside the kernel (a tiny ``[S, kvh, splits, hq]``
+  fp32 reduction) — the FlashDecoding shape, so long contexts parallelize
+  across the split grid instead of serializing one slot's whole window.
+- the freshly-projected k/v row of the CURRENT token never touches the
+  pool before attention: it folds into the softmax during the combine, in
+  compute dtype — exactly the value the gather path attends (the fresh row
+  is written to the view pre-attention there), so int8 pools see the same
+  unquantized current row on both paths and the writeback stays where it
+  was.
+- per-slot cursor masks: a slot's valid pool window is positions
+  ``[0, pos)`` (ragged mid-block cursors included); blocks wholly past the
+  cursor are compute-skipped (``pl.when``) and their DMA lands on whatever
+  block id the table holds there — freed/unbound columns hold the reserved
+  GARBAGE block, so the fetch is always in-range and its values are never
+  read into the softmax.
+- int8 pools dequantize IN-KERNEL: the int8 payload block and its
+  per-(token, head) fp32 scale stream to VMEM natively and the
+  ``payload.astype(f32) * scale`` happens on the tile — elementwise ops
+  identical to ``comm/collectives.py:dequantize_blockwise``, so the fused
+  path reads bit-identical dequantized values, at half the pool HBM
+  traffic of gathering an already-dequantized view.
+
+Tier-1 runs this kernel under ``interpret=True`` on CPU (the same
+discipline as the flash kernels' interpret tests), so correctness — ragged
+cursors, GQA, alibi, int8, garbage-block exclusion — is pinned without
+chips.
+"""
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from . import CompilerParams
+
+NEG_INF = -1e30
+LANES = 128
+
+
+def _fit_splits(requested, n_columns):
+    """Largest split count in [1, requested] dividing ``n_columns`` (the
+    block-table width) — a non-dividing request degrades, never crashes."""
+    s = max(1, min(int(requested), n_columns))
+    while n_columns % s:
+        s -= 1
+    return s
+
+
+def fused_decode_supported(cfg, block_size, *, mp_world_size=1,
+                           backend=None, kv_dtype=""):
+    """Capability probe for the fused backend: ``(ok, reason)``.
+
+    GQA, rope and alibi are supported natively (rope is applied to q/k
+    before the cache, so the pool already holds post-rope keys; alibi is an
+    in-kernel bias; GQA rides the grid). What is NOT:
+
+    - banded local-attention layers (GPT-Neo style): the per-layer band
+      mask isn't implemented in-kernel — the gather path stays correct;
+    - on a real TPU backend, lane/sublane alignment: ``head_dim`` must fill
+      the 128-lane minor dim and ``block_size`` the 8-sublane tile, a
+      model-sharded mesh needs the gather path (``pallas_call`` carries no
+      SPMD partitioning rule, so GSPMD would replicate the pool), and int8
+      pools stay on the gather path until a chip session validates the
+      per-(token, head) scale tiles' (bs, 1) layout under Mosaic — the
+      probe must never approve a shape the compiler then rejects, or the
+      warn-and-fall-back contract becomes a hard failure at first
+      dispatch. Interpret mode (every non-TPU backend, which is how tier-1
+      pins the kernel on CPU) has none of these constraints.
+    """
+    if cfg.local_attention_window > 0:
+        return False, ("local_attention_window > 0: banded layer masks are "
+                       "not implemented in the fused kernel")
+    if cfg.n_heads % cfg.kv_heads:
+        return False, (f"n_heads {cfg.n_heads} not a multiple of kv_heads "
+                       f"{cfg.kv_heads}")
+    backend = backend if backend is not None else jax.default_backend()
+    if backend == "tpu":
+        if cfg.head_dim % LANES:
+            return False, (f"head_dim {cfg.head_dim} not a multiple of the "
+                           f"{LANES}-lane minor dim (TPU)")
+        if block_size % 8:
+            return False, (f"kv_pool.block_size {block_size} not a multiple "
+                           "of the 8-sublane tile (TPU)")
+        if mp_world_size > 1:
+            return False, ("tensor-parallel mesh: pallas_call has no SPMD "
+                           "partitioning rule — the gather path shards the "
+                           "kv-head axis instead")
+        if kv_dtype == "int8":
+            return False, ("kv_dtype=int8 on TPU: the in-kernel dequant's "
+                           "per-(token, head) scale tiles are not yet "
+                           "chip-validated under Mosaic — gather path "
+                           "until a live-TPU session clears them")
+    return True, ""
+
+
+def _decode_kernel(table_ref, pos_ref, q_ref, k_ref, v_ref, *rest, scale,
+                   block_size, blocks_per_split, int8, alibi,
+                   m_prev_bcast):
+    """One (slot, kv_head, split, block) cell: stream one physical block,
+    fold it into the split's running (m, l, acc) triple, emit the partial
+    at the split's last block. ``table_ref``/``pos_ref`` are the
+    scalar-prefetched block table and cursors (the index maps already used
+    them to aim the DMA; the body re-reads the cursor for the mask)."""
+    idx = 0
+    if int8:
+        ks_ref, vs_ref = rest[idx], rest[idx + 1]
+        idx += 2
+    slopes_ref = None
+    if alibi:
+        slopes_ref = rest[idx]
+        idx += 1
+    o_ref, m_ref, l_ref, m_scr, l_scr, acc_scr = rest[idx:]
+
+    s = pl.program_id(0)
+    jb = pl.program_id(3)
+
+    @pl.when(jb == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    pos = pos_ref[s]                       # valid pool window = [0, pos)
+    sp = pl.program_id(2)
+    base = (sp * blocks_per_split + jb) * block_size
+
+    @pl.when(base < pos)
+    def _step():
+        # the allowlisted attention-f32 island (see sanitizer
+        # ATTENTION_F32_ALLOW): QK logits and the PV accumulator run fp32
+        # on purpose — softmax numerics — with narrow dot INPUTS (the
+        # MXU's native mode, same as the flash kernels)
+        with jax.named_scope("paged_flash_decode"):
+            q = q_ref[0, 0]                # [hq, dh]
+            k = k_ref[0, :, 0]             # [bs, dh]
+            v = v_ref[0, :, 0]
+            if int8:
+                # dequantize ON the tile — elementwise-identical to
+                # dequantize_blockwise (f32 payload * per-(token,head)
+                # scale, then the compute-dtype cast the gather view takes)
+                k = (k.astype(jnp.float32) * ks_ref[0, :, 0]).astype(q.dtype)
+                v = (v.astype(jnp.float32) * vs_ref[0, :, 0]).astype(q.dtype)
+            sc = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * scale  # [hq, bs] f32
+            col = jax.lax.broadcasted_iota(jnp.int32, sc.shape, 1)
+            if alibi:
+                # slopes * (kv_pos - cursor): the same int-difference-then-
+                # fp32-multiply as the gather path's per-row alibi
+                dist = (base + col - pos).astype(jnp.float32)
+                sc = sc + slopes_ref[0][:, None] * dist
+            sc = jnp.where(base + col < pos, sc, NEG_INF)
+            m_prev = m_scr[:, :1]
+            m_new = jnp.maximum(m_prev, jnp.max(sc, axis=-1, keepdims=True))
+            p = jnp.exp(sc - m_new)
+            corr = jnp.exp(m_prev - m_new)
+            l_scr[...] = l_scr[...] * corr + jnp.broadcast_to(
+                jnp.sum(p, axis=-1, keepdims=True), l_scr.shape)
+            acc_scr[...] = acc_scr[...] * corr + jax.lax.dot_general(
+                p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
+
+    @pl.when(jb == blocks_per_split - 1)
+    def _emit():
+        # partials, not normalized output: splits with no valid positions
+        # emit (m=-inf, l=0, acc=0) and drop out of the combine exactly
+        o_ref[0, 0, 0] = acc_scr[...]
+        m_ref[0, 0, 0] = m_scr[...][:, :m_prev_bcast]
+        l_ref[0, 0, 0] = l_scr[...][:, :m_prev_bcast]
+
+
+def paged_flash_decode(q, k_new, v_new, kc, vc, table, pos, *, k_scale=None,
+                       v_scale=None, scale=None, alibi_slopes=None,
+                       kv_splits=4, interpret=None):
+    """Fused paged decode attention: softmax(q·K/√d)·V for ONE query row per
+    slot, where K/V live in the paged pool and the kernel walks the block
+    table itself.
+
+    - ``q``: [S, n_heads, dh] (compute dtype) — this step's query rows;
+    - ``k_new``/``v_new``: [S, kvh, dh] — the freshly-projected k/v of the
+      current token (NOT yet in the pool; logically at position ``pos[s]``,
+      folded into the softmax in compute dtype during the combine);
+    - ``kc``/``vc``: [n_blocks, block_size, kvh, dh] — one layer of the
+      pool (int8 payloads when ``k_scale``/``v_scale`` [n_blocks, bs, kvh,
+      1] f32 are given: dequantized in-kernel);
+    - ``table``: [S, NB] int32 physical block ids (scalar-prefetched: the
+      index map reads it to aim each block DMA — no dense view exists);
+    - ``pos``: [S] int32 cursors; pool positions [0, pos) are attended,
+      everything past the cursor (ragged mid-block tails, unbound
+      garbage-block columns) is masked/skipped.
+
+    Returns [S, n_heads, dh] in ``q.dtype``.
+    """
+    s_dim, n_heads, dh = q.shape
+    n_blocks, block_size, kvh, _ = kc.shape
+    nb_cols = table.shape[1]
+    hq = n_heads // kvh
+    scale = scale if scale is not None else 1.0 / math.sqrt(dh)
+    int8 = k_scale is not None
+    alibi = alibi_slopes is not None
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    splits = _fit_splits(kv_splits, nb_cols)
+    bps = nb_cols // splits
+    grid = (s_dim, kvh, splits, bps)
+    qr = q.reshape(s_dim, kvh, hq, dh)
+
+    def kv_index(s, g, sp, jb, table_ref, pos_ref):
+        # THE point of the kernel: the block-table indirection lives here.
+        # Unbound columns hold the reserved garbage block — always a valid
+        # pool row, compute-skipped in the body.
+        return (table_ref[s, sp * bps + jb], 0, g, 0)
+
+    def q_index(s, g, sp, jb, table_ref, pos_ref):
+        return (s, g, 0, 0)
+
+    def out_index(s, g, sp, jb, table_ref, pos_ref):
+        return (s, g, sp, 0, 0)
+
+    in_specs = [
+        pl.BlockSpec((1, 1, hq, dh), q_index),
+        pl.BlockSpec((1, block_size, 1, dh), kv_index),
+        pl.BlockSpec((1, block_size, 1, dh), kv_index),
+    ]
+    operands = [qr, kc, vc]
+    if int8:
+        in_specs += [pl.BlockSpec((1, block_size, 1, 1), kv_index),
+                     pl.BlockSpec((1, block_size, 1, 1), kv_index)]
+        operands += [k_scale, v_scale]
+    if alibi:
+        slopes = jnp.asarray(alibi_slopes, jnp.float32).reshape(kvh, hq)
+        in_specs.append(pl.BlockSpec(
+            (1, hq), lambda s, g, sp, jb, t, p: (g, 0)))
+        operands.append(slopes)
+
+    # the m/l partials keep a LANES-broadcast minor dim in scratch (TPU vreg
+    # layout; see flash_attention.py). Interpret mode emits a single lane to
+    # HBM; a real TPU emits the full broadcast — a 1-lane minor output dim
+    # is a layout Mosaic tiling commonly rejects, and the probe must never
+    # approve a shape the compiler then refuses
+    stat_lanes = 1 if interpret else LANES
+    out_shape = [
+        jax.ShapeDtypeStruct((s_dim, kvh, splits, hq, dh), jnp.float32),
+        jax.ShapeDtypeStruct((s_dim, kvh, splits, hq, stat_lanes),
+                             jnp.float32),
+        jax.ShapeDtypeStruct((s_dim, kvh, splits, hq, stat_lanes),
+                             jnp.float32),
+    ]
+    out_specs = [
+        pl.BlockSpec((1, 1, 1, hq, dh), out_index),
+        pl.BlockSpec((1, 1, 1, hq, stat_lanes), out_index),
+        pl.BlockSpec((1, 1, 1, hq, stat_lanes), out_index),
+    ]
+
+    kernel = functools.partial(
+        _decode_kernel, scale=scale, block_size=block_size,
+        blocks_per_split=bps, int8=int8, alibi=alibi,
+        m_prev_bcast=stat_lanes)
+    acc, m_p, l_p = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=grid,
+            in_specs=in_specs,
+            out_specs=out_specs,
+            scratch_shapes=[
+                pltpu.VMEM((hq, LANES), jnp.float32),
+                pltpu.VMEM((hq, LANES), jnp.float32),
+                pltpu.VMEM((hq, dh), jnp.float32),
+            ],
+        ),
+        out_shape=out_shape,
+        compiler_params=CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
+        interpret=interpret,
+    )(table, pos, *operands)
+
+    # -- combine across the split-KV grid (tiny fp32 reduction) ------------
+    m_p = m_p[..., 0]                                    # [S, kvh, sp, hq]
+    l_p = l_p[..., 0]
+    m_c = jnp.max(m_p, axis=2)                           # [S, kvh, hq]
+    w = jnp.exp(m_p - m_c[:, :, None, :])                # empty splits -> 0
+    l_c = jnp.sum(l_p * w, axis=2)
+    acc_c = jnp.sum(acc * w[..., None], axis=2)          # [S, kvh, hq, dh]
+
+    # -- fold the CURRENT token's fresh k/v row (compute dtype, position
+    # pos — the row the gather path writes into the view pre-attention;
+    # alibi distance is 0 there). Elementwise mul+sum, not a dot: this is
+    # [S, kvh, hq] of work, VPU noise.
+    qf = qr.astype(jnp.float32)
+    s_new = jnp.sum(qf * k_new.astype(jnp.float32)[:, :, None, :],
+                    axis=-1) * scale                     # [S, kvh, hq]
+    m_t = jnp.maximum(m_c, s_new)
+    corr = jnp.exp(m_c - m_t)
+    w_new = jnp.exp(s_new - m_t)
+    l_t = l_c * corr + w_new
+    acc_t = acc_c * corr[..., None] \
+        + w_new[..., None] * v_new.astype(jnp.float32)[:, :, None, :]
+    out = acc_t / jnp.maximum(l_t, 1e-30)[..., None]
+    return out.reshape(s_dim, n_heads, dh).astype(q.dtype)

@@ -34,6 +34,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from . import CompilerParams as _CompilerParams
+
 
 def _int8_kernel(x_ref, q_ref, s_ref, o_ref, *, n_groups, dot_dtype):
     kb = pl.program_id(1)
@@ -151,7 +153,7 @@ def quantized_matmul(x, q, scale, *, bits, block_k=512, block_n=512,
         grid=grid,
         out_specs=out_spec,
         out_shape=out_shape,
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "arbitrary")),
         interpret=interpret,
     )

@@ -93,6 +93,29 @@ class ServingEngine:
         self.paged = bool(self.cfg.kv_pool.enabled)
         self.pool_mgr = KVPoolManager(self.cfg.kv_pool, self.n_slots,
                                       self.max_len) if self.paged else None
+        # decode-attention backend: "dense" (no paging), "gather" (dense
+        # per-slot view through the block table), or "fused" (the split-KV
+        # flash-decode kernel walks the table in-kernel). A requested
+        # "fused" is shape-probed ONCE here; unsupported shapes warn and
+        # fall back to the gather path — serving never hard-fails on a
+        # kernel constraint.
+        self.attn_backend = "dense"
+        if self.paged:
+            self.attn_backend = self.cfg.kv_pool.attention_backend
+            if self.attn_backend == "fused":
+                from ..ops.pallas.paged_attention import \
+                    fused_decode_supported
+
+                ok, reason = fused_decode_supported(
+                    engine.module.config, self.pool_mgr.block_size,
+                    mp_world_size=max(engine.mp_world_size, 1),
+                    kv_dtype=self.cfg.kv_pool.kv_dtype)
+                if not ok:
+                    log_dist(
+                        "ServingEngine: kv_pool.attention_backend='fused' "
+                        f"unsupported for this shape ({reason}); falling "
+                        "back to the gather path", ranks=[0])
+                    self.attn_backend = "gather"
         if self.paged and self.cfg.scrub_freed_slots:
             # block-granularity scrub: zero each physical block as its last
             # reference drops (the dense pool's whole-row scrub generalized)
@@ -138,7 +161,7 @@ class ServingEngine:
         self.metrics = ServingMetrics(self.n_slots, self.clock,
                                       monitor=monitor,
                                       interval=self.cfg.monitor_interval,
-                                      kv_pool=self.pool_mgr.stats
+                                      kv_pool=self._kv_pool_stats
                                       if self.paged else None,
                                       slo=self.cfg.slo)
         # numerics watchdog (the serving leg of telemetry/health.py): the
@@ -214,6 +237,7 @@ class ServingEngine:
                 f"{mgr.allocatable} blocks x {mgr.block_size} tok = {cap} "
                 f"tokens ({cap / self.max_len:.1f} max-len-equivalent slots"
                 f", kv_dtype={self.cfg.kv_pool.kv_dtype or 'engine'}, "
+                f"attention={self.attn_backend}, "
                 f"prefix_cache={'on' if self.cfg.kv_pool.prefix_cache else 'off'}), "
                 + (f"speculative={self.cfg.speculative.drafter}/k="
                    f"{self.spec_k}, " if self.spec else "")
@@ -222,10 +246,20 @@ class ServingEngine:
                 ranks=[0])
         else:
             log_dist(
-                f"ServingEngine: {self.n_slots} slots x {self.max_len} KV window, "
+                f"ServingEngine: {self.n_slots} slots x {self.max_len} KV window "
+                f"(attention={self.attn_backend}), "
                 f"queue depth {self.cfg.max_queue_depth}, "
                 f"clock={'virtual' if isinstance(self.clock, VirtualClock) else 'wall'}",
                 ranks=[0])
+
+    def _kv_pool_stats(self):
+        """``KVPoolManager.stats()`` + the active attention backend — the
+        kv_pool block every consumer reads (``snapshot()["kv_pool"]``,
+        Serving/* events, bench artifacts), so committed numbers always
+        record WHICH decode path produced them."""
+        st = self.pool_mgr.stats()
+        st["attention_backend"] = self.attn_backend
+        return st
 
     # ------------------------------------------------------------------ state
     def _init_state(self):
@@ -308,6 +342,7 @@ class ServingEngine:
     def _build_pool_programs(self):
         model, max_len = self.engine.module, self.max_len
         paged = self.paged
+        attn_backend = self.attn_backend
         bs = self.pool_mgr.block_size if paged else 0
         pool_keys = ("k", "v", "k_scale", "v_scale") \
             if paged and self.cfg.kv_pool.kv_dtype == "int8" else ("k", "v")
@@ -323,7 +358,7 @@ class ServingEngine:
                 logits, cache = forward_with_paged_cache(
                     model, params, state["tok"][:, None],
                     {k: state[k] for k in pool_keys}, state["table"],
-                    state["pos"], bs)
+                    state["pos"], bs, attention_backend=attn_backend)
             else:
                 logits, cache = forward_with_cache(
                     model, params, state["tok"][:, None],

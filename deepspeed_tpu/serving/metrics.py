@@ -422,6 +422,12 @@ class ServingMetrics:
                  float(kv["capacity_tokens"]), self.steps),
                 ("Serving/prefix_hit_rate", float(kv["prefix_hit_rate"]),
                  self.steps),
+                # which decode-attention path produced these numbers
+                # (1 = the fused paged kernel, 0 = the gather path) —
+                # coherent with snapshot()["kv_pool"]["attention_backend"]
+                ("Serving/kv_attention_fused",
+                 1.0 if kv.get("attention_backend") == "fused" else 0.0,
+                 self.steps),
             ]
         if self.speculative_armed:
             # coherent with snapshot()["speculative"] by construction (the

@@ -424,6 +424,69 @@ def test_serving_decode_paged_within_sanitizer_budget(decode_report_paged):
 
 
 @pytest.fixture(scope="module")
+def decode_report_fused(devices8):
+    """tools/program_lint.py --program decode --paged --attention-backend
+    fused geometry: the PAGED decode program through the split-KV
+    flash-decode kernel (block-table walk IN-KERNEL, no dense per-slot
+    view) held to the checked-in serving-decode-fused/8/bf16 budget —
+    the fence for ROADMAP item 1's fused rewrite, enforced tier-1
+    alongside the gather gate."""
+    import jax.numpy as jnp
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models import CausalLM, TransformerConfig
+
+    model = CausalLM(TransformerConfig(
+        vocab_size=512, max_seq_len=64, n_layers=4, n_heads=4,
+        d_model=128, d_ff=256, compute_dtype=jnp.bfloat16))
+    engine = deepspeed_tpu.init_inference(
+        model=model,
+        config={"dtype": "bfloat16", "max_tokens": 64,
+                "serving": {"n_slots": 4, "max_len": 64,
+                            "virtual_clock": True,
+                            "kv_pool": {"enabled": True,
+                                        "block_size": 16,
+                                        "attention_backend": "fused"}}})
+    assert engine.serving.attn_backend == "fused"
+    report = engine.decode_program_report()
+    yield report
+    engine.destroy()
+
+
+def test_serving_decode_fused_within_sanitizer_budget(decode_report_fused):
+    from deepspeed_tpu.profiling.collectives import check_budgets
+
+    v = check_budgets(decode_report_fused,
+                      BUDGETS["serving-decode-fused/8/bf16"])
+    assert not v, v
+    san = decode_report_fused["sanitizer"]
+    assert count_at_or_above(san["findings"], "warning") == 0
+    # the fused program is held to the SAME donation/transfer fence as the
+    # gather path (pool k/v + block table + cursors/rng/knobs all aliased)
+    assert san["summary"]["n_aliased_params"] == 12
+    assert san["summary"]["undonated_candidate_bytes"] == 0
+    assert san["summary"]["transfer_count"] == 0
+    # the table/cursors ride into the kernel as scalar-prefetch operands,
+    # never as Python scalars: compiles once per (model, pool) config
+    assert san["summary"].get("python_scalar_args", 0) == 0
+
+
+def test_fused_peak_hbm_ceiling_below_gather_budget(decode_report_fused):
+    """The whole point of the kernel is DELETING the dense-view transient:
+    the fused budget's peak-HBM ceiling sits strictly below the gather
+    budget's, and the fused program's liveness estimate fits it. (The
+    view's absence itself — 0 view-shaped gathers in the lowered program —
+    is pinned in test_paged_attention.py.)"""
+    fused_cap = BUDGETS["serving-decode-fused/8/bf16"]["sanitizer"][
+        "peak_hbm_gb_max"]
+    gather_cap = BUDGETS["serving-decode-paged/8/bf16"]["sanitizer"][
+        "peak_hbm_gb_max"]
+    assert fused_cap < gather_cap
+    est = decode_report_fused["sanitizer"]["peak_hbm"]["estimate_bytes"]
+    assert est / 1e9 <= fused_cap
+
+
+@pytest.fixture(scope="module")
 def prefill_chunked_report(devices8):
     """tools/program_lint.py --program prefill-chunked geometry: the chunked
     suffix-prefill program (one full chunk's bucket at a traced start

@@ -5,9 +5,19 @@ that no such number existed). Run on the real chip:
 
     python tools/bench_attention.py            # fwd+bwd train-shape sweep
     BENCH_FWD_ONLY=1 python tools/bench_attention.py
+    BENCH_DECODE=1 python tools/bench_attention.py   # serving decode shapes
 
 Prints one line per (seq, impl): ms/iter and achieved TFLOP/s; causal
 attention flops = 2 * 0.5 * s^2 * d * 3 matmuls fwd (+~2.5x bwd).
+
+``BENCH_DECODE=1`` switches to the serving decode shape — 1 query row per
+slot against a long paged KV window — and A/Bs the three decode-attention
+paths (dense slot-pool read, paged-gather dense view, fused split-KV
+kernel) in bf16 AND int8 across slot counts x context lengths
+(``BENCH_DECODE_SLOTS``/``BENCH_DECODE_CTX``/``BENCH_DECODE_BLOCK``).
+Decode is bandwidth-bound, so the printed GB/s (ideal KV bytes touched /
+measured time) is the number that matters: the gather path pays the dense
+view's write+read on top, the fused kernel streams the pool once.
 """
 
 import os
@@ -15,6 +25,127 @@ import sys
 import time
 
 import numpy as np
+
+
+def decode_main():
+    """Decode-shape sweep (BENCH_DECODE=1): 1 query x long paged KV.
+
+    Impls per (slots, ctx, dtype):
+    - ``dense``  — the dense slot-pool read ([S, max_len] cache +
+      masked attention), the pre-paging baseline;
+    - ``gather`` — the paged gather path (``_paged_view``: dense per-slot
+      view through the block table, then the same attention);
+    - ``fused``  — the split-KV flash-decode kernel walking the table
+      in-kernel (``ops/pallas/paged_attention.py``).
+    """
+    from _common import maybe_force_cpu
+
+    maybe_force_cpu()
+    import jax
+    import jax.numpy as jnp
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from deepspeed_tpu.models import layers as L
+    from deepspeed_tpu.ops.pallas.paged_attention import paged_flash_decode
+
+    h, kvh, dh = 16, 16, 128
+    bs = int(os.environ.get("BENCH_DECODE_BLOCK", 32))
+    slot_counts = [int(s) for s in os.environ.get(
+        "BENCH_DECODE_SLOTS", "4,16").split(",")]
+    ctxs = [int(s) for s in os.environ.get(
+        "BENCH_DECODE_CTX", "1024,4096").split(",")]
+    dtypes = os.environ.get("BENCH_DECODE_DTYPES", "bf16,int8").split(",")
+    n_iter = int(os.environ.get("BENCH_DECODE_ITERS", 16))
+
+    def bench(fn, *args):
+        f = jax.jit(fn)
+        out = f(*args)
+        np.asarray(jax.device_get(out.ravel()[0]))   # fence
+        t0 = time.perf_counter()
+        for _ in range(n_iter):
+            out = f(*args)
+        np.asarray(jax.device_get(out.ravel()[0]))
+        return (time.perf_counter() - t0) / n_iter
+
+    print(f"# decode shapes: h={h} dh={dh} block={bs} "
+          f"backend={jax.default_backend()}")
+    for s_dim in slot_counts:
+        for ctx in ctxs:
+            nb_cols = ctx // bs
+            n_blocks = s_dim * nb_cols + 1
+            rng = np.random.RandomState(0)
+            table = np.arange(1, n_blocks).reshape(s_dim, nb_cols) \
+                .astype(np.int32)
+            pos = np.full((s_dim,), ctx - 1, np.int32)
+            for dt in dtypes:
+                cdt = jnp.bfloat16
+                q = jnp.asarray(rng.randn(s_dim, h, dh), cdt)
+                k_new = jnp.asarray(rng.randn(s_dim, kvh, dh), cdt)
+                v_new = jnp.asarray(rng.randn(s_dim, kvh, dh), cdt)
+                if dt == "int8":
+                    kc = jnp.asarray(rng.randint(
+                        -127, 127, (n_blocks, bs, kvh, dh)), jnp.int8)
+                    vc = jnp.asarray(rng.randint(
+                        -127, 127, (n_blocks, bs, kvh, dh)), jnp.int8)
+                    ks = jnp.asarray(
+                        np.abs(rng.randn(n_blocks, bs, kvh, 1)) * .01,
+                        jnp.float32)
+                    # distinct tensors: aliased k/v scales would let XLA
+                    # cache the duplicate reads and inflate reported GB/s
+                    vs = jnp.asarray(
+                        np.abs(rng.randn(n_blocks, bs, kvh, 1)) * .01,
+                        jnp.float32)
+                    kv_bytes = 2 * n_blocks * bs * kvh * (dh + 4)
+                else:
+                    kc = jnp.asarray(rng.randn(n_blocks, bs, kvh, dh), cdt)
+                    vc = jnp.asarray(rng.randn(n_blocks, bs, kvh, dh), cdt)
+                    ks = vs = None
+                    kv_bytes = 2 * n_blocks * bs * kvh * dh * 2
+                tj, pj = jnp.asarray(table), jnp.asarray(pos)
+
+                def gather(q, kc, vc, tj):
+                    g, gv = kc[tj], vc[tj]
+                    if ks is not None:
+                        g = (g.astype(jnp.float32) * ks[tj]).astype(cdt)
+                        gv = (gv.astype(jnp.float32) * vs[tj]).astype(cdt)
+                    g = g.reshape(s_dim, ctx, kvh, dh)
+                    gv = gv.reshape(s_dim, ctx, kvh, dh)
+                    mask = (jnp.arange(ctx)[None, None, None, :]
+                            <= pj[:, None, None, None])
+                    return L.dot_product_attention(
+                        q[:, None], g, gv, mask=mask)
+
+                def fused(q, kc, vc, tj):
+                    return paged_flash_decode(q, k_new, v_new, kc, vc, tj,
+                                              pj, k_scale=ks, v_scale=vs)
+
+                impls = [("gather", gather), ("fused", fused)]
+                if dt != "int8":
+                    # distinct K and V caches: one aliased array would let
+                    # XLA read the bytes once and double the reported GB/s
+                    dense_k = jnp.asarray(
+                        rng.randn(s_dim, ctx, kvh, dh), cdt)
+                    dense_v = jnp.asarray(
+                        rng.randn(s_dim, ctx, kvh, dh), cdt)
+
+                    def dense(q, kc, vc, tj):
+                        mask = (jnp.arange(ctx)[None, None, None, :]
+                                <= pj[:, None, None, None])
+                        return L.dot_product_attention(
+                            q[:, None], dense_k, dense_v, mask=mask)
+
+                    impls.insert(0, ("dense", dense))
+                for name, fn in impls:
+                    try:
+                        sec = bench(fn, q, kc, vc, tj)
+                        print(f"slots={s_dim:4d} ctx={ctx:6d} {dt:5s} "
+                              f"{name:6s} {sec * 1e3:9.3f} ms "
+                              f"{kv_bytes / sec / 1e9:8.1f} GB/s")
+                    except Exception as e:
+                        print(f"slots={s_dim:4d} ctx={ctx:6d} {dt:5s} "
+                              f"{name:6s} FAILED: {type(e).__name__}: "
+                              f"{str(e)[:90]}")
 
 
 def main():
@@ -146,4 +277,7 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    if os.environ.get("BENCH_DECODE") == "1":
+        decode_main()
+    else:
+        main()

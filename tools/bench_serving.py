@@ -168,7 +168,12 @@ def run_open_loop(args):
         serving_kw["kv_pool"] = {
             "enabled": True, "block_size": args.kv_block_size,
             "n_blocks": args.kv_blocks, "kv_dtype": args.kv_dtype,
-            "on_demand_growth": bool(args.kv_growth)}
+            "on_demand_growth": bool(args.kv_growth),
+            "attention_backend": args.attention_backend}
+    elif args.attention_backend != "gather":
+        print("--attention-backend requires --paged (the fused kernel reads "
+              "the paged pool layout)", file=sys.stderr)
+        return 1
     if args.chunk_size:
         serving_kw["chunked_prefill"] = {"enabled": True,
                                          "chunk_size": args.chunk_size}
@@ -340,6 +345,9 @@ def run_open_loop(args):
         "new_tokens": args.new_tokens, "seed": args.seed,
         "paged": bool(args.paged), "kv_block_size": args.kv_block_size,
         "kv_blocks": args.kv_blocks, "kv_dtype": args.kv_dtype,
+        # the backend that ACTUALLY ran (the probe may have fallen back to
+        # gather) — must agree with the kv_pool block's field
+        "attention_backend": replicas[0].attn_backend,
         "shared_prefix": args.shared_prefix, "replicas": len(replicas),
         "chunk_size": args.chunk_size,
         "session_affinity": bool(args.session_affinity),
@@ -381,6 +389,13 @@ def main():
     ap.add_argument("--kv-blocks", type=int, default=0,
                     help="0 = auto (dense-equivalent token capacity)")
     ap.add_argument("--kv-dtype", default="", choices=["", "int8"])
+    ap.add_argument("--attention-backend", default="gather",
+                    choices=["gather", "fused"],
+                    help="paged decode-attention backend (--paged): 'fused' "
+                         "serves through the split-KV flash-decode kernel; "
+                         "the artifact's kv_pool block records which path "
+                         "produced the numbers (unsupported shapes fall "
+                         "back to gather, also recorded)")
     ap.add_argument("--shared-prefix", type=int, default=0,
                     help="open every prompt with this many IDENTICAL "
                          "system-prompt tokens (exercises the prefix cache)")

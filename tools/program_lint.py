@@ -14,6 +14,8 @@ recompile hazards, and a liveness-walk peak-HBM estimate.
     python tools/program_lint.py --program decode --budget serving-decode/8/bf16
     python tools/program_lint.py --program decode --paged \
         --budget serving-decode-paged/8/bf16 --fail-on warning
+    python tools/program_lint.py --program decode-fused \
+        --budget serving-decode-fused/8/bf16 --fail-on warning
 
     # regression check at headline scale (abstract 256-chip mesh):
     python tools/program_lint.py --program train --preset opt-13b \
@@ -85,7 +87,8 @@ def lint_decode(args):
     if args.paged:
         serving["kv_pool"] = {"enabled": True,
                               "block_size": args.kv_block_size,
-                              "kv_dtype": args.kv_dtype}
+                              "kv_dtype": args.kv_dtype,
+                              "attention_backend": args.attention_backend}
     engine = deepspeed_tpu.init_inference(
         model=model,
         config={"dtype": "bfloat16", "max_tokens": max_len,
@@ -94,6 +97,8 @@ def lint_decode(args):
     report.update({"preset": args.preset, "devices": args.devices,
                    "n_slots": args.slots, "serving_max_len": max_len,
                    "paged": bool(args.paged),
+                   "attention_backend": engine.serving.attn_backend
+                   if args.paged else "dense",
                    "n_params": engine.module.num_parameters
                    if hasattr(engine.module, "num_parameters") else None})
     engine.destroy()
@@ -302,6 +307,12 @@ def child(args):
         programs["train"] = lint_train(args)
     if args.program in ("decode", "all"):
         programs["decode"] = lint_decode(args)
+    if args.program == "decode-fused":
+        # alias: the paged decode program through the fused flash-decode
+        # kernel (== --program decode --paged --attention-backend fused)
+        args.paged = True
+        args.attention_backend = "fused"
+        programs["decode-fused"] = lint_decode(args)
     if args.program in ("prefill-chunked", "all"):
         programs["prefill-chunked"] = lint_prefill_chunked(args)
     if args.program in ("verify", "all"):
@@ -320,8 +331,9 @@ def child(args):
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--program", default="all",
-                    choices=["train", "decode", "prefill-chunked", "verify",
-                             "all", "planted", "clean"])
+                    choices=["train", "decode", "decode-fused",
+                             "prefill-chunked", "verify", "all", "planted",
+                             "clean"])
     ap.add_argument("--preset", default="tiny-test")
     ap.add_argument("--devices", type=int, default=8)
     ap.add_argument("--micro", type=int, default=1)
@@ -339,6 +351,11 @@ def main():
                          "gate with --budget serving-decode-paged/8/bf16")
     ap.add_argument("--kv-block-size", type=int, default=16)
     ap.add_argument("--kv-dtype", default="", choices=["", "int8"])
+    ap.add_argument("--attention-backend", default="gather",
+                    choices=["gather", "fused"],
+                    help="paged decode-attention backend (--paged): 'fused' "
+                         "lints the split-KV flash-decode kernel program — "
+                         "gate with --budget serving-decode-fused/8/bf16")
     ap.add_argument("--chunk-size", type=int, default=16,
                     help="chunked-prefill chunk (tokens) the "
                          "prefill-chunked program is linted at")
@@ -380,6 +397,7 @@ def main():
            "--grad-reduce-dtype", args.grad_reduce_dtype,
            "--slots", str(args.slots),
            "--kv-block-size", str(args.kv_block_size),
+           "--attention-backend", args.attention_backend,
            "--chunk-size", str(args.chunk_size),
            "--spec-k", str(args.spec_k)]
     if args.paged:
