@@ -517,6 +517,35 @@ class SLOConfig(ConfigModel):
         return any(v > 0 for v in self.targets_ms().values())
 
 
+class MigrationConfig(ConfigModel):
+    """Live KV migration (``serving/migration.py``): serialize a running
+    request's physical state — pool blocks (raw pool-dtype bytes + int8
+    scales where applicable), block-table row, cursor, per-slot rng key,
+    sampling knobs, prefix chain keys — into a portable snapshot and splice
+    it into a peer replica through the compiled insert path. The Router
+    uses it three ways: failover after a replica kill, ``drain(idx,
+    migrate=True)``, and cross-replica retry. Migrated streams are bitwise
+    vs stay-put (greedy and seeded sampled)."""
+
+    enabled: bool = True
+    # capture a periodic snapshot every N committed tokens per request
+    # (0 = off): bounds kill-recovery replay to tokens since last snapshot
+    snapshot_interval_tokens: int = 0
+    # virtual-clock cost per migrated block on the TARGET replica (models
+    # the splice DMA; keeps drain-vs-wait comparisons honest)
+    virtual_cost_per_block: float = 0.002
+
+    def _validate(self):
+        if self.snapshot_interval_tokens < 0:
+            raise ConfigError(
+                f"migration.snapshot_interval_tokens must be >= 0, got "
+                f"{self.snapshot_interval_tokens}")
+        if self.virtual_cost_per_block < 0:
+            raise ConfigError(
+                f"migration.virtual_cost_per_block must be >= 0, got "
+                f"{self.virtual_cost_per_block}")
+
+
 class ServingConfig(ConfigModel):
     """Continuous-batching serving (Orca-style slot scheduler over ONE jitted
     decode program; DeepSpeed-Inference's serving-side batching layer,
@@ -567,6 +596,13 @@ class ServingConfig(ConfigModel):
     # speculative decoding: drafter + one-forward verify + rollback-safe
     # greedy acceptance over the paged pool (speculative.enabled)
     speculative: SpeculativeConfig = None
+    # live KV migration: portable request snapshots spliced between
+    # replicas (failover, drain-by-migration, cross-replica retry)
+    migration: MigrationConfig = None
+    # cross-replica retry budget: a request that hits a recoverable
+    # per-replica failure (unhealthy_slot, replica crash) is re-dispatched
+    # to a different replica up to this many times before the terminal shed
+    retry_limit: int = 1
 
     def _validate(self):
         if self.kv_pool is None:
@@ -579,6 +615,11 @@ class ServingConfig(ConfigModel):
             self.slo = SLOConfig()
         if self.speculative is None:
             self.speculative = SpeculativeConfig()
+        if self.migration is None:
+            self.migration = MigrationConfig()
+        if self.retry_limit < 0:
+            raise ConfigError(
+                f"serving.retry_limit must be >= 0, got {self.retry_limit}")
         if self.speculative.enabled and not self.kv_pool.enabled:
             raise ConfigError(
                 "serving.speculative.enabled requires serving.kv_pool."

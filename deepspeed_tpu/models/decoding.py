@@ -409,6 +409,37 @@ def gather_slot_cache(cfg, pool, table_row, dtype):
             "v": gv.reshape(L_, 1, nb * bs, kvh, dh).astype(dtype)}
 
 
+def extract_slot_blocks(pool, table_row):
+    """RAW gather of one slot's physical blocks for live migration: every
+    pool leaf at its stored dtype — k/v payloads (int8 or dense) AND the
+    int8 scales when present — stacked [L, NB, bs, kvh, dh|1] in table-row
+    order. No dequantization: a dequant -> requant round trip reproduces
+    the int8 payload but can perturb the recomputed scale in its last ulp,
+    which would break the migrated-stream-is-bitwise contract. Padded
+    table entries (GARBAGE_BLOCK) gather the garbage block; the injector
+    ignores them via its own id padding."""
+    return {name: a[:, table_row] for name, a in pool.items()}
+
+
+def inject_block_kv(pool, raw_blocks, block_id, src_block):
+    """Copy ONE raw migrated block (``extract_slot_blocks`` payload, pool
+    dtype end to end — scales included) into physical block ``block_id``.
+    ``block_id``/``src_block`` are TRACED scalars, so one compiled program
+    covers every (target, source) pair; padded targets point at the
+    reserved garbage block, same convention as the prefill insert loop.
+    The whole block is overwritten — nothing from its previous occupant
+    survives, and because no quantize/dequantize runs, the target pool
+    bytes are identical to the source pool bytes (the bitwise-migration
+    contract's device half)."""
+    out = dict(pool)
+    for name, a in pool.items():
+        rows = jax.lax.dynamic_slice_in_dim(
+            raw_blocks[name], src_block, 1, axis=1)        # [L,1,bs,kvh,*]
+        out[name] = jax.lax.dynamic_update_slice(
+            a, rows.astype(a.dtype), (0, block_id, 0, 0, 0))
+    return out
+
+
 def _attn_with_cache(cfg, p_attn, h, k_cache, v_cache, pos, kv_len, rope=None,
                      is_local=None, prefill=False, row_writes="block"):
     """Attention for q block [b, q, d] against cache[:, :kv_len] after writing the

@@ -10,11 +10,13 @@ from .clock import VirtualClock, WallClock
 from .engine import ServingEngine
 from .kv_pool import GARBAGE_BLOCK, KVPoolManager, prefix_chain_keys
 from .metrics import ServingMetrics, percentile
+from .migration import RequestSnapshot, advance_rng
 from .queue import RequestQueue
 from .request import (FINISH_EOS, FINISH_LENGTH, FINISH_UNHEALTHY,
                       REJECT_ALL_REPLICAS_SATURATED, REJECT_NO_FREE_BLOCKS,
-                      REJECT_PROMPT_TOO_LONG, REJECT_QUEUE_FULL, Request,
-                      RequestState, SamplingParams, TokenEvent, as_request)
+                      REJECT_PROMPT_TOO_LONG, REJECT_QUEUE_FULL,
+                      REJECT_REPLICA_FAILED, Request, RequestState,
+                      SamplingParams, TokenEvent, as_request)
 from .router import Router, RouterMetrics
 from .scheduler import ServingScheduler, simulate_static_batching
 from .speculative import ModelDrafter, NgramDrafter
@@ -39,6 +41,8 @@ __all__ = [
     "RouterMetrics",
     "NgramDrafter",
     "ModelDrafter",
+    "RequestSnapshot",
+    "advance_rng",
     "prefix_chain_keys",
     "FINISH_EOS",
     "FINISH_LENGTH",
@@ -47,4 +51,5 @@ __all__ = [
     "REJECT_PROMPT_TOO_LONG",
     "REJECT_NO_FREE_BLOCKS",
     "REJECT_ALL_REPLICAS_SATURATED",
+    "REJECT_REPLICA_FAILED",
 ]

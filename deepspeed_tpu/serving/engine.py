@@ -28,14 +28,16 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..config.base import ConfigError
 from ..inference.engine import lru_compiled
-from ..models.decoding import (forward_with_cache, forward_with_paged_cache,
-                               gather_slot_cache, init_cache,
-                               init_paged_cache, insert_block_kv,
-                               insert_slot_kv, reset_block_kv, reset_slot_kv,
-                               sample_token, verify_with_paged_cache)
+from ..models.decoding import (extract_slot_blocks, forward_with_cache,
+                               forward_with_paged_cache, gather_slot_cache,
+                               init_cache, init_paged_cache, inject_block_kv,
+                               insert_block_kv, insert_slot_kv,
+                               reset_block_kv, reset_slot_kv, sample_token,
+                               verify_with_paged_cache)
 from ..utils.logging import log_dist
 from .clock import VirtualClock, WallClock
-from .kv_pool import GARBAGE_BLOCK, KVPoolManager
+from .kv_pool import GARBAGE_BLOCK, KVPoolManager, prefix_chain_keys
+from .migration import RequestSnapshot, advance_rng
 from .metrics import ServingMetrics
 from .queue import RequestQueue
 from .request import (FINISH_EOS, FINISH_LENGTH, FINISH_STOP,
@@ -201,6 +203,7 @@ class ServingEngine:
         self._fresh_cache_jit = None     # chunked: zeroed dense b=1 cache
         self._grow_jit = None            # growth: append one table-row block
         self._verify_jit = None          # speculative: one-forward verify
+        self._migrate_in_jit = None      # int8 migration: raw block splice
         # ONE sharding for the pool state, pinned as out_shardings on every
         # pool program: kv heads over the model axis (TP), everything else
         # replicated. Without the pin, insert and decode outputs would carry
@@ -555,6 +558,24 @@ class ServingEngine:
             return dict(state, **reset_block_kv(
                 {k: state[k] for k in pool_keys}, block_id))
 
+        def migrate_in(state, raw_blocks, block_ids):
+            # live KV migration splice for int8 pools: copy a migrated
+            # request's RAW physical blocks — payload AND scales — into
+            # freshly-allocated pool blocks in ONE dispatch (the fori_loop
+            # mirror of insert_blocks; padding ids point at the garbage
+            # block, so their copy is dead). Raw, never dequantized: a
+            # dequant -> requant round trip can perturb the recomputed
+            # scale in its last ulp (see serving/migration.py). Non-int8
+            # pools migrate through the EXISTING insert_blocks program —
+            # their dense view IS the raw bytes.
+            pool = {k: state[k] for k in pool_keys}
+
+            def body(i, p):
+                return inject_block_kv(p, raw_blocks, block_ids[i], i)
+
+            pool = jax.lax.fori_loop(0, block_ids.shape[0], body, pool)
+            return dict(state, **pool)
+
         def sample_first(logits, key, temp, top_k, top_p):
             # same in-graph guard as decode: the first token samples from
             # prefill logits, which must never stream unchecked
@@ -587,6 +608,9 @@ class ServingEngine:
                     self._verify_jit = jax.jit(
                         verify, donate_argnums=(1,),
                         out_shardings=((rep, rep, rep, rep, rep), st))
+                if self.cfg.kv_pool.kv_dtype == "int8":
+                    self._migrate_in_jit = jax.jit(
+                        migrate_in, donate_argnums=(0,), out_shardings=st)
             else:
                 self._insert_jit = jax.jit(insert, donate_argnums=(0,),
                                            out_shardings=st)
@@ -675,6 +699,8 @@ class ServingEngine:
         if self.paged:
             out["insert_block"] = size(self._insert_block_jit)
             out["seed_cache"] = size(self._seed_cache_jit)
+            if self.cfg.kv_pool.kv_dtype == "int8":
+                out["migrate_in"] = size(self._migrate_in_jit)
         if self.paged or self.chunked or self.growth:
             out["suffix_buckets"] = len(self._suffix_programs)
         if self.growth:
@@ -759,6 +785,9 @@ class ServingEngine:
             else:
                 self._decode_once(events)
             self._decode_steps_since_chunk += 1
+            if self.paged and self._slots and self.cfg.migration.enabled \
+                    and self.cfg.migration.snapshot_interval_tokens > 0:
+                self._maybe_snapshot()
         elif not admitted and not self._prefill_jobs and self.queue.depth:
             # nothing running and the queue head hasn't arrived yet (direct
             # submit with a future arrival offset): idle the clock forward to
@@ -878,6 +907,15 @@ class ServingEngine:
             # the goodput block (work avoided, not part of the frac)
             req.prefix_saved_tokens += shared_len
             self.metrics.prefix_saved_tokens += shared_len
+        if resume and self.paged and req.migration is not None \
+                and self.cfg.migration.enabled \
+                and req.migration.compatible_with(self._pool_geometry()) \
+                and self._splice_snapshot(req, req.migration, ids_full,
+                                          shared_len, shared_blocks):
+            # live KV migration: the snapshot spliced (fresh: straight back
+            # into the decode pool; stale: full blocks landed, only the
+            # tail replays) — the normal replay path below never runs
+            return
         chunk = self.cfg.chunked_prefill.chunk_size
         if resume or (self.chunked and len(ids_full) - shared_len > chunk):
             # multi-step prefill (chunked and/or resume replay): reserve the
@@ -1213,6 +1251,301 @@ class ServingEngine:
         req.kv_blocks_peak = max(req.kv_blocks_peak, len(blocks))
         mgr.register_prefix(req.prompt, blocks)
 
+    # ------------------------------------------------- live KV migration
+    def _pool_leaf_names(self):
+        return ("k", "v", "k_scale", "v_scale") \
+            if self.cfg.kv_pool.kv_dtype == "int8" else ("k", "v")
+
+    def _pool_geometry(self):
+        """The splice-compatibility fingerprint a ``RequestSnapshot``
+        carries: a snapshot only splices into a pool whose physical block
+        layout is identical — anything else falls back to replay-resume."""
+        cfg = self.engine.module.config
+        return (cfg.n_layers, self.pool_mgr.block_size, cfg.kv_heads,
+                cfg.head_dim,
+                str(self.cfg.kv_pool.kv_dtype or np.dtype(self.engine.dtype)))
+
+    def capture_snapshot(self, req):
+        """Serialize a RUNNING request's device state into a portable
+        :class:`RequestSnapshot` (between scheduler steps): the physical
+        pool blocks holding positions ``[0, pos)`` as RAW pool-dtype bytes,
+        the cursor, the per-slot rng chain key, the committed tokens, the
+        sampling knobs, and the prompt's SHA-256 prefix chain keys. Host
+        gathers only — no new compiled program, no device mutation — so a
+        capture can run on any step boundary without perturbing the
+        stay-put stream."""
+        if not self.paged or req.slot is None \
+                or self._slots.get(req.slot) is not req:
+            return None
+        mgr = self.pool_mgr
+        slot = req.slot
+        pos = req.prompt_len + len(req.tokens) - 1  # KV coverage [0, pos)
+        cover = -(-pos // mgr.block_size)           # ceil: blocks holding it
+        nb = min(mgr.slot_block_count(slot), cover)
+        if nb <= 0:
+            return None
+        row = np.asarray([mgr.slot_block(slot, j) for j in range(nb)],
+                         np.int32)
+        raw = {name: np.asarray(self._state[name][:, row])
+               for name in self._pool_leaf_names()}
+        s = req.sampling
+        snap = RequestSnapshot(
+            request_id=req.request_id, prompt=req.prompt, tokens=req.tokens,
+            pos=pos, rng=np.asarray(self._state["rng"])[slot].copy(),
+            blocks=raw, block_size=mgr.block_size,
+            chain_keys=prefix_chain_keys(req.prompt, mgr.block_size),
+            temperature=s.temperature, top_k=s.top_k, top_p=s.top_p,
+            seed=s.seed, max_new_tokens=req.max_new_tokens,
+            eos_token_id=req.eos_token_id, geometry=self._pool_geometry())
+        req.migration = snap
+        self.metrics.record_snapshot()
+        return snap
+
+    def _maybe_snapshot(self):
+        """Periodic snapshot cadence (``serving.migration
+        .snapshot_interval_tokens``): re-capture a running request once it
+        has committed that many tokens past its last snapshot — the bound
+        a replica-kill recovery replays from."""
+        interval = self.cfg.migration.snapshot_interval_tokens
+        for slot in sorted(self._slots):
+            req = self._slots[slot]
+            have = len(req.migration.tokens) \
+                if req.migration is not None else 0
+            if len(req.tokens) - have >= interval:
+                self.capture_snapshot(req)
+
+    def chain_key_for_resume(self, req):
+        """The per-slot rng chain key a replayed request must re-enter with
+        when NO snapshot exists (replica killed before the first cadence
+        capture): re-derive the insert-time chain key deterministically
+        from the request's seed and advance it by the committed decode
+        steps, exactly as the compiled decode would have."""
+        return advance_rng(np.asarray(self._request_key(req)[1]),
+                           len(req.tokens) - 1)
+
+    def _inject_raw(self, snap, blocks, n_shared, n_inject):
+        """The device half of a splice: copy snapshot source blocks
+        ``[n_shared, n_shared + n_inject)`` into the pool blocks of the
+        same index. Non-int8 pools ride the EXISTING compiled
+        insert_blocks program (their dense view is the raw bytes, and the
+        compiled-once pin holds — the dense source is device_put with the
+        same pinned cache sharding prefill outputs carry); int8 pools run
+        the dedicated raw program so payload AND scales move verbatim."""
+        mgr = self.pool_mgr
+        bs = mgr.block_size
+        ids = np.full((mgr.blocks_per_slot,), GARBAGE_BLOCK, np.int32)
+        if self.cfg.kv_pool.kv_dtype == "int8":
+            raw = {}
+            for name, a in snap.blocks.items():
+                pad = np.zeros((a.shape[0], mgr.blocks_per_slot)
+                               + a.shape[2:], a.dtype)
+                pad[:, :a.shape[1]] = a
+                raw[name] = jax.device_put(pad, self._cache_sharding)
+            for i in range(n_shared, n_shared + n_inject):
+                ids[i] = blocks[i]
+            self._state = self._migrate_in_jit(self._state, raw,
+                                               jnp.asarray(ids))
+            return
+        dense = {}
+        for name in ("k", "v"):
+            a = snap.blocks[name]
+            d = np.zeros((a.shape[0], 1, self.max_len) + a.shape[3:],
+                         np.dtype(self.engine.dtype))
+            d[:, 0, :a.shape[1] * bs] = \
+                a.reshape((a.shape[0], -1) + a.shape[3:])
+            dense[name] = jax.device_put(d, self._cache_sharding)
+        srcs = np.zeros((mgr.blocks_per_slot,), np.int32)
+        for i in range(n_shared, n_shared + n_inject):
+            ids[i] = blocks[i]
+            srcs[i] = i * bs
+        self._state = self._insert_block_jit(
+            self._state, dense["k"], dense["v"], jnp.asarray(ids),
+            jnp.asarray(srcs))
+
+    def _splice_snapshot(self, req, snap, ids_full, shared_len,
+                         shared_blocks):
+        """Splice a migrated request's snapshot into this replica instead
+        of replaying it. FRESH snapshot (captured at the current commit
+        point — drain-by-migration): every computed position lands
+        verbatim, including the partial tail block (its garbage rows past
+        the cursor are causally masked, exactly as on the stay-put
+        replica), and the request re-enters the decode pool directly —
+        zero recompute. STALE snapshot (periodic cadence, after a kill):
+        the FULL blocks splice and only the tail since the capture replays
+        through the standard resume-prefill machinery (counted as replay
+        tokens). Prefix-cache hits on the target always win first: blocks
+        the target already shares are taken by reference, never copied.
+        Returns False (no side effects) when the prefix hit already covers
+        the snapshot — the caller falls through to the normal path."""
+        mgr = self.pool_mgr
+        bs = mgr.block_size
+        prefill_len = self._prefill_len(req)
+        n_shared = len(shared_blocks)
+        fresh = snap.pos >= prefill_len
+        cover = min(-(-snap.pos // bs) if fresh else snap.full_blocks,
+                    mgr.blocks_per_slot)
+        if cover <= n_shared:
+            return False
+        delta = len(req.tokens) - len(snap.tokens)
+        self.clock.advance(
+            (cover - n_shared) * self.cfg.migration.virtual_cost_per_block)
+        if fresh:
+            slot = self._free_slots.pop()
+            needed = mgr.blocks_for_prefill(self._growth_admission_len(req)) \
+                if self.growth \
+                else mgr.blocks_for(req.prompt_len, req.max_new_tokens)
+            self._unreserve(req)
+            private = mgr.alloc(needed - n_shared)
+            blocks = list(shared_blocks) + private
+            n_inject = min(cover, len(blocks)) - n_shared
+            self._inject_raw(snap, blocks, n_shared, n_inject)
+            row = np.full((mgr.blocks_per_slot,), GARBAGE_BLOCK, np.int32)
+            row[:len(blocks)] = blocks
+            # committed replicated scalar, same reason as _complete_job:
+            # an uncommitted host scalar would open a second jit-cache
+            # entry and break the insert-compiles-once pin
+            tok = jax.device_put(jnp.asarray(req.tokens[-1], jnp.int32),
+                                 self._rep_sharding)
+            rng = jnp.asarray(advance_rng(snap.rng, delta))
+            s, eos = req.sampling, req.eos_token_id
+            self._state = self._insert_jit(
+                self._state, np.int32(slot), jnp.asarray(row), tok,
+                np.int32(prefill_len),
+                np.int32(req.max_new_tokens - len(req.tokens)), rng,
+                np.float32(s.temperature), np.int32(s.top_k),
+                np.float32(s.top_p), np.int32(-1 if eos is None else eos))
+            mgr.bind_slot(slot, blocks,
+                          self._growth_admission_len(req) if self.growth
+                          else req.prompt_len + req.max_new_tokens - 1)
+            req.kv_blocks_peak = max(req.kv_blocks_peak, len(blocks))
+            mgr.register_prefix(req.prompt, blocks)
+            req.state = RequestState.RUNNING
+            self._slots[slot] = req
+            req.slot = slot
+            if req.admit_seq < 0:
+                req.admit_seq = self._admit_seq
+                self._admit_seq += 1
+            saved = min(cover * bs, snap.pos) - n_shared * bs
+            replay = 0
+        else:
+            n_inject = cover - n_shared
+            # the admission reservation covers these blocks: consume our
+            # own share BEFORE alloc so the target's pending count stays
+            # honest (and never eats another request's reservation)
+            mgr.consume_reservation(min(n_inject, req.reserved_blocks))
+            req.reserved_blocks = max(req.reserved_blocks - n_inject, 0)
+            blocks = list(shared_blocks) + mgr.alloc(n_inject)
+            self._inject_raw(snap, blocks, n_shared, n_inject)
+            slot = self._free_slots.pop()
+            row = np.full((mgr.blocks_per_slot,), GARBAGE_BLOCK, np.int32)
+            row[:len(blocks)] = blocks
+            cache = self._seed_cache_jit(self._state, jnp.asarray(row))
+            # teacher-forced tail: the tokens committed after the capture
+            # replay as prefill, and the rng re-joins the original chain
+            req.resume_rng = advance_rng(snap.rng, delta)
+            self._prefill_jobs.append(_PrefillJob(
+                req=req, slot=slot, cache=cache,
+                ids=np.asarray(ids_full, np.int32), pos=cover * bs,
+                shared_len=cover * bs, shared_blocks=blocks, resume=True))
+            saved = n_inject * bs
+            replay = len(ids_full) - cover * bs
+        if shared_len:
+            # the dedupe win: positions the target's prefix cache already
+            # held, so the splice never re-sent their blocks (a resume
+            # replay is not credited, but a migrated snapshot arriving over
+            # the wire is genuinely avoided transfer + prefill work)
+            req.prefix_saved_tokens += shared_len
+            self.metrics.prefix_saved_tokens += shared_len
+        req.migrations += 1
+        self.metrics.record_migration_in(saved)
+        self.tracer.instant("request/migrated", cat="serving",
+                            ts=self.clock.now(), request_id=req.request_id,
+                            trace_id=req.trace_id, n_tokens=len(req.tokens),
+                            spliced_blocks=n_inject, shared_len=shared_len,
+                            saved_tokens=saved, replay_tokens=replay,
+                            fresh=fresh)
+        return True
+
+    def evacuate(self):
+        """Drain-by-migration: capture a FRESH snapshot of every running
+        request, release its device state, and hand every unfinished
+        request back (original admission order) for re-dispatch on a peer
+        replica — a drained replica restarts with ZERO lost and (when the
+        snapshot splices) zero recomputed tokens. Pending prefill jobs and
+        the queue ride along as-is: their work is not on this device yet
+        beyond the shared prefix."""
+        out = []
+        migration_on = self.paged and self.cfg.migration.enabled
+        for slot in sorted(self._slots,
+                           key=lambda s_: self._slots[s_].admit_seq):
+            req = self._slots[slot]
+            # capture while the slot binding is still live (the ownership
+            # guard in capture_snapshot rejects an unbound request)
+            if migration_on:
+                self.capture_snapshot(req)
+            self._slots.pop(slot)
+            # keep the plain resume path viable too (snapshot may not
+            # splice on the target): the rng at this commit point
+            req.resume_rng = np.asarray(self._state["rng"])[slot].copy()
+            self._state = self._release_jit(self._state, np.int32(slot))
+            if self.paged:
+                self.pool_mgr.free_slot(slot)
+            if self._drafter is not None:
+                self._drafter.release(slot)
+            self._free_slots.append(slot)
+            req.slot = None
+            req.state = RequestState.QUEUED
+            self.metrics.record_migration_out()
+            self.tracer.instant("request/migrated_out", cat="serving",
+                                ts=self.clock.now(),
+                                request_id=req.request_id,
+                                trace_id=req.trace_id,
+                                n_tokens=len(req.tokens),
+                                snapshot=req.migration is not None)
+            out.append(req)
+        for job in list(self._prefill_jobs):
+            req = job.req
+            if self.paged:
+                self.pool_mgr.release_blocks(job.shared_blocks)
+            self._unreserve(req)
+            self._free_slots.append(job.slot)
+            req.slot = None
+            req.state = RequestState.QUEUED
+            out.append(req)
+        self._prefill_jobs.clear()
+        while self.queue.depth:
+            out.append(self.queue.pop())
+        return out
+
+    def abandon_inflight(self):
+        """A killed replica's post-mortem: collect every unfinished request
+        WITHOUT touching the device (the replica is gone — no capture, no
+        release; recovery runs from whatever snapshot the periodic cadence
+        already took, or replays the prompt + committed tokens). Host
+        bookkeeping only: reservations are zeroed ON THE REQUEST — the
+        pool they were pending against died with the replica, and carrying
+        them to a survivor would eat its reservations."""
+        out = []
+        for slot in sorted(self._slots,
+                           key=lambda s_: self._slots[s_].admit_seq):
+            req = self._slots.pop(slot)
+            req.slot = None
+            req.state = RequestState.QUEUED
+            req.reserved_blocks = 0
+            out.append(req)
+        for job in list(self._prefill_jobs):
+            req = job.req
+            req.slot = None
+            req.state = RequestState.QUEUED
+            req.reserved_blocks = 0
+            out.append(req)
+        self._prefill_jobs.clear()
+        while self.queue.depth:
+            req = self.queue.pop()
+            req.reserved_blocks = 0
+            out.append(req)
+        return out
+
     # ------------------------------------------------- speculative decoding
     def set_speculation(self, enabled):
         """Toggle speculation at runtime (drafting is skipped when off; the
@@ -1495,7 +1828,14 @@ class ServingEngine:
                             # with the fleet counters (tier-1-pinned)
                             drafted_tokens=req.drafted_tokens,
                             accepted_tokens=req.accepted_tokens,
-                            rolled_back_tokens=req.rolled_back_tokens)
+                            rolled_back_tokens=req.rolled_back_tokens,
+                            # fleet recovery accounting: completed replica
+                            # moves and the bounded failover/retry budget
+                            # spent (router-owned, but the Request object
+                            # is the same across replicas)
+                            migrations=req.migrations,
+                            failovers=req.failovers,
+                            retries=req.retries)
 
     # ------------------------------------------------------------- frontends
     def serve(self, requests=None, yield_rejections=True):
@@ -1567,6 +1907,7 @@ class ServingEngine:
         self._fresh_cache_jit = None
         self._grow_jit = None
         self._verify_jit = None
+        self._migrate_in_jit = None
         if self._drafter is not None and hasattr(self._drafter, "destroy"):
             self._drafter.destroy()
         self._drafter = None

@@ -132,6 +132,14 @@ class ServingMetrics:
         self.rolled_back_tokens = 0
         self.verify_steps = 0
         self.decode_dispatches = 0
+        # live KV migration (serving/migration.py): snapshots captured on
+        # this replica, requests migrated out (drain) / spliced in, and the
+        # positions a splice restored WITHOUT recompute (work avoided, like
+        # prefix_saved_tokens — reported, not part of the goodput frac)
+        self.kv_snapshots = 0
+        self.migrations_out = 0
+        self.migrations_in = 0
+        self.migrated_saved_tokens = 0
 
     # -- recording ----------------------------------------------------------
     def _mark_started(self):
@@ -279,6 +287,24 @@ class ServingMetrics:
                 self.accepted_tokens_per_step, 4),
         }
 
+    def record_snapshot(self):
+        self.kv_snapshots += 1
+
+    def record_migration_out(self):
+        self.migrations_out += 1
+
+    def record_migration_in(self, saved_tokens=0):
+        self.migrations_in += 1
+        self.migrated_saved_tokens += int(saved_tokens)
+
+    def migration_snapshot(self):
+        return {
+            "kv_snapshots": self.kv_snapshots,
+            "migrations_out": self.migrations_out,
+            "migrations_in": self.migrations_in,
+            "migrated_saved_tokens": self.migrated_saved_tokens,
+        }
+
     def record_health_step(self, n_bad_slots):
         """Once per decode step (or poisoned prefill): how many ACTIVE
         computations produced non-finite logits (freed slots decode garbage
@@ -380,6 +406,7 @@ class ServingMetrics:
                 for name, d in self.latency_digests().items()},
             "goodput": self.goodput_snapshot(),
             "speculative": self.speculative_snapshot(),
+            "migration": self.migration_snapshot(),
             "slo": self.slo_eval(),
             "steps": self.steps,
             "queue_depth": self._queue_depth,

@@ -1,0 +1,107 @@
+"""Live KV migration: portable request snapshots for the serving fleet.
+
+A :class:`RequestSnapshot` is everything a running request needs to continue
+on a DIFFERENT replica, captured between scheduler steps:
+
+- the physical pool blocks holding positions ``[0, pos)`` as RAW pool-dtype
+  bytes — int8 payloads move with their f32 scales instead of being
+  dequantized, because a dequantize -> requantize round trip reproduces the
+  payload but can perturb the recomputed scale in its last ulp, which would
+  break the migrated-stream-is-bitwise contract;
+- the block-table row order (implicit: blocks are stacked in row order);
+- the cursor, the per-slot rng chain key, the committed tokens, and the
+  sampling knobs (the same state tuple PR 12's preempt/resume moves through
+  the queue, plus the device bytes so nothing is recomputed);
+- the prompt's SHA-256 prefix chain keys, so the target replica can dedupe
+  the spliced blocks against its own prefix cache (shared blocks are taken
+  by reference, only the private suffix is copied).
+
+The engine side (``ServingEngine.capture_snapshot`` / the splice branch in
+``_start_request``) owns the device programs; this module owns the portable
+container and the host-side rng re-derivation used when a snapshot is STALE
+(periodic-cadence snapshots under replica-kill recovery) or absent.
+"""
+
+import numpy as np
+
+__all__ = ["RequestSnapshot", "advance_rng"]
+
+
+class RequestSnapshot:
+    """Portable mid-stream state of one serving request.
+
+    ``blocks`` maps every paged-pool leaf name (``k``, ``v`` and, for int8
+    pools, ``k_scale``/``v_scale``) to a host array ``[L, NB, bs, kvh, *]``
+    in block-table-row order: source block ``j`` covers positions
+    ``[j*bs, (j+1)*bs)``. Only the first :attr:`full_blocks` source blocks
+    are ever injected — the capture cursor may sit mid-block, and a partial
+    block is cheaper to replay (<= ``block_size`` tokens) than to splice
+    with a positional fix-up program.
+    """
+
+    __slots__ = ("request_id", "prompt", "tokens", "pos", "rng", "blocks",
+                 "block_size", "chain_keys", "temperature", "top_k", "top_p",
+                 "seed", "max_new_tokens", "eos_token_id", "geometry")
+
+    def __init__(self, *, request_id, prompt, tokens, pos, rng, blocks,
+                 block_size, chain_keys, temperature, top_k, top_p, seed,
+                 max_new_tokens, eos_token_id, geometry):
+        self.request_id = request_id
+        self.prompt = np.asarray(prompt, np.int32)
+        self.tokens = tuple(int(t) for t in tokens)
+        self.pos = int(pos)
+        self.rng = np.asarray(rng, np.uint32).copy()
+        self.blocks = blocks
+        self.block_size = int(block_size)
+        self.chain_keys = tuple(chain_keys)
+        self.temperature = float(temperature)
+        self.top_k = int(top_k)
+        self.top_p = float(top_p)
+        self.seed = seed
+        self.max_new_tokens = int(max_new_tokens)
+        self.eos_token_id = eos_token_id
+        # (n_layers, block_size, kv_heads, head_dim, leaf-dtype fingerprint):
+        # a snapshot only splices into a pool with the SAME geometry —
+        # anything else falls back to replay-resume
+        self.geometry = tuple(geometry)
+
+    @property
+    def full_blocks(self):
+        """Source blocks that are completely filled at the capture cursor
+        (positions [0, full_blocks * block_size) are splice-able verbatim;
+        the tail past that replays as a suffix prefill)."""
+        return self.pos // self.block_size
+
+    @property
+    def nbytes(self):
+        return sum(a.nbytes for a in self.blocks.values())
+
+    def compatible_with(self, geometry):
+        """Splice precondition: identical pool geometry AND at least one
+        full source block (otherwise replay is strictly simpler)."""
+        return tuple(geometry) == self.geometry and self.full_blocks > 0
+
+    def __repr__(self):
+        return (f"RequestSnapshot(request_id={self.request_id}, "
+                f"pos={self.pos}, tokens={len(self.tokens)}, "
+                f"full_blocks={self.full_blocks}, nbytes={self.nbytes})")
+
+
+def advance_rng(rng, n_steps):
+    """Advance a per-slot rng chain key by ``n_steps`` decode steps on the
+    host — exactly what the compiled decode program does on device
+    (``split(key)[1]`` once per dispatched step, one committed token per
+    active step), so a SEEDED sampled stream resumed from a stale snapshot
+    re-joins its original rng stream bitwise: the tokens committed after the
+    capture are teacher-forced by the replay prefill, and the first fresh
+    sample draws from the key the uninterrupted stream would have held.
+    Greedy rows never consult the key, so over-advancing is harmless there.
+    """
+    if n_steps <= 0:
+        return np.asarray(rng, np.uint32)
+    import jax
+
+    key = np.asarray(rng, np.uint32)
+    for _ in range(int(n_steps)):
+        key = np.asarray(jax.random.split(key)[1], np.uint32)
+    return key

@@ -29,6 +29,10 @@ REJECT_NO_FREE_BLOCKS = "no_free_blocks"
 # router tier: every replica is draining or at queue capacity — the
 # cross-replica generalization of queue_full
 REJECT_ALL_REPLICAS_SATURATED = "all_replicas_saturated"
+# router tier, terminal failover fallback: the request's replica died (or
+# kept failing) and the bounded retry budget (serving.retry_limit) is spent
+# — or no surviving replica could take it
+REJECT_REPLICA_FAILED = "replica_failed"
 
 FINISH_EOS = "eos"
 FINISH_LENGTH = "length"
@@ -119,6 +123,19 @@ class Request:
     drafted_tokens: int = 0
     accepted_tokens: int = 0
     rolled_back_tokens: int = 0
+    # live KV migration (serving/migration.py): the latest portable
+    # RequestSnapshot of this request's device state — captured on the
+    # periodic cadence (serving.migration.snapshot_interval_tokens) or at
+    # drain-by-migration; a target replica splices it instead of replaying
+    migration: typing.Optional[object] = None
+    # fleet recovery accounting, all counted distinctly in RouterMetrics:
+    # cross-replica re-dispatches after a replica failure (bounded by
+    # serving.retry_limit), cross-replica retries after an unhealthy_slot
+    # shed (same budget, separate counter), and completed replica moves
+    # (drain-by-migration + failover splices/replays)
+    failovers: int = 0
+    retries: int = 0
+    migrations: int = 0
 
     def __post_init__(self):
         self.prompt = np.asarray(self.prompt, np.int32).reshape(-1)
@@ -130,6 +147,17 @@ class Request:
     @property
     def prompt_len(self):
         return int(self.prompt.shape[0])
+
+    def reset_for_retry(self):
+        """Clear the terminal state an ``unhealthy_slot`` shed left so the
+        router can re-dispatch this request to a DIFFERENT replica. Safe by
+        construction: the unhealthy shed fires BEFORE the first token
+        streams, so nothing user-visible rewinds."""
+        self.state = RequestState.QUEUED
+        self.reject_reason = None
+        self.finish_reason = None
+        self.finish_time = None
+        self.slot = None
 
     @property
     def start_time(self):

@@ -37,16 +37,29 @@ from ..telemetry.digest import LatencyDigest, evaluate_slo
 from .clock import VirtualClock
 from .kv_pool import prefix_chain_keys
 from .metrics import percentile, slo_digest_events
-from .request import (REJECT_ALL_REPLICAS_SATURATED, RequestState, TokenEvent,
+from .migration import advance_rng
+from .request import (FINISH_UNHEALTHY, REJECT_ALL_REPLICAS_SATURATED,
+                      REJECT_REPLICA_FAILED, RequestState, TokenEvent,
                       as_request)
 
 
 class _Replica:
-    """Router-side replica handle: the engine plus drain state."""
+    """Router-side replica handle: the engine plus drain/health state."""
 
-    def __init__(self, sv):
+    def __init__(self, sv, idx=0):
         self.sv = sv
+        self.idx = idx
         self.draining = False
+        # failure-recovery state machine: "live" -> "degraded" (stalled —
+        # its clock jumped ahead, the DES starves it until the fleet
+        # catches up; still correct, still routable) -> "dead" (killed:
+        # in-flight work failed over to survivors, never routed again)
+        self.health = "live"
+        self.stall_until = 0.0
+
+    @property
+    def dead(self):
+        return self.health == "dead"
 
     @property
     def busy(self):
@@ -92,6 +105,15 @@ class RouterMetrics:
         self.rebalances = 0
         self.drains = 0
         self.rejoins = 0
+        # failure recovery, each counted distinctly: cross-replica
+        # re-dispatches after a replica death, unhealthy_slot retries on a
+        # different replica, terminal replica_failed sheds, and the raw
+        # fault counts the chaos schedule fired
+        self.failovers = 0
+        self.retries = 0
+        self.shed_replica_failed = 0
+        self.replica_kills = 0
+        self.replica_stalls = 0
         self.per_replica_routed = collections.Counter()
         self._events_emitted = 0
         # fleet-level SLO bookkeeping (emit intervals with >=1 violated
@@ -127,6 +149,21 @@ class RouterMetrics:
             if total else 1.0
         return tot
 
+    def fleet_migration(self):
+        """Fleet live-migration rollup: replica snapshot/splice counters
+        summed, plus the router-side recovery counts — the ``resilience``
+        block bench artifacts commit."""
+        reps = self._router._replicas
+        keys = ("kv_snapshots", "migrations_out", "migrations_in",
+                "migrated_saved_tokens")
+        out = {k: sum(getattr(r.sv.metrics, k) for r in reps) for k in keys}
+        out["failovers"] = self.failovers
+        out["retries"] = self.retries
+        out["shed_replica_failed"] = self.shed_replica_failed
+        out["replica_kills"] = self.replica_kills
+        out["replica_stalls"] = self.replica_stalls
+        return out
+
     def fleet_slo(self, digests=None):
         """``digests``: pass an already-merged ``fleet_digests()`` result to
         avoid re-merging (snapshot() runs on per-replica hooks)."""
@@ -148,6 +185,8 @@ class RouterMetrics:
                 round(len(r.sv._slots) / max(r.sv.n_slots, 1), 4)
                 for r in reps],
             "draining": [i for i, r in enumerate(reps) if r.draining],
+            "health": [r.health for r in reps],
+            "migration": self.fleet_migration(),
             "session_hits": self.session_hits,
             "prefix_hits": self.prefix_hits,
             "prefix_lookups": self.prefix_lookups,
@@ -181,6 +220,16 @@ class RouterMetrics:
             ("Serving/router_drains", float(snap["drains"]), step),
             ("Serving/router_sheds",
              float(snap["shed_all_replicas_saturated"]), step),
+            # fleet recovery scalars (live KV migration + failover): the
+            # committed Serving/migrations / Serving/failovers streams
+            ("Serving/migrations",
+             float(snap["migration"]["migrations_in"]), step),
+            ("Serving/failovers", float(snap["migration"]["failovers"]),
+             step),
+            ("Serving/router_retries",
+             float(snap["migration"]["retries"]), step),
+            ("Serving/router_shed_replica_failed",
+             float(snap["migration"]["shed_replica_failed"]), step),
         ]
         for i, depth in enumerate(snap["per_replica_queue_depth"]):
             events.append((f"Serving/router_r{i}_queue_depth", float(depth),
@@ -206,11 +255,19 @@ class Router:
         if not replicas:
             raise ValueError("Router needs at least one replica")
         self.cfg = config if config is not None else replicas[0].cfg.router
-        self._replicas = [_Replica(sv) for sv in replicas]
+        self._replicas = [_Replica(sv, i) for i, sv in enumerate(replicas)]
         self._sessions = {}                        # session_id -> replica idx
         self._prefix_index = collections.OrderedDict()  # chain key -> idx
         self._rr_next = 0
         self._next_id = 0
+        # failure recovery: in-flight request registry (request_id ->
+        # (Request, replica idx)) so a replica death / unhealthy shed can
+        # re-dispatch the actual Request object; entries drop as their
+        # done events stream. Homogeneous-fleet knob like slo below.
+        self._requests = {}
+        self._retry_limit = int(replicas[0].cfg.retry_limit)
+        self._chaos = []                          # (ReplicaEvent, ...) queue
+        self._chaos_pos = 0
         # fleet SLO targets: the serving.slo block (homogeneous fleet — the
         # first replica's config speaks for all, like cfg.router above)
         self._slo = replicas[0].cfg.slo
@@ -341,7 +398,7 @@ class Router:
             req.trace_id = f"req-{req.request_id:06d}"
         now = req.arrival_time if req.arrival_resolved else self._frontier()
         live = [i for i, r in enumerate(self._replicas)
-                if not r.draining and not r.saturated]
+                if not r.draining and not r.saturated and not r.dead]
         if not live:
             req.state = RequestState.REJECTED
             req.reject_reason = REJECT_ALL_REPLICAS_SATURATED
@@ -366,6 +423,7 @@ class Router:
             return req
         self.metrics.routed += 1
         self.metrics.per_replica_routed[idx] += 1
+        self._requests[req.request_id] = (req, idx)
         if req.session_id is not None and self.cfg.session_affinity:
             self._sessions[req.session_id] = idx
         self._register_prefix(req, idx)
@@ -459,14 +517,274 @@ class Router:
         return None
 
     # ------------------------------------------------------ drain / rejoin
-    def drain(self, idx):
-        """Stop routing new work to replica ``idx``; in-flight requests keep
-        decoding to completion (``drained(idx)`` turns True). The safe
-        moment to ``sv.destroy()`` for a restart."""
+    def drain(self, idx, migrate=False):
+        """Stop routing new work to replica ``idx``.
+
+        ``migrate=False`` (wait-for-finish): in-flight requests keep
+        decoding to completion (``drained(idx)`` turns True) — the safe
+        moment to ``sv.destroy()`` for a restart. ``migrate=True``
+        (drain-by-migration): every in-flight stream is captured as a
+        FRESH snapshot and live-moved to a peer replica instead, so the
+        replica empties after ONE evacuation pass and its restart loses
+        zero computed tokens (a fresh snapshot splices with zero
+        recompute). Voluntary moves never burn the retry budget. Returns
+        the shed TokenEvents the evacuation produced (normally empty)."""
         rep = self._replicas[idx]
         if not rep.draining:
             rep.draining = True
             self.metrics.drains += 1
+        if not migrate or rep.dead:
+            return []
+        moved = rep.sv.evacuate()
+        started = [r for r in moved if r.tokens
+                   or r.prefill_start_time is not None]
+        started_ids = {id(r) for r in started}
+        queued = [r for r in moved if id(r) not in started_ids]
+        events = []
+        # started streams land at their target's queue head — dispatch in
+        # REVERSE seniority so successive push_fronts leave the most
+        # senior request at the head
+        for req in reversed(started):
+            events.extend(self._failover(req, idx, "drain",
+                                         count_retry=False))
+        for req in queued:
+            events.extend(self._failover(req, idx, "drain",
+                                         count_retry=False))
+        return events
+
+    def kill_replica(self, idx):
+        """Seeded fault surface: replica ``idx`` crashes NOW. Its device
+        state is gone — no capture, no release — so affected requests fail
+        over to survivors from their last periodic snapshot (splice + tail
+        replay) or, with no snapshot, replay prompt + committed tokens as
+        a chunkable resume prefill (counted as replay tokens in goodput).
+        Each started re-dispatch burns one unit of the bounded retry
+        budget (``serving.retry_limit``); the terminal fallback is a
+        shed-with-reason ``replica_failed``. The dead replica's affinity
+        state is purged so nothing routes toward vanished blocks. Returns
+        the TokenEvents (terminal sheds) the failover produced."""
+        rep = self._replicas[idx]
+        if rep.dead:
+            return []
+        rep.health = "dead"
+        rep.draining = True
+        self.metrics.replica_kills += 1
+        self.tracer.instant("replica/killed", cat="router",
+                            ts=self._frontier(), replica=idx,
+                            inflight=len(rep.sv._slots)
+                            + len(rep.sv._prefill_jobs)
+                            + rep.sv.queue.depth)
+        for key in [k for k, v in self._prefix_index.items() if v == idx]:
+            del self._prefix_index[key]
+        for sid in [s for s, v in self._sessions.items() if v == idx]:
+            del self._sessions[sid]
+        affected = rep.sv.abandon_inflight()
+        started = [r for r in affected if r.tokens
+                   or r.prefill_start_time is not None]
+        started_ids = {id(r) for r in started}
+        queued = [r for r in affected if id(r) not in started_ids]
+        events = []
+        for req in reversed(started):
+            events.extend(self._failover(req, idx, "replica_killed"))
+        for req in queued:
+            events.extend(self._failover(req, idx, "replica_killed"))
+        return events
+
+    def stall_replica(self, idx, duration):
+        """Seeded fault surface: replica ``idx`` freezes for ``duration``
+        seconds (a GC pause / preemptible-host interruption). Its clock
+        jumps forward, so the conservative DES starves it until the rest
+        of the fleet catches up — every co-resident request eats the
+        latency, no state is lost. Health reads ``degraded`` until the
+        fleet frontier passes the stall."""
+        rep = self._replicas[idx]
+        if rep.dead:
+            return
+        rep.sv.clock.sleep(float(duration))
+        rep.stall_until = rep.sv.clock.now()
+        rep.health = "degraded"
+        self.metrics.replica_stalls += 1
+        self.tracer.instant("replica/stalled", cat="router",
+                            ts=self._frontier(), replica=idx,
+                            duration=float(duration))
+
+    def _update_health(self):
+        """Degraded -> live once every surviving clock passed the stall."""
+        alive = [r.sv.clock.now() for r in self._replicas if not r.dead]
+        if not alive:
+            return
+        floor = min(alive)
+        for rep in self._replicas:
+            if rep.health == "degraded" and floor >= rep.stall_until:
+                rep.health = "live"
+
+    def _failover(self, req, from_idx, why, count_retry=True):
+        """Re-dispatch one request off a dead (or migrating) replica.
+
+        STARTED requests (committed tokens / prefill begun) are the
+        expensive case: each involuntary move counts against
+        ``serving.retry_limit`` (``count_retry``), the resume rng is
+        re-derived (snapshot chain advanced host-side, or the insert-time
+        chain key re-derived when no snapshot exists), and the request
+        lands at the least-loaded survivor's QUEUE HEAD — committed
+        tokens outrank queued arrivals, and ``push_front`` deliberately
+        bypasses depth bounds. Queued-only requests re-route free through
+        normal admission. Never goes through ``submit()``: that would
+        reset ``submit_time`` and double-count ``record_submit``."""
+        started = bool(req.tokens) or req.prefill_start_time is not None
+        if started and count_retry:
+            req.failovers += 1
+            if req.failovers > self._retry_limit:
+                return self._shed_failed(req, from_idx, "retry_limit")
+        live = [i for i, r in enumerate(self._replicas)
+                if r.health != "dead" and not r.draining]
+        if not live:
+            return self._shed_failed(req, from_idx, "no_live_replica")
+        scores = {i: self._replicas[i].load_score(self.cfg) for i in live}
+        if started:
+            target = min(live, key=lambda i: (scores[i], i))
+            sv = self._replicas[target].sv
+            snap = req.migration
+            if req.tokens:
+                if snap is not None and len(req.tokens) >= len(snap.tokens):
+                    # re-join the original rng chain at the current commit
+                    # point: the tokens since the capture replay as
+                    # teacher-forced prefill
+                    req.resume_rng = advance_rng(
+                        snap.rng, len(req.tokens) - len(snap.tokens))
+                elif req.resume_rng is None:
+                    req.resume_rng = sv.chain_key_for_resume(req)
+            req.slot = None
+            req.state = RequestState.QUEUED
+            req.reject_reason = None
+            req.finish_reason = None
+            sv.queue.push_front(req)
+            if count_retry:
+                self.metrics.failovers += 1
+        else:
+            candidates = [i for i in live
+                          if not self._replicas[i].saturated]
+            if not candidates:
+                return self._shed_failed(req, from_idx, "all_saturated")
+            target = min(candidates, key=lambda i: (scores[i], i))
+            sv = self._replicas[target].sv
+            reason = sv.queue.admit(
+                req, sv.max_len,
+                kv_fits=sv.pool_mgr.fits_ever if sv.paged else None)
+            if reason is not None:
+                return self._shed_failed(req, from_idx, reason)
+        self._requests[req.request_id] = (req, target)
+        self.tracer.instant("route/failover", cat="router",
+                            ts=self._frontier(), request_id=req.request_id,
+                            trace_id=req.trace_id, replica=from_idx,
+                            target=target, why=why, started=started,
+                            n_tokens=len(req.tokens),
+                            snapshot=req.migration is not None,
+                            failovers=req.failovers)
+        return []
+
+    def _shed_failed(self, req, from_idx, why):
+        """Terminal failover fallback: shed with reason ``replica_failed``
+        (budget spent / no survivor with room). Router-side count, like
+        ``all_replicas_saturated``."""
+        req.state = RequestState.REJECTED
+        req.reject_reason = REJECT_REPLICA_FAILED
+        req.finish_reason = None
+        req.slot = None
+        self.metrics.shed_replica_failed += 1
+        self._requests.pop(req.request_id, None)
+        now = self._frontier()
+        self.tracer.instant("route/shed", cat="router", ts=now,
+                            request_id=req.request_id,
+                            trace_id=req.trace_id,
+                            reason=REJECT_REPLICA_FAILED, detail=why,
+                            replica=from_idx)
+        return [TokenEvent(req.request_id, -1, len(req.tokens), True,
+                           f"rejected:{REJECT_REPLICA_FAILED}", now)]
+
+    def _retry_unhealthy(self, req, from_idx):
+        """Satellite of the failover machinery: an ``unhealthy_slot`` shed
+        on a multi-replica fleet retries ONCE (bounded by
+        ``serving.retry_limit``) on a DIFFERENT replica before the shed
+        becomes terminal — the poisoned prefill fired before the first
+        token streamed, so nothing user-visible rewinds. Returns True
+        (event swallowed, fleet will finish the request), a list of
+        terminal shed events, or None (no candidate: the original
+        unhealthy event stands)."""
+        live = [i for i, r in enumerate(self._replicas)
+                if i != from_idx and r.health == "live" and not r.draining
+                and not r.saturated]
+        if not live:
+            return None
+        req.reset_for_retry()
+        req.retries += 1
+        self.metrics.retries += 1
+        scores = {i: self._replicas[i].load_score(self.cfg) for i in live}
+        target = min(live, key=lambda i: (scores[i], i))
+        sv = self._replicas[target].sv
+        reason = sv.queue.admit(
+            req, sv.max_len,
+            kv_fits=sv.pool_mgr.fits_ever if sv.paged else None)
+        if reason is not None:
+            return self._shed_failed(req, from_idx, reason)
+        self._requests[req.request_id] = (req, target)
+        self.tracer.instant("route/retry", cat="router",
+                            ts=self._frontier(), request_id=req.request_id,
+                            trace_id=req.trace_id,
+                            reason=FINISH_UNHEALTHY, replica=from_idx,
+                            target=target, retries=req.retries)
+        return True
+
+    def _filter_events(self, idx, raw):
+        """Every replica step's events pass through here: unhealthy_slot
+        sheds get the cross-replica retry (swallowed on success — the
+        consumer never sees a request fail that the fleet then finishes),
+        and finished requests leave the in-flight registry."""
+        out = []
+        for ev in raw:
+            if ev.finish_reason == FINISH_UNHEALTHY:
+                entry = self._requests.get(ev.request_id)
+                req = entry[0] if entry is not None else None
+                if req is not None and not req.tokens \
+                        and req.retries < self._retry_limit:
+                    res = self._retry_unhealthy(req, idx)
+                    if res is True:
+                        continue
+                    if res is not None:
+                        out.extend(res)
+                        continue
+            if ev.done:
+                self._requests.pop(ev.request_id, None)
+            out.append(ev)
+        return out
+
+    # ------------------------------------------------------- chaos schedule
+    def apply_chaos(self, schedule):
+        """Arm a seeded replica-level fault schedule
+        (``testing.fault_injection.ReplicaChaosSchedule`` or any iterable
+        of ``(time, kind, replica, duration)``): events fire inside the
+        serve/step loops when the fleet frontier reaches their instant —
+        same seed, same schedule, same recovery, deterministically."""
+        events = getattr(schedule, "events", schedule)
+        self._chaos = sorted(tuple(e) for e in events)
+        self._chaos_pos = 0
+
+    def _fire_chaos(self):
+        """Fire every armed fault whose instant the frontier has reached;
+        returns the terminal shed TokenEvents the failovers produced."""
+        out = []
+        while self._chaos_pos < len(self._chaos):
+            t, kind, idx, duration = self._chaos[self._chaos_pos]
+            if self._frontier() < t:
+                break
+            self._chaos_pos += 1
+            if self._replicas[idx].dead:
+                continue
+            if kind == "kill":
+                out.extend(self.kill_replica(idx))
+            elif kind == "stall":
+                self.stall_replica(idx, duration)
+        return out
 
     def drained(self, idx):
         """True once the draining replica has no in-flight work left."""
@@ -485,17 +803,24 @@ class Router:
                 del self._prefix_index[key]
             for sid in [s for s, v in self._sessions.items() if v == idx]:
                 del self._sessions[sid]
+        elif rep.dead:
+            raise ValueError(
+                f"rejoin({idx}): a killed replica's device state is gone — "
+                "pass a replacement engine")
         rep.draining = False
+        rep.health = "live"
+        rep.stall_until = 0.0
         self.metrics.rejoins += 1
 
     # ------------------------------------------------------------- the loop
     def step(self):
         """One scheduler step on every busy replica (the wall-clock /
         manual-driving path). Returns the concatenated TokenEvents."""
-        events = []
+        events = list(self._fire_chaos())
+        self._update_health()
         for rep in self._replicas:
-            if rep.busy:
-                events.extend(rep.sv.step())
+            if rep.busy and not rep.dead:
+                events.extend(self._filter_events(rep.idx, rep.sv.step()))
         self.metrics.maybe_emit()
         return events
 
@@ -523,17 +848,26 @@ class Router:
             elif r.arrival_time is None:
                 r.arrival_time = t0
         try:
-            while pending or any(r.busy for r in self._replicas):
-                busy = [r for r in self._replicas if r.busy]
+            while pending or any(r.busy and not r.dead
+                                 for r in self._replicas):
+                # armed faults fire at the frontier BEFORE new work lands:
+                # a killed replica's failovers re-dispatch first, so this
+                # round's routing already sees the shrunken fleet
+                for ev in self._fire_chaos():
+                    yield ev
+                self._update_health()
+                busy = [r for r in self._replicas if r.busy and not r.dead]
                 if busy:
                     horizon = min(r.sv.clock.now() for r in busy)
                 else:
-                    horizon = pending[0].arrival_time
-                while pending and pending[0].arrival_time <= horizon:
+                    horizon = pending[0].arrival_time if pending else None
+                while pending and horizon is not None \
+                        and pending[0].arrival_time <= horizon:
                     for ev in self._dispatch(pending.pop(0),
                                              yield_rejections):
                         yield ev
-                    busy = [r for r in self._replicas if r.busy]
+                    busy = [r for r in self._replicas
+                            if r.busy and not r.dead]
                 if not busy:
                     if not pending:
                         break
@@ -544,11 +878,12 @@ class Router:
                     # advance the laggard one step: no replica's clock ever
                     # runs ahead of another's un-simulated past
                     rep = min(busy, key=lambda r: r.sv.clock.now())
-                    for ev in rep.sv.step():
+                    for ev in self._filter_events(rep.idx, rep.sv.step()):
                         yield ev
                 else:
                     for rep in busy:
-                        for ev in rep.sv.step():
+                        for ev in self._filter_events(rep.idx,
+                                                      rep.sv.step()):
                             yield ev
                 self.metrics.maybe_emit()
         finally:

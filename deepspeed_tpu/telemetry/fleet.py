@@ -33,9 +33,11 @@ from .digest import LatencyDigest
 from .tracer import event_to_chrome
 
 # request lifecycle + routing instants the wide-event builder consumes
-_LIFECYCLE = ("route/decision", "route/shed", "request/queued",
-              "request/shed", "request/first_token", "request/preempted",
-              "request/resumed", "request/unhealthy", "request/finish")
+_LIFECYCLE = ("route/decision", "route/shed", "route/failover",
+              "route/retry", "request/queued", "request/shed",
+              "request/first_token", "request/preempted",
+              "request/resumed", "request/migrated_out", "request/migrated",
+              "request/unhealthy", "request/finish")
 
 
 def merge_fleet_events(sources):
@@ -87,12 +89,15 @@ def build_wide_events(merged_events):
             "padding_tokens": 0, "prefix_saved_tokens": 0,
             "kv_blocks_peak": 0, "drafted_tokens": 0,
             "accepted_tokens": 0, "rolled_back_tokens": 0,
+            "migrations": 0, "failovers": 0, "retries": 0,
+            "migrated_saved_tokens": 0,
             "queue_wait": None, "admit_wait": None,
             "ttft": None,
             "tpot": None, "breakdown": None,
             "_start": None, "_first": None, "_finish": None,
             "_prefill_dur": 0.0, "_prefill_ts": [],
             "_preempt_ts": [], "_resume_ts": [],
+            "_migrate_out_ts": [], "_migrate_in_ts": [],
         })
 
     for e in merged_events:
@@ -134,6 +139,17 @@ def build_wide_events(merged_events):
             r["_preempt_ts"].append(e["ts"])
         elif name == "request/resumed":
             r["_resume_ts"].append(e["ts"])
+        elif name == "request/migrated_out":
+            r["_migrate_out_ts"].append(e["ts"])
+        elif name == "request/migrated":
+            r["_migrate_in_ts"].append(e["ts"])
+            r["migrations"] += 1
+            r["migrated_saved_tokens"] += args.get("saved_tokens") or 0
+            r["replica"] = e.get("replica", r["replica"])
+        elif name == "route/failover":
+            r["failovers"] += 1
+        elif name == "route/retry":
+            r["retries"] += 1
         elif name == "request/finish":
             r["state"] = "finished"
             r["_finish"] = e["ts"]
@@ -143,7 +159,8 @@ def build_wide_events(merged_events):
                       "replay_tokens", "padding_tokens",
                       "prefix_saved_tokens", "kv_blocks_peak",
                       "drafted_tokens", "accepted_tokens",
-                      "rolled_back_tokens"):
+                      "rolled_back_tokens", "migrations", "failovers",
+                      "retries"):
                 src = "reason" if k == "finish_reason" else k
                 if args.get(src) is not None:
                     r[k] = args[src]
@@ -154,6 +171,7 @@ def build_wide_events(merged_events):
         prefill_ts = r.pop("_prefill_ts")
         prefill_dur = r.pop("_prefill_dur")
         pre, res = r.pop("_preempt_ts"), r.pop("_resume_ts")
+        mo, mi = r.pop("_migrate_out_ts"), r.pop("_migrate_in_ts")
         if first is not None and start is not None:
             r["ttft"] = first - start
         if finish is not None and first is not None \
@@ -167,16 +185,24 @@ def build_wide_events(merged_events):
         stall = sum(b - a for a, b in zip(pre, res))
         if len(pre) > len(res) and finish is not None:
             stall += finish - pre[len(res)]
+        # cross-replica move stall: migrated_out -> migrated windows,
+        # attributed like a preemption stall. The two instants come from
+        # different replicas' clocks, which can disagree mid-run under the
+        # DES, so each window is clamped at zero.
+        mstall = sum(max(b - a, 0.0) for a, b in zip(mo, mi))
+        if len(mo) > len(mi) and finish is not None:
+            mstall += max(finish - mo[len(mi)], 0.0)
         r["start"], r["finish"] = start, finish
         if finish is not None and start is not None:
             r["breakdown"] = {
                 "queue_wait": r["queue_wait"] or 0.0,
                 "prefill": prefill_dur,
                 "preempted": stall,
+                "migrated": mstall,
                 # elapsed decode attribution (co-batched wall share):
-                # first token -> finish, minus preemption stalls
+                # first token -> finish, minus preemption/migration stalls
                 "decode": max((finish - (first if first is not None
-                                         else start)) - stall, 0.0),
+                                         else start)) - stall - mstall, 0.0),
             }
     return reqs
 
@@ -217,7 +243,7 @@ def latency_rollup(wide_events):
     preemption stalls. Shared by fleet_report and trace_summary so both
     CLIs attribute identically."""
     rollup = {k: 0.0 for k in ("queue_wait", "prefill", "decode",
-                               "preempted")}
+                               "preempted", "migrated")}
     for r in wide_events.values():
         if r.get("state") != "finished":
             continue
@@ -249,6 +275,8 @@ def slowest_requests(wide_events, top_k=5):
             "replay_tokens": r.get("replay_tokens") or 0,
             "chunks": r.get("chunks") or 0,
             "kv_blocks_peak": r.get("kv_blocks_peak") or 0,
+            "migrations": r.get("migrations") or 0,
+            "failovers": r.get("failovers") or 0,
         })
     return out
 

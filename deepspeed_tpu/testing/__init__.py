@@ -2,11 +2,12 @@ from .fault_injection import (
     ChaosSchedule,
     FaultInjector,
     InjectedFault,
+    ReplicaChaosSchedule,
     truncate_file,
     sigterm_data_iter,
 )
 
 __all__ = [
-    "ChaosSchedule", "FaultInjector", "InjectedFault", "truncate_file",
-    "sigterm_data_iter",
+    "ChaosSchedule", "FaultInjector", "InjectedFault",
+    "ReplicaChaosSchedule", "truncate_file", "sigterm_data_iter",
 ]

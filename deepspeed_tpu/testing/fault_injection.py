@@ -112,6 +112,75 @@ class ChaosSchedule:
         return len(self.events)
 
 
+class ReplicaChaosSchedule:
+    """Seeded replica-level fault schedule for serving-fleet chaos runs —
+    the serving-tier sibling of :class:`ChaosSchedule`.
+
+    Draws ``n_kills + n_stalls`` strictly-increasing virtual-clock instants
+    in ``[min_gap, horizon - min_gap]`` (each at least ``min_gap`` apart, so
+    every segment makes progress), assigns each a target replica (kills
+    draw WITHOUT replacement — a replica dies at most once; stalls draw
+    with replacement over all replicas) and shuffles which instants are
+    kills vs stalls. Deterministic: the same seed always produces the same
+    schedule, which is what lets fleet chaos tests assert exact recovery
+    (zero lost committed tokens, identical shed sets) rather than "it
+    survived".
+
+    ``events`` is ``[(time, kind, replica, duration), ...]`` sorted by
+    time, directly consumable by ``Router.apply_chaos``.
+    """
+
+    def __init__(self, seed, horizon, n_replicas, n_kills, n_stalls=0,
+                 stall_duration=0.25, min_gap=0.05):
+        import numpy as np
+
+        n_events = n_kills + n_stalls
+        if n_kills > n_replicas:
+            raise ValueError(
+                f"n_kills={n_kills} exceeds n_replicas={n_replicas} "
+                "(kills draw without replacement)")
+        if horizon < (n_events + 1) * min_gap:
+            raise ValueError(
+                f"horizon={horizon} too small for {n_events} events "
+                f"with min_gap={min_gap}")
+        self.seed = seed
+        self.horizon = float(horizon)
+        self.n_replicas = int(n_replicas)
+        rng = np.random.RandomState(seed)
+        times, floor = [], min_gap
+        for i in range(n_events):
+            # leave room for the remaining events' gaps (the ChaosSchedule
+            # draw, on a continuous clock)
+            ceil = horizon - min_gap - (n_events - 1 - i) * min_gap
+            if floor > ceil:
+                raise ValueError("schedule does not fit; raise horizon")
+            t = float(rng.uniform(floor, ceil))
+            times.append(t)
+            floor = t + min_gap
+        kinds = ["kill"] * n_kills + ["stall"] * n_stalls
+        kinds = [kinds[i] for i in rng.permutation(n_events)] \
+            if n_events else []
+        kill_targets = list(rng.permutation(n_replicas)[:n_kills])
+        stall_targets = [int(rng.randint(0, n_replicas))
+                         for _ in range(n_stalls)]
+        events = []
+        for t, kind in zip(times, kinds):
+            if kind == "kill":
+                replica = int(kill_targets.pop(0))
+                events.append((t, "kill", replica, 0.0))
+            else:
+                events.append((t, "stall", stall_targets.pop(0),
+                               float(stall_duration)))
+        self.events = events
+        self.kill_times = [e[0] for e in events if e[1] == "kill"]
+
+    def __iter__(self):
+        return iter(self.events)
+
+    def __len__(self):
+        return len(self.events)
+
+
 class _Fault:
     def __init__(self, event, match, nth, times, action, only_background):
         self.event = event

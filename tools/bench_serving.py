@@ -188,6 +188,20 @@ def run_open_loop(args):
     if args.slo_ttft_p99_ms or args.slo_tpot_p99_ms:
         serving_kw["slo"] = {"ttft_p99_ms": args.slo_ttft_p99_ms,
                              "tpot_p99_ms": args.slo_tpot_p99_ms}
+    if args.chaos_kills or args.chaos_stalls:
+        if args.chaos_kills >= max(args.replicas, 1):
+            print(f"--chaos-kills {args.chaos_kills} must leave at least one "
+                  f"survivor of --replicas {args.replicas}", file=sys.stderr)
+            return 1
+        if not args.paged:
+            print("--chaos-kills requires --paged (live KV migration "
+                  "snapshots ride the block pool)", file=sys.stderr)
+            return 1
+        # arm live migration so failover re-dispatches splice from the last
+        # snapshot instead of replaying the whole committed stream
+        serving_kw["migration"] = {
+            "enabled": True,
+            "snapshot_interval_tokens": args.chaos_snapshot_interval}
     engine._config.serving = engine._config.serving.replace(**serving_kw)
 
     rng = np.random.RandomState(args.seed)
@@ -225,6 +239,24 @@ def run_open_loop(args):
             prompt=rng.randint(0, vocab, (p,)).astype(np.int32),
             max_new_tokens=2) for p in prompts])
         rep.metrics.reset_window()  # warmup out of the tokens/s window
+
+    chaos_events = []
+    if args.chaos_kills or args.chaos_stalls:
+        from deepspeed_tpu.testing import ReplicaChaosSchedule
+
+        # schedule instants are offsets into the offered-load window; shift
+        # by the fleet frontier at arm time so the same seeded schedule
+        # works on wall clocks (perf_counter zero is process start, not run
+        # start) and virtual clocks (frontier 0 — identity shift) alike
+        sched = ReplicaChaosSchedule(
+            args.chaos_seed, horizon=max(float(arrivals[-1]), 1e-3) + 0.5,
+            n_replicas=len(replicas), n_kills=args.chaos_kills,
+            n_stalls=args.chaos_stalls)
+        t_base = max(rep.clock.now() for rep in replicas)
+        chaos_events = [[round(t, 4), kind, idx, dur]
+                        for t, kind, idx, dur in sched.events]
+        router.apply_chaos([(t_base + t, kind, idx, dur)
+                            for t, kind, idx, dur in sched.events])
 
     t0 = time.perf_counter()
     finished, rejected, router_snap = router.run(requests)
@@ -317,6 +349,18 @@ def run_open_loop(args):
         "percentiles": router_snap["percentiles"],
         "slo": router_snap["slo"],
         "goodput": router_snap["goodput"],
+        # the resilience block: live-migration / failover economics next to
+        # the throughput they protected — snapshots taken, streams migrated,
+        # cross-replica failovers and retries, terminal replica_failed
+        # sheds, and the replay tokens burned re-computing work a dead
+        # replica had already committed (zero when every failover spliced a
+        # fresh snapshot)
+        "resilience": dict(
+            router_snap["router"]["migration"],
+            replay_tokens=router_snap["goodput"]["replay_tokens"],
+            chaos={"kills": args.chaos_kills, "stalls": args.chaos_stalls,
+                   "seed": args.chaos_seed,
+                   "schedule": chaos_events} if chaos_events else None),
         "speculative": speculative,
         # numerics self-incrimination next to the run stamp: a throughput
         # number earned while slots were shedding non-finite logits (or
@@ -354,7 +398,10 @@ def run_open_loop(args):
         "kv_growth": bool(args.kv_growth),
         "spec_draft": args.spec_draft, "spec_k": args.spec_k,
         "slo_ttft_p99_ms": args.slo_ttft_p99_ms,
-        "slo_tpot_p99_ms": args.slo_tpot_p99_ms})
+        "slo_tpot_p99_ms": args.slo_tpot_p99_ms,
+        "chaos_kills": args.chaos_kills, "chaos_stalls": args.chaos_stalls,
+        "chaos_seed": args.chaos_seed,
+        "chaos_snapshot_interval": args.chaos_snapshot_interval})
     print(json.dumps(artifact), flush=True)
     if args.output:
         with open(args.output, "w") as f:
@@ -430,6 +477,24 @@ def main():
                          "grades the fleet digests against it")
     ap.add_argument("--slo-tpot-p99-ms", type=float, default=0.0,
                     help="open-loop mode: serving.slo TPOT P99 target (ms)")
+    ap.add_argument("--chaos-kills", type=int, default=0,
+                    help="open-loop mode (requires --paged): kill this many "
+                         "replicas at seeded instants during the offered-"
+                         "load window (testing.ReplicaChaosSchedule); arms "
+                         "live KV migration so failovers splice snapshots "
+                         "instead of replaying streams, and the artifact "
+                         "gains a resilience block (migrations, failovers, "
+                         "retries, replay tokens)")
+    ap.add_argument("--chaos-stalls", type=int, default=0,
+                    help="stall this many replicas (transient degraded "
+                         "health) at seeded instants")
+    ap.add_argument("--chaos-seed", type=int, default=0,
+                    help="seed for the replica chaos schedule (independent "
+                         "of --seed so the workload stays fixed across "
+                         "chaos variations)")
+    ap.add_argument("--chaos-snapshot-interval", type=int, default=4,
+                    help="serving.migration.snapshot_interval_tokens under "
+                         "--chaos-kills — the failover replay bound")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--output", default=None,
                     help="write the open-loop JSON artifact here")

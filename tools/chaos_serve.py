@@ -1,0 +1,257 @@
+#!/usr/bin/env python
+"""Serving chaos soak runner: seeded replica-kill/stall survival testing.
+
+The serving-tier mirror of ``chaos_train.py``: drives a Router fleet of
+ServingEngine replicas (virtual clock — deterministic DES) through a
+:class:`ReplicaChaosSchedule` — seeded kills and stalls at arbitrary fleet
+instants — with live KV migration armed, and measures what the recovery
+layer actually delivers:
+
+- ``kills_fired`` / ``stalls_fired``: every scheduled fault must fire;
+- survival: every request ends FINISHED or terminally shed with a reason
+  (``replica_failed`` after the bounded retry budget) — nothing hangs;
+- bitwise continuity: every finished stream must equal an uninterrupted
+  single-replica reference run of the same request (greedy AND seeded
+  sampling) — failover replay and snapshot splicing may move work between
+  replicas but may never change a committed token;
+- determinism: the same chaos seed must reproduce the same per-request
+  terminal states, token streams and recovery counters exactly;
+- recovery economics: the fleet migration block (snapshots, migrations,
+  failovers, retries, terminal sheds) and the goodput split (replay tokens
+  burned re-computing work the dead replica had already done vs tokens
+  the snapshots saved).
+
+Emits a provenance-stamped JSON artifact (``tools/_common.run_stamp``).
+Tier-1 smokes this on the tiny preset; real soaks raise ``--requests`` /
+``--kills``.
+
+Usage:
+    python tools/chaos_serve.py --replicas 3 --requests 10 --kills 1 \
+        --stalls 1 --seed 0 --out tools/artifacts/chaos_serve_tiny_cpu.json
+
+Exit codes: 0 ok; 2 survival gate (fault did not fire / request neither
+finished nor shed); 3 continuity gate (bitwise mismatch vs reference or
+chaos-vs-chaos nondeterminism); 4 shed gate (shed rate above ``--max-shed``).
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tools._common import stamp_record  # noqa: E402
+
+
+def build_engine(args):
+    import jax.numpy as jnp
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models import get_model
+
+    model = get_model("gpt2", "tiny", vocab_size=args.vocab,
+                      max_seq_len=args.seq, compute_dtype=jnp.float32)
+    return deepspeed_tpu.init_inference(
+        model, dtype="float32", max_tokens=args.seq,
+        prompt_bucket_size=16)
+
+
+def make_replica(engine, args):
+    from deepspeed_tpu.config import ServingConfig
+    from deepspeed_tpu.serving import ServingEngine, VirtualClock
+
+    cfg = ServingConfig(
+        virtual_clock=True,
+        n_slots=args.slots,
+        retry_limit=args.retry_limit,
+        chunked_prefill={"enabled": True, "chunk_size": 8},
+        kv_pool={"enabled": True, "block_size": 8, "on_demand_growth": True},
+        migration={"enabled": True,
+                   "snapshot_interval_tokens": args.snapshot_interval})
+    return ServingEngine(engine, serving_config=cfg, clock=VirtualClock())
+
+
+def make_requests(args):
+    """Seeded workload: alternating greedy / seeded-sampled requests with
+    staggered arrivals — fresh Request objects per run (runs mutate them)."""
+    import numpy as np
+
+    from deepspeed_tpu.serving import Request, SamplingParams
+
+    rng = np.random.RandomState(args.seed * 9973 + 17)
+    reqs = []
+    for i in range(args.requests):
+        plen = int(rng.randint(9, 30))
+        prompt = rng.randint(0, args.vocab, (plen,)).astype(np.int32)
+        sampling = SamplingParams(temperature=0.8, top_k=8,
+                                  seed=1000 + i) if i % 2 else None
+        reqs.append(Request(prompt=prompt, max_new_tokens=args.new_tokens,
+                            arrival_time=i * args.arrival_gap,
+                            sampling=sampling))
+    return reqs
+
+
+def run_reference(engine, args):
+    """Uninterrupted single-replica run of each request, one at a time:
+    the bitwise-continuity baseline (no router, no chaos, no co-batching)."""
+    sv = make_replica(engine, args)
+    streams = []
+    for req in make_requests(args):
+        for _ in sv.run([req]):
+            pass
+        streams.append(list(req.tokens))
+    return streams
+
+
+def run_chaos(engine, args):
+    """One seeded chaos pass over a fresh fleet; returns the terminal
+    per-request states/streams plus the fleet snapshot."""
+    from deepspeed_tpu.serving import Router
+    from deepspeed_tpu.testing import ReplicaChaosSchedule
+
+    replicas = [make_replica(engine, args) for _ in range(args.replicas)]
+    router = Router(replicas)
+    schedule = ReplicaChaosSchedule(
+        args.seed, horizon=args.horizon, n_replicas=args.replicas,
+        n_kills=args.kills, n_stalls=args.stalls,
+        stall_duration=args.stall_duration)
+    router.apply_chaos(schedule)
+    requests = make_requests(args)
+    finished, rejected, snap = router.run(requests)
+    return {
+        "schedule": [[round(t, 6), kind, idx, dur]
+                     for t, kind, idx, dur in schedule.events],
+        "states": [r.state.value for r in requests],
+        "streams": [list(r.tokens) for r in requests],
+        "finish_reasons": [r.finish_reason or r.reject_reason
+                           for r in requests],
+        "failovers": [r.failovers for r in requests],
+        "migrations": [r.migrations for r in requests],
+        "n_finished": len(finished),
+        "n_rejected": len(rejected),
+        "snapshot": snap,
+    }
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--replicas", type=int, default=3)
+    ap.add_argument("--requests", type=int, default=10)
+    ap.add_argument("--kills", type=int, default=1)
+    ap.add_argument("--stalls", type=int, default=1)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--slots", type=int, default=2)
+    ap.add_argument("--new-tokens", type=int, default=8)
+    ap.add_argument("--vocab", type=int, default=64)
+    ap.add_argument("--seq", type=int, default=64)
+    ap.add_argument("--retry-limit", type=int, default=1)
+    ap.add_argument("--snapshot-interval", type=int, default=2,
+                    help="serving.migration.snapshot_interval_tokens — the "
+                         "failover replay bound")
+    ap.add_argument("--horizon", type=float, default=2.0,
+                    help="chaos schedule horizon in fleet virtual seconds")
+    ap.add_argument("--stall-duration", type=float, default=0.25)
+    ap.add_argument("--arrival-gap", type=float, default=0.05)
+    ap.add_argument("--max-shed", type=float, default=0.5,
+                    help="max tolerated shed rate before exit 4 (kills with "
+                         "retry_limit 0 legitimately shed their victims)")
+    ap.add_argument("--out", default="")
+    args = ap.parse_args(argv)
+
+    if args.kills >= args.replicas:
+        print(f"--kills {args.kills} must leave at least one survivor of "
+              f"--replicas {args.replicas}", file=sys.stderr)
+        return 1
+
+    engine = build_engine(args)
+    try:
+        ref_streams = run_reference(engine, args)
+        chaos = run_chaos(engine, args)
+        rerun = run_chaos(engine, args)
+    finally:
+        engine.destroy()
+
+    # ---- gates ----------------------------------------------------------
+    mig = chaos["snapshot"]["router"]["migration"]
+    goodput = chaos["snapshot"]["goodput"]
+    kills_fired = mig["replica_kills"]
+    stalls_fired = mig["replica_stalls"]
+    nonterminal = [i for i, s in enumerate(chaos["states"])
+                   if s not in ("finished", "rejected")]
+    mismatches = [i for i, (s, ref) in
+                  enumerate(zip(chaos["streams"], ref_streams))
+                  if chaos["states"][i] == "finished" and s != ref]
+    deterministic = all(
+        chaos[k] == rerun[k]
+        for k in ("states", "streams", "finish_reasons", "failovers",
+                  "migrations", "schedule")) \
+        and chaos["snapshot"]["router"]["migration"] == \
+        rerun["snapshot"]["router"]["migration"]
+    shed_rate = chaos["n_rejected"] / max(args.requests, 1)
+
+    record = {
+        "tool": "chaos_serve",
+        "config": {k: getattr(args, k) for k in
+                   ("replicas", "requests", "kills", "stalls", "seed",
+                    "slots", "new_tokens", "vocab", "seq", "retry_limit",
+                    "snapshot_interval", "horizon", "stall_duration",
+                    "arrival_gap", "max_shed")},
+        "schedule": chaos["schedule"],
+        "kills_fired": kills_fired,
+        "stalls_fired": stalls_fired,
+        "completed": chaos["n_finished"],
+        "shed": chaos["n_rejected"],
+        "shed_rate": round(shed_rate, 4),
+        "shed_reasons": {r: chaos["finish_reasons"].count(r)
+                         for i, r in enumerate(chaos["finish_reasons"])
+                         if chaos["states"][i] == "rejected"},
+        "nonterminal_requests": nonterminal,
+        "bitwise_mismatches": mismatches,
+        "deterministic_rerun": deterministic,
+        # the recovery economics: the resilience block bench artifacts carry
+        "resilience": dict(mig, replay_tokens=goodput["replay_tokens"],
+                           migrated_saved_tokens=mig["migrated_saved_tokens"]),
+        "goodput": goodput,
+        "health": chaos["snapshot"]["router"]["health"],
+        "makespan": chaos["snapshot"].get("makespan"),
+        "per_request": [
+            {"state": s, "reason": fr, "tokens": len(st),
+             "failovers": f, "migrations": m}
+            for s, fr, st, f, m in zip(
+                chaos["states"], chaos["finish_reasons"], chaos["streams"],
+                chaos["failovers"], chaos["migrations"])],
+    }
+    stamp_record(record, config=record["config"])
+    out = json.dumps(record, indent=1, default=str)
+    if args.out:
+        os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+        with open(args.out, "w") as f:
+            f.write(out + "\n")
+    print(out)
+
+    if kills_fired != args.kills or stalls_fired != args.stalls:
+        print(f"FAIL: fired {kills_fired}/{args.kills} kills, "
+              f"{stalls_fired}/{args.stalls} stalls", file=sys.stderr)
+        return 2
+    if nonterminal:
+        print(f"FAIL: requests {nonterminal} neither finished nor shed",
+              file=sys.stderr)
+        return 2
+    if mismatches:
+        print(f"FAIL: requests {mismatches} finished with streams that "
+              f"differ from the uninterrupted reference", file=sys.stderr)
+        return 3
+    if not deterministic:
+        print("FAIL: chaos rerun with the same seed diverged",
+              file=sys.stderr)
+        return 3
+    if shed_rate > args.max_shed:
+        print(f"FAIL: shed rate {shed_rate} > {args.max_shed}",
+              file=sys.stderr)
+        return 4
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

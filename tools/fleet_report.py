@@ -79,6 +79,12 @@ def _row(label, rows):
         "label": label, "requests": len(rows), "finished": len(fin),
         "shed": sum(1 for r in rows if r.get("state") == "shed"),
         "preemptions": sum(r.get("preemptions") or 0 for r in fin),
+        # recovery instants (live KV migration / replica failover): a
+        # request's replica column shows where it FINISHED — these columns
+        # show how it got there
+        "migrations": sum(r.get("migrations") or 0 for r in fin),
+        "failovers": sum(r.get("failovers") or 0 for r in fin),
+        "retries": sum(r.get("retries") or 0 for r in fin),
         **gp,
         "ttft_p50_ms": d["ttft"].quantile_ms(50),
         "ttft_p99_ms": d["ttft"].quantile_ms(99),
@@ -89,6 +95,7 @@ def _row(label, rows):
             f"| {r['shed']} | {ms(r['ttft_p50_ms'])} "
             f"| {ms(r['ttft_p99_ms'])} | {ms(r['tpot_p99_ms'])} "
             f"| {ms(r['queue_wait_p99_ms'])} | {r['preemptions']} "
+            f"| {r['migrations']} | {r['failovers']} "
             f"| {r['replay_tokens']} | {r['padding_tokens']} |"),
     }
 
@@ -143,6 +150,11 @@ def summarize(wide, fleet=None, targets_ms=None, top_k=5):
         "replicas": [strip(r) for r in replica_rows],
         "fleet": strip(fleet_row),
         "goodput": (fleet or {}).get("goodput") or _goodput(rows),
+        # the fleet recovery rollup when the live fleet.json carries it
+        # (snapshots, migrations, failovers, retries, kills/stalls fired);
+        # None for bare-trace inputs — the per-row columns still cover the
+        # per-request view
+        "resilience": ((fleet or {}).get("router") or {}).get("migration"),
         "slo": slo,
         "digest_coherence": coherence,
         "critical_paths": critical,
@@ -152,8 +164,9 @@ def summarize(wide, fleet=None, targets_ms=None, top_k=5):
 
 def print_report(summary):
     print("| replica | reqs | finished | shed | ttft p50 ms | ttft p99 ms "
-          "| tpot p99 ms | queue p99 ms | preempt | replay tok | pad tok |")
-    print("|---|---|---|---|---|---|---|---|---|---|---|")
+          "| tpot p99 ms | queue p99 ms | preempt | migrate | failover "
+          "| replay tok | pad tok |")
+    print("|---|---|---|---|---|---|---|---|---|---|---|---|---|")
     for r in summary["_replica_rows"]:
         print(r["_fmt"](r))
     fr = summary["_fleet_row"]
@@ -165,6 +178,17 @@ def print_report(summary):
               f"(replay {gp['replay_tokens']} + padding "
               f"{gp['padding_tokens']} wasted tokens; prefix cache saved "
               f"{gp['prefix_saved_tokens']})")
+
+    res = summary.get("resilience")
+    if res:
+        print(f"resilience: {res.get('migrations_in', 0)} migrations "
+              f"({res.get('kv_snapshots', 0)} snapshots, "
+              f"{res.get('migrated_saved_tokens', 0)} tokens saved), "
+              f"{res.get('failovers', 0)} failovers, "
+              f"{res.get('retries', 0)} retries, "
+              f"{res.get('shed_replica_failed', 0)} replica_failed sheds "
+              f"[{res.get('replica_kills', 0)} kills / "
+              f"{res.get('replica_stalls', 0)} stalls fired]")
 
     slo = summary["slo"]
     if slo["configured"]:
@@ -198,12 +222,16 @@ def print_report(summary):
             route = c.get("routing") or {}
             total = "" if c["total_ms"] is None \
                 else f", total {c['total_ms']:.1f} ms"
+            moved = ""
+            if c.get("migrations") or c.get("failovers"):
+                moved = (f", {c.get('migrations') or 0} migrations, "
+                         f"{c.get('failovers') or 0} failovers")
             print(f"  req {c['request_id']} @ {c['replica']} "
                   f"(routed: {route.get('affinity') or route.get('policy')}"
                   f"{', rebalanced' if route.get('rebalanced') else ''}): "
                   f"ttft {c['ttft_ms']:.1f} ms{total} = {parts} "
                   f"[dominant: {c['dominant']}; {c['preemptions']} "
-                  f"preemptions, {c['replay_tokens']} replay tok, "
+                  f"preemptions{moved}, {c['replay_tokens']} replay tok, "
                   f"{c['chunks']} chunks, kv peak {c['kv_blocks_peak']}]")
 
 

@@ -142,6 +142,24 @@ def summarize_fleet(events, wide, max_ttft_p99_ms=None, top_k=5):
             if name not in phases:
                 phases.append(name)
 
+    # recovery instants: where and when the fleet moved work — live KV
+    # migrations (out/in pairs), replica failovers, cross-replica retries —
+    # pulled from the merged span stream so the timeline is inspectable
+    # next to the latency it explains
+    recovery = []
+    for e in events:
+        if e.get("ph") == "i" and e.get("name") in (
+                "request/migrated_out", "request/migrated",
+                "route/failover", "route/retry"):
+            a = e.get("args") or {}
+            recovery.append({
+                "t": e.get("ts"), "event": e["name"],
+                "replica": e.get("replica", "?"),
+                "request_id": a.get("request_id"),
+                "saved_tokens": a.get("saved_tokens"),
+            })
+    recovery.sort(key=lambda r: (r["t"] is None, r["t"]))
+
     digests = {m: digest_from_wide_events(wide, m)
                for m in ("ttft", "tpot", "queue_wait")}
     p99 = digests["ttft"].quantile_ms(99)
@@ -169,6 +187,9 @@ def summarize_fleet(events, wide, max_ttft_p99_ms=None, top_k=5):
                                "p99": d.quantile_ms(99)}
                            for m, d in digests.items()},
         "slowest": slowest_requests(wide, top_k=top_k),
+        "recovery_instants": recovery,
+        "migrations": sum(r.get("migrations") or 0 for r in wide.values()),
+        "failovers": sum(r.get("failovers") or 0 for r in wide.values()),
         "max_ttft_p99_ms": max_ttft_p99_ms,
         "ttft_p99_ms": p99,
         "flagged_steps": ["fleet_ttft_p99"] if flagged else [],
@@ -203,7 +224,17 @@ def print_fleet_summary(summary):
                            for k, v in s["breakdown_ms"].items())
         print(f"  slow: req {s['request_id']} @ {s['replica']} ttft "
               f"{s['ttft_ms']:.1f} ms = {parts} ({s['preemptions']} "
-              f"preemptions, {s['chunks']} chunks)")
+              f"preemptions, {s.get('migrations') or 0} migrations, "
+              f"{s['chunks']} chunks)")
+    if summary["recovery_instants"]:
+        print(f"\nrecovery timeline ({summary['migrations']} migrations, "
+              f"{summary['failovers']} failovers):")
+        for r in summary["recovery_instants"]:
+            t = "-" if r["t"] is None else f"{r['t']:.3f}"
+            saved = f", saved {r['saved_tokens']} tok" \
+                if r.get("saved_tokens") else ""
+            print(f"  t={t} {r['event']} req {r['request_id']} "
+                  f"@ {r['replica']}{saved}")
     if summary["flagged_steps"]:
         print(f"\nFLAGGED: fleet TTFT p99 {summary['ttft_p99_ms']:.1f} ms "
               f"exceeds --max-ttft-p99-ms {summary['max_ttft_p99_ms']}")
