@@ -546,6 +546,97 @@ class MigrationConfig(ConfigModel):
                 f"{self.virtual_cost_per_block}")
 
 
+class PoolsConfig(ConfigModel):
+    """Disaggregated prefill/decode fleet (``serving/router.py``): partition
+    the Router's replicas into a PREFILL pool (first ``prefill_replicas``
+    indices) and a DECODE pool (the rest). Prefill replicas run prompts to
+    the first token, capture a FRESH live-migration snapshot (partial tail
+    block included — the PR 16 zero-recompute contract) and hand the stream
+    off to a decode replica through the compiled insert path; decode
+    replicas only ever decode. Long prompts stop interfering with in-flight
+    decode latency — disaggregation ELIMINATES the interference chunked
+    prefill only amortizes (DeepSpeed-Inference, arXiv:2207.00032).
+    Disabled (the default) keeps every replica mixed."""
+
+    enabled: bool = False
+    # pool sizes; together they must equal the Router's replica count
+    # (checked at Router construction — the config cannot see the fleet)
+    prefill_replicas: int = 1
+    decode_replicas: int = 1
+    # per-pool chunked-prefill chunk-size overrides (0 = inherit the shared
+    # serving.chunked_prefill.chunk_size): prefill replicas typically want
+    # LARGER chunks (no co-resident decodes to protect), decode replicas
+    # smaller ones (they only ever prefill on failover/rebalance splices)
+    prefill_chunk_size: int = 0
+    decode_chunk_size: int = 0
+    # per-pool speculative-decoding overrides ("" = inherit serving.
+    # speculative.enabled, "on"/"off" = force): speculation only pays on
+    # the decode pool — a prefill replica holds each stream for one token
+    prefill_speculation: str = ""
+    decode_speculation: str = ""
+
+    def _validate(self):
+        if self.prefill_replicas < 1:
+            raise ConfigError(
+                f"pools.prefill_replicas must be >= 1, got "
+                f"{self.prefill_replicas}")
+        if self.decode_replicas < 1:
+            raise ConfigError(
+                f"pools.decode_replicas must be >= 1, got "
+                f"{self.decode_replicas}")
+        for field in ("prefill_chunk_size", "decode_chunk_size"):
+            if getattr(self, field) < 0:
+                raise ConfigError(
+                    f"pools.{field} must be >= 0 (0 inherits), got "
+                    f"{getattr(self, field)}")
+        for field in ("prefill_speculation", "decode_speculation"):
+            if getattr(self, field) not in ("", "on", "off"):
+                raise ConfigError(
+                    f"pools.{field} must be '', 'on' or 'off', got "
+                    f"{getattr(self, field)!r}")
+
+
+class RebalanceConfig(ConfigModel):
+    """Live decode rebalancing (``serving/router.py``): the actuator over
+    the live-migration mechanism — the Router watches per-replica load
+    scores (occupancy, queue depth, the same signals routing uses) and
+    migrates long-tail decode streams off hot replicas mid-flight. The
+    trigger is hysteresis-guarded so it provably never thrashes: a move
+    fires only when the hot/cold load gap exceeds ``min_gain`` (and a move
+    of one stream cannot invert a gap that large back past the threshold),
+    at most ``max_concurrent`` streams move per trigger, and the trigger
+    then cools down for ``cooldown`` seconds. Voluntary moves never burn
+    the ``serving.retry_limit`` budget."""
+
+    enabled: bool = False
+    # minimum hot-minus-cold load-score gap before any stream moves; also
+    # the hysteresis band — below it the fleet is "balanced enough"
+    min_gain: float = 0.25
+    # seconds (virtual under a VirtualClock) between triggers
+    cooldown: float = 0.5
+    # streams moved per trigger (bounded blast radius)
+    max_concurrent: int = 1
+    # router loop iterations between load evaluations (the check is cheap
+    # but per-step evaluation would just hit the cooldown gate anyway)
+    interval: int = 8
+
+    def _validate(self):
+        if self.min_gain <= 0:
+            raise ConfigError(
+                f"rebalance.min_gain must be > 0 (the hysteresis band), "
+                f"got {self.min_gain}")
+        if self.cooldown < 0:
+            raise ConfigError(
+                f"rebalance.cooldown must be >= 0, got {self.cooldown}")
+        if self.max_concurrent < 1:
+            raise ConfigError(
+                f"rebalance.max_concurrent must be >= 1, got "
+                f"{self.max_concurrent}")
+        if self.interval < 1:
+            raise ConfigError(
+                f"rebalance.interval must be >= 1, got {self.interval}")
+
+
 class ServingConfig(ConfigModel):
     """Continuous-batching serving (Orca-style slot scheduler over ONE jitted
     decode program; DeepSpeed-Inference's serving-side batching layer,
@@ -599,6 +690,13 @@ class ServingConfig(ConfigModel):
     # live KV migration: portable request snapshots spliced between
     # replicas (failover, drain-by-migration, cross-replica retry)
     migration: MigrationConfig = None
+    # disaggregated prefill/decode pools over the Router's replicas
+    # (pools.enabled): prefill replicas hand streams off at first-token
+    # time through the migration machinery
+    pools: PoolsConfig = None
+    # live decode rebalancing: hysteresis-guarded migration of long-tail
+    # streams off hot replicas (rebalance.enabled)
+    rebalance: RebalanceConfig = None
     # cross-replica retry budget: a request that hits a recoverable
     # per-replica failure (unhealthy_slot, replica crash) is re-dispatched
     # to a different replica up to this many times before the terminal shed
@@ -617,6 +715,20 @@ class ServingConfig(ConfigModel):
             self.speculative = SpeculativeConfig()
         if self.migration is None:
             self.migration = MigrationConfig()
+        if self.pools is None:
+            self.pools = PoolsConfig()
+        if self.rebalance is None:
+            self.rebalance = RebalanceConfig()
+        if self.pools.enabled and not self.kv_pool.enabled:
+            raise ConfigError(
+                "serving.pools.enabled requires serving.kv_pool.enabled: "
+                "the first-token handoff splices a fresh paged-pool "
+                "snapshot into the decode replica (the PR 16 zero-"
+                "recompute contract has no dense-pool form)")
+        if self.pools.enabled and not self.migration.enabled:
+            raise ConfigError(
+                "serving.pools.enabled requires serving.migration.enabled: "
+                "the first-token handoff IS a live migration")
         if self.retry_limit < 0:
             raise ConfigError(
                 f"serving.retry_limit must be >= 0, got {self.retry_limit}")

@@ -126,6 +126,12 @@ class ServingEngine:
         # interleaved with decode steps (bounded co-batched TPOT); each chunk
         # is one suffix-prefill call against the request's partial cache
         self.chunked = bool(self.cfg.chunked_prefill.enabled)
+        # disaggregated-fleet role (serving.pools): assigned by the Router
+        # via set_pool_role after construction — "mixed" (default), or
+        # "prefill"/"decode" with optional per-pool chunk-size override
+        # (0 = the shared chunked_prefill.chunk_size)
+        self.pool_role = "mixed"
+        self.chunk_size_override = 0
         # on-demand block growth (paged only): admission reserves prompt
         # blocks, decode blocks are allocated as cursors advance, and pool
         # exhaustion preempts the newest request back to the queue
@@ -254,6 +260,33 @@ class ServingEngine:
                 f"queue depth {self.cfg.max_queue_depth}, "
                 f"clock={'virtual' if isinstance(self.clock, VirtualClock) else 'wall'}",
                 ranks=[0])
+
+    @property
+    def chunk_size(self):
+        """Effective chunked-prefill chunk size: the per-pool override when
+        the Router specialized this replica (serving.pools.*_chunk_size),
+        else the shared ``chunked_prefill.chunk_size``."""
+        return self.chunk_size_override or self.cfg.chunked_prefill.chunk_size
+
+    def set_pool_role(self, role, chunk_size=0, speculation=""):
+        """Assign this replica's disaggregated-pool role (Router-driven,
+        ``serving.pools``): records the role for the banner/snapshot,
+        applies the per-pool chunk-size override (0 = inherit) and the
+        speculation override (""/"on"/"off"). Chunk size only changes the
+        SCHEDULE (chunks ride the bucketed suffix programs) and speculation
+        toggling never perturbs a seeded stream, so pool specialization
+        cannot change any committed token."""
+        if role not in ("mixed", "prefill", "decode"):
+            raise ValueError(f"unknown pool role {role!r}")
+        self.pool_role = role
+        self.chunk_size_override = int(chunk_size)
+        if speculation:
+            self.set_speculation(speculation == "on")
+        log_dist(
+            f"ServingEngine: pool role {role} "
+            f"(chunk_size={self.chunk_size}"
+            f"{'*' if self.chunk_size_override else ''}, "
+            f"speculation={'on' if self._spec_on else 'off'})", ranks=[0])
 
     def _kv_pool_stats(self):
         """``KVPoolManager.stats()`` + the active attention backend — the
@@ -650,7 +683,7 @@ class ServingEngine:
         against a donated, partially-filled dense b=1 cache."""
         if self._decode_jit is None:
             self._build_pool_programs()
-        chunk = int(chunk_tokens or self.cfg.chunked_prefill.chunk_size)
+        chunk = int(chunk_tokens or self.chunk_size)
         padded = self.engine._bucket_prompt_len(min(chunk, self.max_len),
                                                 self.max_len)
         fn = self._suffix_program(padded)
@@ -916,7 +949,13 @@ class ServingEngine:
             # into the decode pool; stale: full blocks landed, only the
             # tail replays) — the normal replay path below never runs
             return
-        chunk = self.cfg.chunked_prefill.chunk_size
+        if req.handoff_pending:
+            # the handoff splice degraded to a replay-resume (snapshot
+            # incompatible here, or fully covered by this pool's prefix
+            # cache): the stream still completed its move
+            req.handoff_pending = False
+            req.handoffs += 1
+        chunk = self.chunk_size
         if resume or (self.chunked and len(ids_full) - shared_len > chunk):
             # multi-step prefill (chunked and/or resume replay): reserve the
             # slot now, seed the partial cache, and let the step loop drive
@@ -1083,7 +1122,7 @@ class ServingEngine:
         full chunk shares one compiled program."""
         job = self._prefill_jobs[0]
         remaining = len(job.ids) - job.pos
-        n = min(self.cfg.chunked_prefill.chunk_size, remaining) \
+        n = min(self.chunk_size, remaining) \
             if self.chunked else remaining
         # ceiling shrinks by the already-prefilled prefix (same overrun
         # guard as the shared-prefix suffix path: a bucket past max_len
@@ -1458,12 +1497,56 @@ class ServingEngine:
             self.metrics.prefix_saved_tokens += shared_len
         req.migrations += 1
         self.metrics.record_migration_in(saved)
-        self.tracer.instant("request/migrated", cat="serving",
+        # the handoff instant pair's IN side: a first-token prefill->decode
+        # handoff splice is telemetered distinctly from a recovery splice
+        # (same machinery, different latency semantics — wide events charge
+        # the out->in gap to "handoff", not "migrated")
+        name = "request/migrated"
+        if req.handoff_pending:
+            name = "request/handoff_in"
+            req.handoff_pending = False
+            req.handoffs += 1
+        self.tracer.instant(name, cat="serving",
                             ts=self.clock.now(), request_id=req.request_id,
                             trace_id=req.trace_id, n_tokens=len(req.tokens),
                             spliced_blocks=n_inject, shared_len=shared_len,
                             saved_tokens=saved, replay_tokens=replay,
                             fresh=fresh)
+        return True
+
+    def evacuate_request(self, req, instant="request/migrated_out"):
+        """Live-move ONE running stream off this replica: capture a FRESH
+        snapshot while the slot binding is live (the ownership guard in
+        ``capture_snapshot`` rejects an unbound request), release the
+        slot's device state, and hand the request back QUEUED for
+        re-dispatch on a peer. This is the unit the first-token handoff
+        (``instant="request/handoff_out"``) and the rebalancer move;
+        ``evacuate()`` is this over every slot. Returns False when the
+        request is not a slot-bound stream here (nothing to move)."""
+        slot = req.slot
+        if slot is None or self._slots.get(slot) is not req:
+            return False
+        if self.paged and self.cfg.migration.enabled:
+            self.capture_snapshot(req)
+        self._slots.pop(slot)
+        # keep the plain resume path viable too (snapshot may not
+        # splice on the target): the rng at this commit point
+        req.resume_rng = np.asarray(self._state["rng"])[slot].copy()
+        self._state = self._release_jit(self._state, np.int32(slot))
+        if self.paged:
+            self.pool_mgr.free_slot(slot)
+        if self._drafter is not None:
+            self._drafter.release(slot)
+        self._free_slots.append(slot)
+        req.slot = None
+        req.state = RequestState.QUEUED
+        self.metrics.record_migration_out()
+        self.tracer.instant(instant, cat="serving",
+                            ts=self.clock.now(),
+                            request_id=req.request_id,
+                            trace_id=req.trace_id,
+                            n_tokens=len(req.tokens),
+                            snapshot=req.migration is not None)
         return True
 
     def evacuate(self):
@@ -1475,33 +1558,10 @@ class ServingEngine:
         the queue ride along as-is: their work is not on this device yet
         beyond the shared prefix."""
         out = []
-        migration_on = self.paged and self.cfg.migration.enabled
         for slot in sorted(self._slots,
                            key=lambda s_: self._slots[s_].admit_seq):
             req = self._slots[slot]
-            # capture while the slot binding is still live (the ownership
-            # guard in capture_snapshot rejects an unbound request)
-            if migration_on:
-                self.capture_snapshot(req)
-            self._slots.pop(slot)
-            # keep the plain resume path viable too (snapshot may not
-            # splice on the target): the rng at this commit point
-            req.resume_rng = np.asarray(self._state["rng"])[slot].copy()
-            self._state = self._release_jit(self._state, np.int32(slot))
-            if self.paged:
-                self.pool_mgr.free_slot(slot)
-            if self._drafter is not None:
-                self._drafter.release(slot)
-            self._free_slots.append(slot)
-            req.slot = None
-            req.state = RequestState.QUEUED
-            self.metrics.record_migration_out()
-            self.tracer.instant("request/migrated_out", cat="serving",
-                                ts=self.clock.now(),
-                                request_id=req.request_id,
-                                trace_id=req.trace_id,
-                                n_tokens=len(req.tokens),
-                                snapshot=req.migration is not None)
+            self.evacuate_request(req)
             out.append(req)
         for job in list(self._prefill_jobs):
             req = job.req
@@ -1835,7 +1895,11 @@ class ServingEngine:
                             # is the same across replicas)
                             migrations=req.migrations,
                             failovers=req.failovers,
-                            retries=req.retries)
+                            retries=req.retries,
+                            # disaggregated fleet: first-token handoffs and
+                            # voluntary rebalance moves this stream rode
+                            handoffs=req.handoffs,
+                            rebalances=req.rebalances)
 
     # ------------------------------------------------------------- frontends
     def serve(self, requests=None, yield_rejections=True):

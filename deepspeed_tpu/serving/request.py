@@ -136,6 +136,13 @@ class Request:
     failovers: int = 0
     retries: int = 0
     migrations: int = 0
+    # disaggregated fleet (serving.pools): completed first-token
+    # prefill->decode handoffs, and the in-flight marker the router sets so
+    # the decode-side splice emits the handoff_in instant (cleared there);
+    # rebalances counts voluntary mid-flight moves off hot replicas
+    handoffs: int = 0
+    handoff_pending: bool = False
+    rebalances: int = 0
 
     def __post_init__(self):
         self.prompt = np.asarray(self.prompt, np.int32).reshape(-1)

@@ -56,6 +56,9 @@ class _Replica:
         # in-flight work failed over to survivors, never routed again)
         self.health = "live"
         self.stall_until = 0.0
+        # disaggregated-fleet role (serving.pools): "mixed" (default) |
+        # "prefill" | "decode" — assigned at Router construction
+        self.role = "mixed"
 
     @property
     def dead(self):
@@ -82,6 +85,41 @@ class _Replica:
             # routed request per live replica
             score += cfg.block_weight * sv.pool_mgr.occupancy()
         return score
+
+    def prefill_score(self, cfg):
+        """Prefill-pool dispatch score: queue depth + PENDING PROMPT
+        TOKENS (queued prompts plus in-flight prefill-job remainders,
+        normalized by the pool's token capacity) — slot/block occupancy is
+        the wrong signal for a pool whose slots recycle at first-token
+        time; what queues work here is un-prefilled prompt length."""
+        sv = self.sv
+        score = cfg.queue_weight * sv.queue.depth \
+            / max(sv.cfg.max_queue_depth, 1)
+        pending = sum(r.prompt_len for r in sv.queue._q)
+        pending += sum(len(j.ids) - j.pos for j in sv._prefill_jobs)
+        score += pending / max(sv.n_slots * sv.max_len, 1)
+        return score
+
+    def decode_score(self, cfg):
+        """Decode-pool dispatch score: slot + paged-block occupancy only
+        (a decode replica's queue holds just splices in flight — imminent
+        slots, so they count toward batch fullness: a score blind to them
+        would see a just-landed move as free capacity and the rebalancer
+        would oscillate instead of settling inside the hysteresis band)."""
+        sv = self.sv
+        score = cfg.slot_weight * (len(sv._slots) + sv.queue.depth) \
+            / max(sv.n_slots, 1)
+        if sv.paged:
+            score += cfg.block_weight * sv.pool_mgr.occupancy()
+        return score
+
+    def pool_score(self, cfg):
+        """The role-appropriate dispatch score."""
+        if self.role == "prefill":
+            return self.prefill_score(cfg)
+        if self.role == "decode":
+            return self.decode_score(cfg)
+        return self.load_score(cfg)
 
 
 class RouterMetrics:
@@ -114,6 +152,12 @@ class RouterMetrics:
         self.shed_replica_failed = 0
         self.replica_kills = 0
         self.replica_stalls = 0
+        # disaggregated fleet: completed first-token prefill->decode
+        # handoffs, and live rebalance moves (voluntary mid-flight stream
+        # migrations off hot replicas — distinct from ``rebalances``
+        # above, which counts affinity overrides at ROUTING time)
+        self.handoffs = 0
+        self.pool_rebalances = 0
         self.per_replica_routed = collections.Counter()
         self._events_emitted = 0
         # fleet-level SLO bookkeeping (emit intervals with >=1 violated
@@ -171,6 +215,33 @@ class RouterMetrics:
             self._router._slo.targets_ms() if self._router._slo is not None
             else {}, digests if digests is not None else self.fleet_digests())
 
+    def pool_rollup(self):
+        """Per-pool topology rollup: routed counts, mean occupancy and the
+        TTFT split by pool (a handed-off stream's first token fires on its
+        PREFILL replica, so pool membership of the recording replica is
+        the attribution) — the bench artifact's ``topology`` block."""
+        reps = self._router._replicas
+        to_ms = lambda v: None if v is None else v * 1e3
+        out = {"enabled": self._router._pools_on,
+               "roles": [r.role for r in reps]}
+        for role in ("prefill", "decode", "mixed"):
+            members = [r for r in reps if r.role == role]
+            if not members:
+                continue
+            ttft = [s for r in members for s in r.sv.metrics.ttft_samples]
+            out[role] = {
+                "replicas": [r.idx for r in members],
+                "routed": sum(self.per_replica_routed[r.idx]
+                              for r in members),
+                "occupancy": round(sum(
+                    r.sv.pool_mgr.occupancy() if r.sv.paged else
+                    len(r.sv._slots) / max(r.sv.n_slots, 1)
+                    for r in members) / len(members), 4),
+                "ttft_ms": {"p50": to_ms(percentile(ttft, 50)),
+                            "p99": to_ms(percentile(ttft, 99))},
+            }
+        return out
+
     def snapshot(self):
         reps = self._router._replicas
         return {
@@ -195,6 +266,12 @@ class RouterMetrics:
             "drains": self.drains,
             "rejoins": self.rejoins,
             "shed_all_replicas_saturated": self.shed_saturated,
+            # disaggregated topology: pool roles + the handoff/rebalance
+            # counters (coherent with Serving/handoffs|rebalances events)
+            "roles": [r.role for r in reps],
+            "handoffs": self.handoffs,
+            "pool_rebalances": self.pool_rebalances,
+            "pools": self.pool_rollup(),
         }
 
     def maybe_emit(self):
@@ -230,7 +307,20 @@ class RouterMetrics:
              float(snap["migration"]["retries"]), step),
             ("Serving/router_shed_replica_failed",
              float(snap["migration"]["shed_replica_failed"]), step),
+            # disaggregated topology: first-token handoffs + live rebalance
+            # moves, the same numbers snapshot() reports (tier-1 coherence)
+            ("Serving/handoffs", float(snap["handoffs"]), step),
+            ("Serving/rebalances", float(snap["pool_rebalances"]), step),
         ]
+        if snap["pools"]["enabled"]:
+            for role in ("prefill", "decode"):
+                pool = snap["pools"].get(role)
+                if pool is None:
+                    continue
+                events.append((f"Serving/pool_{role}_routed",
+                               float(pool["routed"]), step))
+                events.append((f"Serving/pool_{role}_occupancy",
+                               float(pool["occupancy"]), step))
         for i, depth in enumerate(snap["per_replica_queue_depth"]):
             events.append((f"Serving/router_r{i}_queue_depth", float(depth),
                            step))
@@ -271,6 +361,38 @@ class Router:
         # fleet SLO targets: the serving.slo block (homogeneous fleet — the
         # first replica's config speaks for all, like cfg.router above)
         self._slo = replicas[0].cfg.slo
+        # disaggregated prefill/decode pools (serving.pools): the first
+        # ``prefill_replicas`` indices prefill-to-first-token and hand off,
+        # the rest decode — per-pool overrides applied per replica here
+        # (the shared config object is never mutated)
+        self._pools = replicas[0].cfg.pools
+        self._pools_on = bool(self._pools.enabled)
+        if self._pools_on:
+            want = self._pools.prefill_replicas + self._pools.decode_replicas
+            if want != len(self._replicas):
+                raise ValueError(
+                    f"serving.pools: prefill_replicas "
+                    f"({self._pools.prefill_replicas}) + decode_replicas "
+                    f"({self._pools.decode_replicas}) must equal the fleet "
+                    f"size ({len(self._replicas)})")
+            for rep in self._replicas:
+                if rep.idx < self._pools.prefill_replicas:
+                    rep.role = "prefill"
+                    rep.sv.set_pool_role(
+                        "prefill",
+                        chunk_size=self._pools.prefill_chunk_size,
+                        speculation=self._pools.prefill_speculation)
+                else:
+                    rep.role = "decode"
+                    rep.sv.set_pool_role(
+                        "decode",
+                        chunk_size=self._pools.decode_chunk_size,
+                        speculation=self._pools.decode_speculation)
+        # live rebalancing (serving.rebalance): hysteresis-guarded actuator
+        # over the migration machinery, evaluated on its own cadence
+        self._rebalance_cfg = replicas[0].cfg.rebalance
+        self._rebalance_calls = 0
+        self._rebalance_next = 0.0   # cooldown gate (fleet-frontier time)
         self.metrics = RouterMetrics(self, monitor=monitor)
         self.tracer, self._fleet_dir = self._setup_tracing(tracer)
         self._rehome_replica_monitors()
@@ -437,20 +559,37 @@ class Router:
         scores, affinity kind honored, rebalance flag), i.e. WHY this
         replica, postmortem-readable."""
         scores = {i: self._replicas[i].load_score(self.cfg) for i in live}
+        # disaggregated pools: FRESH work dispatches into the prefill pool
+        # (scored on queue depth + pending prompt tokens); affinity may
+        # still pull it to ANY live replica — a decode-side prefix hit
+        # routes there directly (suffix-only prefill, no handoff needed).
+        # An all-dead/draining prefill pool degrades to the whole fleet.
+        if self._pools_on:
+            cands = [i for i in live
+                     if self._replicas[i].role == "prefill"] or live
+            pool_scores = {i: self._replicas[i].pool_score(self.cfg)
+                           for i in cands}
+        else:
+            cands, pool_scores = live, scores
         decision = {"policy": self.cfg.policy,
                     "scores": {str(i): round(s, 6)
                                for i, s in scores.items()},
                     "affinity": None, "rebalanced": False}
+        if self._pools_on:
+            decision["pool_scores"] = {str(i): round(s, 6)
+                                       for i, s in pool_scores.items()}
         if self.cfg.policy == "round_robin":
             # round_robin ignores load AND affinity (no lookups, no hit
             # counting) — it is the baseline the affinity/load policies are
-            # measured against
+            # measured against. Under pools it cycles the prefill pool.
             for _ in range(len(self._replicas)):
                 cand = self._rr_next % len(self._replicas)
                 self._rr_next += 1
-                if cand in scores:
+                if cand in pool_scores:
+                    self._note_pool(decision, cand)
                     return cand, decision
-            return live[0], decision
+            self._note_pool(decision, cands[0])
+            return cands[0], decision
         target = kind = None
         if self.cfg.session_affinity and req.session_id is not None:
             t = self._sessions.get(req.session_id)
@@ -459,7 +598,7 @@ class Router:
         if target is None and self.cfg.prefix_affinity:
             target = self._prefix_lookup(req, scores)
             kind = "prefix" if target is not None else None
-        best = min(live, key=lambda i: (scores[i], i))
+        best = min(cands, key=lambda i: (pool_scores[i], i))
         if target is not None:
             if scores[target] - scores[best] <= self.cfg.rebalance_margin:
                 # hits count ONLY when the affinity target is actually used:
@@ -470,12 +609,18 @@ class Router:
                 else:
                     self.metrics.prefix_hits += 1
                 decision["affinity"] = kind
+                self._note_pool(decision, target)
                 return target, decision
             # affinity would pile onto an overloaded replica: rebalance
             self.metrics.rebalances += 1
             decision["rebalanced"] = True
             decision["affinity_overridden"] = kind
+        self._note_pool(decision, best)
         return best, decision
+
+    def _note_pool(self, decision, idx):
+        if self._pools_on:
+            decision["pool"] = self._replicas[idx].role
 
     def _prefix_lookup(self, req, scores):
         """Longest prefix-chain-key hit among live replicas (the paged
@@ -642,7 +787,10 @@ class Router:
             return self._shed_failed(req, from_idx, "no_live_replica")
         scores = {i: self._replicas[i].load_score(self.cfg) for i in live}
         if started:
-            target = min(live, key=lambda i: (scores[i], i))
+            # disaggregated pools: a started stream is decode work — it
+            # recovers into the decode pool (any survivor when none lives)
+            target = min(self._pool_candidates(live, "decode"),
+                         key=lambda i: (scores[i], i))
             sv = self._replicas[target].sv
             snap = req.migration
             if req.tokens:
@@ -666,7 +814,9 @@ class Router:
                           if not self._replicas[i].saturated]
             if not candidates:
                 return self._shed_failed(req, from_idx, "all_saturated")
-            target = min(candidates, key=lambda i: (scores[i], i))
+            # a queued request still owes its whole prefill: prefill pool
+            target = min(self._pool_candidates(candidates, "prefill"),
+                         key=lambda i: (scores[i], i))
             sv = self._replicas[target].sv
             reason = sv.queue.admit(
                 req, sv.max_len,
@@ -720,7 +870,9 @@ class Router:
         req.retries += 1
         self.metrics.retries += 1
         scores = {i: self._replicas[i].load_score(self.cfg) for i in live}
-        target = min(live, key=lambda i: (scores[i], i))
+        # the poisoned prefill never streamed a token: it is prefill work
+        target = min(self._pool_candidates(live, "prefill"),
+                     key=lambda i: (scores[i], i))
         sv = self._replicas[target].sv
         reason = sv.queue.admit(
             req, sv.max_len,
@@ -735,12 +887,156 @@ class Router:
                             target=target, retries=req.retries)
         return True
 
+    def _pool_candidates(self, live, role):
+        """Restrict ``live`` to the given pool under disaggregation; the
+        whole list when pools are off or the pool has no live member (a
+        decode-pool wipeout degrades to mixed service, never to an outage)."""
+        if not self._pools_on:
+            return live
+        return [i for i in live if self._replicas[i].role == role] or live
+
+    # -------------------------------------------- first-token handoff
+    def _handoff(self, req, from_idx):
+        """Move a stream that just committed its FIRST token off its
+        prefill replica into the decode pool: capture a fresh snapshot
+        (partial tail block included — zero recompute on splice, and
+        delta-to-capture is 0 so the rng chain passes through unchanged:
+        the decode replica's stream is bitwise the prefill replica's
+        continuation), free the prefill slot (it re-admits the next prompt
+        immediately — the TTFT win), and queue-head the request at the
+        least-occupied decode replica. A handoff failure is not terminal:
+        with no live decode replica the stream simply keeps decoding where
+        it is, and a target that dies mid-splice recovers through the
+        normal failover path (the request carries the snapshot)."""
+        decode = [i for i, r in enumerate(self._replicas)
+                  if r.role == "decode" and r.health != "dead"
+                  and not r.draining]
+        if not decode:
+            return False
+        target = min(decode,
+                     key=lambda i: (self._replicas[i].decode_score(self.cfg),
+                                    i))
+        rep = self._replicas[from_idx]
+        if not rep.sv.evacuate_request(req, instant="request/handoff_out"):
+            return False
+        req.handoff_pending = True
+        now = rep.sv.clock.now()
+        self._push_started(req, target, now)
+        # the decode replica now owns the stream's blocks: future
+        # identical prompts route straight to it (cross-pool dedupe —
+        # prefix affinity both directions)
+        self._register_prefix(req, target)
+        self.metrics.handoffs += 1
+        self.tracer.instant("route/handoff", cat="router", ts=now,
+                            request_id=req.request_id,
+                            trace_id=req.trace_id, replica=from_idx,
+                            target=target, n_tokens=len(req.tokens))
+        return True
+
+    def _push_started(self, req, target, now):
+        """Land a moved started stream at ``target``'s queue head.
+        Causality under the DES: an IDLE target's clock may lag the move
+        instant — idle time passes before the splice can land (a busy
+        target's skew is already bounded by the laggard-first stepping)."""
+        rep = self._replicas[target]
+        if not rep.busy:
+            gap = now - rep.sv.clock.now()
+            if gap > 0:
+                rep.sv.clock.sleep(gap)
+        rep.sv.queue.push_front(req)
+        self._requests[req.request_id] = (req, target)
+
+    # -------------------------------------------------- live rebalancing
+    def _move_delta(self, hot, cold, req):
+        """Predicted total score shift of moving ``req`` hot -> cold: the
+        slot term leaves one side and lands on the other, and the stream's
+        blocks migrate between the pools. The overshoot guard compares the
+        measured gap against this BEFORE moving — the units are the same
+        (both are load-score points), so the comparison is exact up to
+        on-demand pool growth."""
+        d = self.cfg.slot_weight * (1.0 / max(hot.sv.n_slots, 1)
+                                    + 1.0 / max(cold.sv.n_slots, 1))
+        if hot.sv.paged and cold.sv.paged:
+            blocks = -(-(req.prompt_len + len(req.tokens))
+                       // hot.sv.pool_mgr.block_size)
+            d += self.cfg.block_weight * blocks * (
+                1.0 / max(hot.sv.pool_mgr.n_blocks, 1)
+                + 1.0 / max(cold.sv.pool_mgr.n_blocks, 1))
+        return d
+
+    def _maybe_rebalance(self):
+        """The bounded, hysteresis-guarded rebalance trigger (serving.
+        rebalance): when the hottest decode replica's score exceeds the
+        coldest's by more than ``min_gain``, migrate up to
+        ``max_concurrent`` longest-tail streams hot -> cold, then cool
+        down. Thrash-proof by construction: a stream moves only when the
+        measured gap ALSO exceeds its predicted score shift minus
+        ``min_gain`` (the overshoot guard — the post-move REVERSE gap
+        ``delta - gap`` stays strictly inside the hysteresis band, so the
+        move itself can never arm the opposite trigger; only an external
+        load change can), moves stop the moment the RE-MEASURED gap falls
+        inside the band, every trigger is followed by a ``cooldown``
+        window, and voluntary moves never burn the retry budget."""
+        cfg = self._rebalance_cfg
+        if not cfg.enabled:
+            return
+        self._rebalance_calls += 1
+        if self._rebalance_calls % cfg.interval:
+            return
+        now = self._frontier()
+        if now < self._rebalance_next:
+            return
+        cands = [r for r in self._replicas
+                 if r.health == "live" and not r.draining
+                 and (not self._pools_on or r.role == "decode")]
+        if len(cands) < 2:
+            return
+        score = lambda r: r.decode_score(self.cfg)
+        hot = max(cands, key=lambda r: (score(r), r.idx))
+        cold = min(cands, key=lambda r: (score(r), r.idx))
+        if hot is cold or score(hot) - score(cold) <= cfg.min_gain:
+            return
+        # longest-tail first: the streams with the most decode left
+        # amortize the splice cost best (and vacate the most future work)
+        streams = sorted(
+            (r for r in hot.sv._slots.values() if r.tokens),
+            key=lambda r: r.max_new_tokens - len(r.tokens), reverse=True)
+        moved = 0
+        for req in streams:
+            gap = score(hot) - score(cold)
+            if moved >= cfg.max_concurrent or gap <= cfg.min_gain:
+                break
+            if gap <= self._move_delta(hot, cold, req) - cfg.min_gain:
+                # overshoot guard: this stream is heavy enough that moving
+                # it would swing the pair past equality by more than the
+                # hysteresis band and re-trigger in reverse — a lighter
+                # stream further down the tail may still fit
+                continue
+            if not hot.sv.evacuate_request(req):
+                continue
+            req.rebalances += 1
+            self._push_started(req, cold.idx, now)
+            self._register_prefix(req, cold.idx)
+            self.metrics.pool_rebalances += 1
+            self.tracer.instant("route/rebalance", cat="router", ts=now,
+                                request_id=req.request_id,
+                                trace_id=req.trace_id, replica=hot.idx,
+                                target=cold.idx, n_tokens=len(req.tokens),
+                                remaining=req.max_new_tokens
+                                - len(req.tokens))
+            moved += 1
+        if moved:
+            self._rebalance_next = now + cfg.cooldown
+
     def _filter_events(self, idx, raw):
         """Every replica step's events pass through here: unhealthy_slot
         sheds get the cross-replica retry (swallowed on success — the
         consumer never sees a request fail that the fleet then finishes),
-        and finished requests leave the in-flight registry."""
+        a prefill replica's FIRST-token events trigger the prefill->decode
+        handoff, and finished requests leave the in-flight registry."""
         out = []
+        prefill_side = self._pools_on \
+            and self._replicas[idx].role == "prefill"
         for ev in raw:
             if ev.finish_reason == FINISH_UNHEALTHY:
                 entry = self._requests.get(ev.request_id)
@@ -753,6 +1049,13 @@ class Router:
                     if res is not None:
                         out.extend(res)
                         continue
+            if prefill_side and not ev.done and ev.index == 0:
+                # first token committed on the prefill side: hand the
+                # stream off (the event itself still streams — the token
+                # is committed; only the REST of the decode moves)
+                entry = self._requests.get(ev.request_id)
+                if entry is not None and entry[1] == idx:
+                    self._handoff(entry[0], idx)
             if ev.done:
                 self._requests.pop(ev.request_id, None)
             out.append(ev)
@@ -818,6 +1121,7 @@ class Router:
         manual-driving path). Returns the concatenated TokenEvents."""
         events = list(self._fire_chaos())
         self._update_health()
+        self._maybe_rebalance()
         for rep in self._replicas:
             if rep.busy and not rep.dead:
                 events.extend(self._filter_events(rep.idx, rep.sv.step()))
@@ -856,6 +1160,7 @@ class Router:
                 for ev in self._fire_chaos():
                     yield ev
                 self._update_health()
+                self._maybe_rebalance()
                 busy = [r for r in self._replicas if r.busy and not r.dead]
                 if busy:
                     horizon = min(r.sv.clock.now() for r in busy)

@@ -34,9 +34,11 @@ from .tracer import event_to_chrome
 
 # request lifecycle + routing instants the wide-event builder consumes
 _LIFECYCLE = ("route/decision", "route/shed", "route/failover",
-              "route/retry", "request/queued", "request/shed",
+              "route/retry", "route/handoff", "route/rebalance",
+              "request/queued", "request/shed",
               "request/first_token", "request/preempted",
               "request/resumed", "request/migrated_out", "request/migrated",
+              "request/handoff_out", "request/handoff_in",
               "request/unhealthy", "request/finish")
 
 
@@ -91,6 +93,7 @@ def build_wide_events(merged_events):
             "accepted_tokens": 0, "rolled_back_tokens": 0,
             "migrations": 0, "failovers": 0, "retries": 0,
             "migrated_saved_tokens": 0,
+            "handoffs": 0, "rebalances": 0,
             "queue_wait": None, "admit_wait": None,
             "ttft": None,
             "tpot": None, "breakdown": None,
@@ -98,6 +101,7 @@ def build_wide_events(merged_events):
             "_prefill_dur": 0.0, "_prefill_ts": [],
             "_preempt_ts": [], "_resume_ts": [],
             "_migrate_out_ts": [], "_migrate_in_ts": [],
+            "_handoff_out_ts": [], "_handoff_in_ts": [],
         })
 
     for e in merged_events:
@@ -146,6 +150,15 @@ def build_wide_events(merged_events):
             r["migrations"] += 1
             r["migrated_saved_tokens"] += args.get("saved_tokens") or 0
             r["replica"] = e.get("replica", r["replica"])
+        elif name == "request/handoff_out":
+            r["_handoff_out_ts"].append(e["ts"])
+        elif name == "request/handoff_in":
+            r["_handoff_in_ts"].append(e["ts"])
+            r["handoffs"] += 1
+            r["migrated_saved_tokens"] += args.get("saved_tokens") or 0
+            r["replica"] = e.get("replica", r["replica"])
+        elif name == "route/rebalance":
+            r["rebalances"] += 1
         elif name == "route/failover":
             r["failovers"] += 1
         elif name == "route/retry":
@@ -160,7 +173,7 @@ def build_wide_events(merged_events):
                       "prefix_saved_tokens", "kv_blocks_peak",
                       "drafted_tokens", "accepted_tokens",
                       "rolled_back_tokens", "migrations", "failovers",
-                      "retries"):
+                      "retries", "handoffs", "rebalances"):
                 src = "reason" if k == "finish_reason" else k
                 if args.get(src) is not None:
                     r[k] = args[src]
@@ -172,6 +185,7 @@ def build_wide_events(merged_events):
         prefill_dur = r.pop("_prefill_dur")
         pre, res = r.pop("_preempt_ts"), r.pop("_resume_ts")
         mo, mi = r.pop("_migrate_out_ts"), r.pop("_migrate_in_ts")
+        ho, hi = r.pop("_handoff_out_ts"), r.pop("_handoff_in_ts")
         if first is not None and start is not None:
             r["ttft"] = first - start
         if finish is not None and first is not None \
@@ -192,6 +206,13 @@ def build_wide_events(merged_events):
         mstall = sum(max(b - a, 0.0) for a, b in zip(mo, mi))
         if len(mo) > len(mi) and finish is not None:
             mstall += max(finish - mo[len(mi)], 0.0)
+        # disaggregated first-token handoff: prefill-side handoff_out ->
+        # decode-side handoff_in (splice) windows, clamped like migration
+        # stalls; a handoff that degraded to replay-resume on the decode
+        # side has no handoff_in and clamps to the finish tail
+        hstall = sum(max(b - a, 0.0) for a, b in zip(ho, hi))
+        if len(ho) > len(hi) and finish is not None:
+            hstall += max(finish - ho[len(hi)], 0.0)
         r["start"], r["finish"] = start, finish
         if finish is not None and start is not None:
             r["breakdown"] = {
@@ -199,10 +220,13 @@ def build_wide_events(merged_events):
                 "prefill": prefill_dur,
                 "preempted": stall,
                 "migrated": mstall,
+                "handoff": hstall,
                 # elapsed decode attribution (co-batched wall share):
-                # first token -> finish, minus preemption/migration stalls
+                # first token -> finish, minus preemption/migration/handoff
+                # stalls
                 "decode": max((finish - (first if first is not None
-                                         else start)) - stall - mstall, 0.0),
+                                         else start))
+                              - stall - mstall - hstall, 0.0),
             }
     return reqs
 
@@ -243,7 +267,7 @@ def latency_rollup(wide_events):
     preemption stalls. Shared by fleet_report and trace_summary so both
     CLIs attribute identically."""
     rollup = {k: 0.0 for k in ("queue_wait", "prefill", "decode",
-                               "preempted", "migrated")}
+                               "preempted", "migrated", "handoff")}
     for r in wide_events.values():
         if r.get("state") != "finished":
             continue
@@ -277,6 +301,7 @@ def slowest_requests(wide_events, top_k=5):
             "kv_blocks_peak": r.get("kv_blocks_peak") or 0,
             "migrations": r.get("migrations") or 0,
             "failovers": r.get("failovers") or 0,
+            "handoffs": r.get("handoffs") or 0,
         })
     return out
 

@@ -188,6 +188,26 @@ def run_open_loop(args):
     if args.slo_ttft_p99_ms or args.slo_tpot_p99_ms:
         serving_kw["slo"] = {"ttft_p99_ms": args.slo_ttft_p99_ms,
                              "tpot_p99_ms": args.slo_tpot_p99_ms}
+    pools_on = bool(args.prefill_replicas or args.decode_replicas)
+    if pools_on:
+        if not args.paged:
+            print("--prefill-replicas/--decode-replicas require --paged "
+                  "(the first-token KV handoff splices pool blocks)",
+                  file=sys.stderr)
+            return 1
+        # disaggregated topology: the pool split IS the replica count
+        args.replicas = max(args.prefill_replicas, 1) \
+            + max(args.decode_replicas, 1)
+        serving_kw["pools"] = {
+            "enabled": True,
+            "prefill_replicas": max(args.prefill_replicas, 1),
+            "decode_replicas": max(args.decode_replicas, 1)}
+        # the handoff IS a live migration — arm fresh-snapshot capture
+        serving_kw["migration"] = {
+            "enabled": True,
+            "snapshot_interval_tokens": args.chaos_snapshot_interval}
+    if args.rebalance:
+        serving_kw["rebalance"] = {"enabled": True}
     if args.chaos_kills or args.chaos_stalls:
         if args.chaos_kills >= max(args.replicas, 1):
             print(f"--chaos-kills {args.chaos_kills} must leave at least one "
@@ -341,6 +361,14 @@ def run_open_loop(args):
         # rates, rebalances and drain counts — how the fleet actually
         # balanced, next to the throughput it earned
         "router": router_snap["router"],
+        # the disaggregated-topology block: pool roles, per-pool routed
+        # counts / occupancy / TTFT split, and the first-token handoff +
+        # live-rebalance counters (mirrors Serving/handoffs|rebalances)
+        "topology": dict(
+            router_snap["router"]["pools"],
+            roles=router_snap["router"]["roles"],
+            handoffs=router_snap["router"]["handoffs"],
+            rebalances=router_snap["router"]["pool_rebalances"]),
         # streaming-digest percentiles (fleet-merged, EXACT across replica
         # count), the SLO grade against the --slo-* targets, and the
         # goodput accounting (useful vs replay/padding device tokens) —
@@ -397,6 +425,9 @@ def run_open_loop(args):
         "session_affinity": bool(args.session_affinity),
         "kv_growth": bool(args.kv_growth),
         "spec_draft": args.spec_draft, "spec_k": args.spec_k,
+        "prefill_replicas": args.prefill_replicas,
+        "decode_replicas": args.decode_replicas,
+        "rebalance": bool(args.rebalance),
         "slo_ttft_p99_ms": args.slo_ttft_p99_ms,
         "slo_tpot_p99_ms": args.slo_tpot_p99_ms,
         "chaos_kills": args.chaos_kills, "chaos_stalls": args.chaos_stalls,
@@ -471,6 +502,22 @@ def main():
                          "accepted_tokens_per_step, drafts, rollbacks)")
     ap.add_argument("--spec-k", type=int, default=4,
                     help="max draft tokens per verify step")
+    ap.add_argument("--prefill-replicas", type=int, default=0,
+                    help="disaggregated fleet (requires --paged): dedicate "
+                         "this many replicas to PREFILL; at first token the "
+                         "stream's KV hands off to the decode pool via a "
+                         "fresh snapshot splice (zero recompute). Overrides "
+                         "--replicas to prefill+decode; the artifact gains "
+                         "a topology block (per-pool routed/occupancy, "
+                         "handoffs, rebalances, TTFT split by pool)")
+    ap.add_argument("--decode-replicas", type=int, default=0,
+                    help="disaggregated fleet: dedicate this many replicas "
+                         "to DECODE (receives first-token handoffs)")
+    ap.add_argument("--rebalance", action="store_true",
+                    help="live rebalancing (serving.rebalance): migrate "
+                         "long-tail decode streams off hot replicas mid-"
+                         "flight, with hysteresis (min_gain + cooldown) so "
+                         "the fleet never thrashes")
     ap.add_argument("--slo-ttft-p99-ms", type=float, default=0.0,
                     help="open-loop mode: serving.slo TTFT P99 target (ms; "
                          "0 = no objective) — the artifact's slo block "

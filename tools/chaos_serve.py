@@ -29,9 +29,18 @@ Usage:
     python tools/chaos_serve.py --replicas 3 --requests 10 --kills 1 \
         --stalls 1 --seed 0 --out tools/artifacts/chaos_serve_tiny_cpu.json
 
+Disaggregated mode (``--prefill-replicas/--decode-replicas``, optional
+``--rebalance``): the fleet splits into a prefill and a decode pool
+(first-token KV handoffs between them) and the seeded schedule becomes
+POOL-AWARE — kills land on the PREFILL pool (a replica dies mid-prefill /
+mid-handoff; recovery must re-dispatch through the surviving topology) and
+stalls land on the DECODE pool (degraded health while rebalancing is live).
+Same exit gates, plus the handoff machinery must actually have engaged.
+
 Exit codes: 0 ok; 2 survival gate (fault did not fire / request neither
-finished nor shed); 3 continuity gate (bitwise mismatch vs reference or
-chaos-vs-chaos nondeterminism); 4 shed gate (shed rate above ``--max-shed``).
+finished nor shed / disaggregated run with zero handoffs); 3 continuity
+gate (bitwise mismatch vs reference or chaos-vs-chaos nondeterminism);
+4 shed gate (shed rate above ``--max-shed``).
 """
 
 import argparse
@@ -61,7 +70,7 @@ def make_replica(engine, args):
     from deepspeed_tpu.config import ServingConfig
     from deepspeed_tpu.serving import ServingEngine, VirtualClock
 
-    cfg = ServingConfig(
+    kw = dict(
         virtual_clock=True,
         n_slots=args.slots,
         retry_limit=args.retry_limit,
@@ -69,6 +78,13 @@ def make_replica(engine, args):
         kv_pool={"enabled": True, "block_size": 8, "on_demand_growth": True},
         migration={"enabled": True,
                    "snapshot_interval_tokens": args.snapshot_interval})
+    if args.prefill_replicas or args.decode_replicas:
+        kw["pools"] = {"enabled": True,
+                       "prefill_replicas": max(args.prefill_replicas, 1),
+                       "decode_replicas": max(args.decode_replicas, 1)}
+    if args.rebalance:
+        kw["rebalance"] = {"enabled": True}
+    cfg = ServingConfig(**kw)
     return ServingEngine(engine, serving_config=cfg, clock=VirtualClock())
 
 
@@ -116,12 +132,22 @@ def run_chaos(engine, args):
         args.seed, horizon=args.horizon, n_replicas=args.replicas,
         n_kills=args.kills, n_stalls=args.stalls,
         stall_duration=args.stall_duration)
-    router.apply_chaos(schedule)
+    events = list(schedule.events)
+    if args.prefill_replicas or args.decode_replicas:
+        # pool-aware faults: deterministically remap the seeded schedule so
+        # kills land on the PREFILL pool (mid-prefill / mid-handoff death)
+        # and stalls on the DECODE pool (degraded health under rebalance)
+        n_p = max(args.prefill_replicas, 1)
+        n_d = max(args.decode_replicas, 1)
+        events = [(t, kind,
+                   idx % n_p if kind == "kill" else n_p + idx % n_d, dur)
+                  for t, kind, idx, dur in events]
+    router.apply_chaos(events)
     requests = make_requests(args)
     finished, rejected, snap = router.run(requests)
     return {
         "schedule": [[round(t, 6), kind, idx, dur]
-                     for t, kind, idx, dur in schedule.events],
+                     for t, kind, idx, dur in events],
         "states": [r.state.value for r in requests],
         "streams": [list(r.tokens) for r in requests],
         "finish_reasons": [r.finish_reason or r.reject_reason
@@ -137,6 +163,17 @@ def run_chaos(engine, args):
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--replicas", type=int, default=3)
+    ap.add_argument("--prefill-replicas", type=int, default=0,
+                    help="disaggregated mode: dedicate this many replicas "
+                         "to PREFILL (first-token KV handoff to the decode "
+                         "pool); overrides --replicas to prefill+decode and "
+                         "makes the chaos schedule pool-aware (kills target "
+                         "the prefill pool, stalls the decode pool)")
+    ap.add_argument("--decode-replicas", type=int, default=0,
+                    help="disaggregated mode: decode-pool size")
+    ap.add_argument("--rebalance", action="store_true",
+                    help="arm live rebalancing (serving.rebalance) so decode "
+                         "stalls exercise the hot->cold migration path")
     ap.add_argument("--requests", type=int, default=10)
     ap.add_argument("--kills", type=int, default=1)
     ap.add_argument("--stalls", type=int, default=1)
@@ -159,6 +196,10 @@ def main(argv=None):
     ap.add_argument("--out", default="")
     args = ap.parse_args(argv)
 
+    pools_on = bool(args.prefill_replicas or args.decode_replicas)
+    if pools_on:
+        args.replicas = max(args.prefill_replicas, 1) \
+            + max(args.decode_replicas, 1)
     if args.kills >= args.replicas:
         print(f"--kills {args.kills} must leave at least one survivor of "
               f"--replicas {args.replicas}", file=sys.stderr)
@@ -187,13 +228,17 @@ def main(argv=None):
         for k in ("states", "streams", "finish_reasons", "failovers",
                   "migrations", "schedule")) \
         and chaos["snapshot"]["router"]["migration"] == \
-        rerun["snapshot"]["router"]["migration"]
+        rerun["snapshot"]["router"]["migration"] \
+        and all(chaos["snapshot"]["router"][k] ==
+                rerun["snapshot"]["router"][k]
+                for k in ("handoffs", "pool_rebalances"))
     shed_rate = chaos["n_rejected"] / max(args.requests, 1)
 
     record = {
         "tool": "chaos_serve",
         "config": {k: getattr(args, k) for k in
-                   ("replicas", "requests", "kills", "stalls", "seed",
+                   ("replicas", "prefill_replicas", "decode_replicas",
+                    "rebalance", "requests", "kills", "stalls", "seed",
                     "slots", "new_tokens", "vocab", "seq", "retry_limit",
                     "snapshot_interval", "horizon", "stall_duration",
                     "arrival_gap", "max_shed")},
@@ -213,6 +258,14 @@ def main(argv=None):
         "resilience": dict(mig, replay_tokens=goodput["replay_tokens"],
                            migrated_saved_tokens=mig["migrated_saved_tokens"]),
         "goodput": goodput,
+        # the disaggregated-topology block: pool roles, per-pool rollup and
+        # the handoff/rebalance counters (empty-by-default mixed fleets
+        # carry enabled=false)
+        "topology": dict(
+            chaos["snapshot"]["router"]["pools"],
+            roles=chaos["snapshot"]["router"]["roles"],
+            handoffs=chaos["snapshot"]["router"]["handoffs"],
+            rebalances=chaos["snapshot"]["router"]["pool_rebalances"]),
         "health": chaos["snapshot"]["router"]["health"],
         "makespan": chaos["snapshot"].get("makespan"),
         "per_request": [
@@ -233,6 +286,10 @@ def main(argv=None):
     if kills_fired != args.kills or stalls_fired != args.stalls:
         print(f"FAIL: fired {kills_fired}/{args.kills} kills, "
               f"{stalls_fired}/{args.stalls} stalls", file=sys.stderr)
+        return 2
+    if pools_on and record["topology"]["handoffs"] == 0:
+        print("FAIL: disaggregated run completed with zero prefill->decode "
+              "handoffs — the pool machinery never engaged", file=sys.stderr)
         return 2
     if nonterminal:
         print(f"FAIL: requests {nonterminal} neither finished nor shed",

@@ -85,6 +85,10 @@ def _row(label, rows):
         "migrations": sum(r.get("migrations") or 0 for r in fin),
         "failovers": sum(r.get("failovers") or 0 for r in fin),
         "retries": sum(r.get("retries") or 0 for r in fin),
+        # disaggregated topology: first-token handoffs / live rebalances
+        # the finished rows went through
+        "handoffs": sum(r.get("handoffs") or 0 for r in fin),
+        "rebalances": sum(r.get("rebalances") or 0 for r in fin),
         **gp,
         "ttft_p50_ms": d["ttft"].quantile_ms(50),
         "ttft_p99_ms": d["ttft"].quantile_ms(99),
@@ -145,6 +149,31 @@ def summarize(wide, fleet=None, targets_ms=None, top_k=5):
     critical = slowest_requests(wide, top_k=top_k)
 
     strip = lambda r: {k: v for k, v in r.items() if not k.startswith("_")}
+
+    # per-pool tables (disaggregated fleets): group wide rows by the ROLE
+    # of the replica each request finished on (fleet.json's router block
+    # carries the role list; a handed-off stream therefore lands in the
+    # decode pool's row — where its tokens were produced)
+    pools = None
+    router_blk = (fleet or {}).get("router") or {}
+    roles = router_blk.get("roles")
+    if roles and (router_blk.get("pools") or {}).get("enabled"):
+        by_role = {}
+        for r in rows:
+            label = str(r.get("replica") or "?")
+            try:
+                role = roles[int(label.replace("replica", ""))]
+            except (ValueError, IndexError):
+                role = "?"
+            by_role.setdefault(role, []).append(r)
+        pool_rows = [_row(f"pool:{role}", rs)
+                     for role, rs in sorted(by_role.items())]
+        pools = {
+            "rollup": router_blk.get("pools"),
+            "handoffs": router_blk.get("handoffs") or 0,
+            "rebalances": router_blk.get("pool_rebalances") or 0,
+            "rows": [strip(r) for r in pool_rows],
+        }
     return {
         "requests": len(rows),
         "replicas": [strip(r) for r in replica_rows],
@@ -158,7 +187,9 @@ def summarize(wide, fleet=None, targets_ms=None, top_k=5):
         "slo": slo,
         "digest_coherence": coherence,
         "critical_paths": critical,
+        "pools": pools,
         "_replica_rows": replica_rows, "_fleet_row": fleet_row,
+        "_pool_rows": pool_rows if pools else None,
     }
 
 
@@ -171,6 +202,22 @@ def print_report(summary):
         print(r["_fmt"](r))
     fr = summary["_fleet_row"]
     print(fr["_fmt"](fr))
+
+    pools = summary.get("pools")
+    if pools:
+        # per-pool rows: same columns, requests grouped by the ROLE of the
+        # replica they finished on (handed-off streams land in pool:decode)
+        for r in summary["_pool_rows"]:
+            print(r["_fmt"](r))
+        roll = pools.get("rollup") or {}
+        split = ", ".join(
+            f"{role} ttft p50/p99 "
+            f"{(roll.get(role) or {}).get('ttft_ms', {}).get('p50')}"
+            f"/{(roll.get(role) or {}).get('ttft_ms', {}).get('p99')} ms"
+            for role in ("prefill", "decode") if roll.get(role))
+        print(f"topology: {pools['handoffs']} first-token handoffs, "
+              f"{pools['rebalances']} live rebalances"
+              + (f" ({split})" if split else ""))
 
     gp = summary["goodput"]
     if "goodput_frac" in gp:

@@ -114,8 +114,8 @@ def summarize(events, scalars, max_exposed_frac=None):
 
 
 def load_fleet(path):
-    """(merged_span_events, wide_events) from a fleet dir (or None if the
-    path is not one — no requests.jsonl)."""
+    """(merged_span_events, wide_events, fleet_json_or_None) from a fleet
+    dir (or None if the path is not one — no requests.jsonl)."""
     if not os.path.isdir(path):
         return None
     req_file = os.path.join(path, "requests.jsonl")
@@ -123,10 +123,16 @@ def load_fleet(path):
         return None
     spans_file = os.path.join(path, "spans.jsonl")
     events = load_jsonl(spans_file) if os.path.exists(spans_file) else []
-    return events, load_wide_events(req_file)
+    fleet_json = None
+    fj = os.path.join(path, "fleet.json")
+    if os.path.exists(fj):
+        with open(fj) as f:
+            fleet_json = json.load(f)
+    return events, load_wide_events(req_file), fleet_json
 
 
-def summarize_fleet(events, wide, max_ttft_p99_ms=None, top_k=5):
+def summarize_fleet(events, wide, max_ttft_p99_ms=None, top_k=5,
+                    fleet_json=None):
     """Fleet rollup: per-replica phase totals, the critical-path
     attribution of fleet latency, digest percentiles + P99 flagging."""
     # per-replica phase table: span time by (replica, span name)
@@ -142,15 +148,18 @@ def summarize_fleet(events, wide, max_ttft_p99_ms=None, top_k=5):
             if name not in phases:
                 phases.append(name)
 
-    # recovery instants: where and when the fleet moved work — live KV
-    # migrations (out/in pairs), replica failovers, cross-replica retries —
-    # pulled from the merged span stream so the timeline is inspectable
-    # next to the latency it explains
+    # recovery + topology instants: where and when the fleet moved work —
+    # live KV migrations (out/in pairs), replica failovers, cross-replica
+    # retries, first-token prefill->decode handoffs and live rebalance
+    # moves — pulled from the merged span stream so the timeline is
+    # inspectable next to the latency it explains
     recovery = []
     for e in events:
         if e.get("ph") == "i" and e.get("name") in (
                 "request/migrated_out", "request/migrated",
-                "route/failover", "route/retry"):
+                "route/failover", "route/retry",
+                "request/handoff_out", "request/handoff_in",
+                "route/handoff", "route/rebalance"):
             a = e.get("args") or {}
             recovery.append({
                 "t": e.get("ts"), "event": e["name"],
@@ -171,7 +180,7 @@ def summarize_fleet(events, wide, max_ttft_p99_ms=None, top_k=5):
                and p99_bucket
                > LatencyDigest.bucket_index(max_ttft_p99_ms / 1e3))
 
-    return {
+    out = {
         "mode": "fleet",
         "requests": len(wide),
         "finished": sum(1 for r in wide.values()
@@ -190,10 +199,44 @@ def summarize_fleet(events, wide, max_ttft_p99_ms=None, top_k=5):
         "recovery_instants": recovery,
         "migrations": sum(r.get("migrations") or 0 for r in wide.values()),
         "failovers": sum(r.get("failovers") or 0 for r in wide.values()),
+        "handoffs": sum(r.get("handoffs") or 0 for r in wide.values()),
+        "rebalances": sum(r.get("rebalances") or 0
+                          for r in wide.values()),
         "max_ttft_p99_ms": max_ttft_p99_ms,
         "ttft_p99_ms": p99,
         "flagged_steps": ["fleet_ttft_p99"] if flagged else [],
     }
+    # per-pool table (disaggregated fleets): wide rows grouped by the ROLE
+    # of the replica each request finished on (fleet.json's router block
+    # carries the role list)
+    router_blk = (fleet_json or {}).get("router") or {}
+    roles = router_blk.get("roles")
+    if roles and (router_blk.get("pools") or {}).get("enabled"):
+        by_role = {}
+        for r in wide.values():
+            label = str(r.get("replica") or "?")
+            try:
+                role = roles[int(label.replace("replica", ""))]
+            except (ValueError, IndexError):
+                role = "?"
+            by_role.setdefault(role, []).append(r)
+        out["pools"] = {
+            role: {
+                "requests": len(rs),
+                "finished": sum(1 for r in rs
+                                if r.get("state") == "finished"),
+                "handoffs": sum(r.get("handoffs") or 0 for r in rs),
+                "rebalances": sum(r.get("rebalances") or 0 for r in rs),
+                "ttft_ms": {
+                    q: digest_from_wide_events(
+                        {r["request_id"]: r for r in rs},
+                        "ttft").quantile_ms(qv)
+                    for q, qv in (("p50", 50), ("p99", 99))},
+            } for role, rs in sorted(by_role.items())}
+        out["pools"]["_fleet"] = {
+            "handoffs": router_blk.get("handoffs") or 0,
+            "rebalances": router_blk.get("pool_rebalances") or 0}
+    return out
 
 
 def print_fleet_summary(summary):
@@ -226,9 +269,28 @@ def print_fleet_summary(summary):
               f"{s['ttft_ms']:.1f} ms = {parts} ({s['preemptions']} "
               f"preemptions, {s.get('migrations') or 0} migrations, "
               f"{s['chunks']} chunks)")
+    pools = summary.get("pools")
+    if pools:
+        fl = pools.get("_fleet") or {}
+        print(f"\nper-pool (finishing replica's role; "
+              f"{fl.get('handoffs', 0)} handoffs, "
+              f"{fl.get('rebalances', 0)} rebalances fleet-wide):")
+        print("| pool | reqs | finished | handoffs | rebalances "
+              "| ttft p50 ms | ttft p99 ms |")
+        print("|---|---|---|---|---|---|---|")
+        for role, row in pools.items():
+            if role == "_fleet":
+                continue
+            t = row["ttft_ms"]
+            ms = lambda v: "-" if v is None else f"{v:.1f}"
+            print(f"| {role} | {row['requests']} | {row['finished']} "
+                  f"| {row['handoffs']} | {row['rebalances']} "
+                  f"| {ms(t['p50'])} | {ms(t['p99'])} |")
     if summary["recovery_instants"]:
         print(f"\nrecovery timeline ({summary['migrations']} migrations, "
-              f"{summary['failovers']} failovers):")
+              f"{summary['failovers']} failovers, "
+              f"{summary['handoffs']} handoffs, "
+              f"{summary['rebalances']} rebalances):")
         for r in summary["recovery_instants"]:
             t = "-" if r["t"] is None else f"{r['t']:.3f}"
             saved = f", saved {r['saved_tokens']} tok" \
@@ -322,9 +384,10 @@ def main(argv=None):
                   "--max-ttft-p99-ms, or point at a per-replica trace dir",
                   file=sys.stderr)
             return 1
-        events, wide = fleet
+        events, wide, fleet_json = fleet
         summary = summarize_fleet(events, wide,
-                                  max_ttft_p99_ms=args.max_ttft_p99_ms)
+                                  max_ttft_p99_ms=args.max_ttft_p99_ms,
+                                  fleet_json=fleet_json)
         print_fleet_summary(summary)
     else:
         events, scalars = load_trace(args.trace, args.scalars)
