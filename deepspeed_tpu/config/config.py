@@ -637,6 +637,177 @@ class RebalanceConfig(ConfigModel):
                 f"rebalance.interval must be >= 1, got {self.interval}")
 
 
+class AutoscalerConfig(ConfigModel):
+    """SLO-driven replica autoscaling (``serving/control.py``): the Router
+    watches the windowed ``slo_burn_rate`` + queue depth of each replica
+    group (the whole fleet, or each prefill/decode pool independently under
+    ``serving.pools``) and scales the ACTIVE replica set through the
+    existing drain(migrate=True)/rejoin lifecycle — scale up on sustained
+    burn, drain down on sustained idle. The fleet the Router was built
+    with is the ceiling; ``min_replicas`` is the floor (per pool when
+    pools are enabled). Hysteresis follows the rebalance overshoot-guard
+    discipline: a dead band between the up and down thresholds, N
+    consecutive evaluations before any action, a cooldown between actions,
+    and a capacity guard that refuses a drain-down unless the surviving
+    replicas can absorb every in-flight stream — so the controller
+    provably never thrashes (a down can only fire when it cannot
+    re-create the up signal from the load present at decision time)."""
+
+    enabled: bool = False
+    # floor of ACTIVE replicas (per pool under serving.pools); the replica
+    # list the Router was constructed with is the ceiling
+    min_replicas: int = 1
+    # windowed burn rate (samples since the previous evaluation) at/above
+    # which an evaluation counts toward scale-up
+    scale_up_burn: float = 1.0
+    # windowed burn rate at/below which (with an empty queue) an
+    # evaluation counts toward drain-down; must sit strictly below
+    # scale_up_burn — this gap IS the hysteresis dead band
+    scale_down_burn: float = 0.25
+    # mean queue depth per active replica that also arms scale-up
+    # (0 disables the queue trigger; burn alone then drives it)
+    scale_up_queue_depth: float = 0.0
+    # consecutive armed evaluations before an action fires
+    sustain_evals: int = 2
+    # seconds (virtual under a VirtualClock) between scale actions
+    cooldown: float = 4.0
+    # router loop iterations between evaluations (cf. rebalance.interval)
+    interval: int = 8
+
+    def _validate(self):
+        if self.min_replicas < 1:
+            raise ConfigError(
+                f"autoscaler.min_replicas must be >= 1, got "
+                f"{self.min_replicas}")
+        if self.scale_up_burn <= 0:
+            raise ConfigError(
+                f"autoscaler.scale_up_burn must be > 0, got "
+                f"{self.scale_up_burn}")
+        if not 0 <= self.scale_down_burn < self.scale_up_burn:
+            raise ConfigError(
+                "autoscaler.scale_down_burn must sit in [0, scale_up_burn) "
+                f"— the hysteresis dead band — got {self.scale_down_burn} "
+                f"vs scale_up_burn={self.scale_up_burn}")
+        if self.scale_up_queue_depth < 0:
+            raise ConfigError(
+                f"autoscaler.scale_up_queue_depth must be >= 0 (0 "
+                f"disables), got {self.scale_up_queue_depth}")
+        if self.sustain_evals < 1:
+            raise ConfigError(
+                f"autoscaler.sustain_evals must be >= 1, got "
+                f"{self.sustain_evals}")
+        if self.cooldown < 0:
+            raise ConfigError(
+                f"autoscaler.cooldown must be >= 0, got {self.cooldown}")
+        if self.interval < 1:
+            raise ConfigError(
+                f"autoscaler.interval must be >= 1, got {self.interval}")
+
+
+class TenantClassConfig(ConfigModel):
+    """One tenant class (``serving.tenants.interactive`` / ``.batch``):
+    the weighted-fair share, the per-tenant token-bucket admission budget,
+    and an optional per-class TTFT objective for per-tenant SLO grading."""
+
+    # weighted-fair admission share (start-time fair queuing over tenants:
+    # a tenant's virtual time advances by admitted_tokens / weight)
+    weight: float = 1.0
+    # per-TENANT token-bucket budget: sustained admitted tokens
+    # (prompt + max_new_tokens) per second (virtual under a VirtualClock);
+    # 0 = unlimited. Over-budget requests WAIT in the queue (deferral,
+    # not shedding) until the bucket refills — enforcement is exact under
+    # the virtual clock.
+    token_budget_per_s: float = 0.0
+    # bucket capacity (burst); 0 = one second's refill (token_budget_per_s)
+    token_budget_burst: float = 0.0
+    # per-class TTFT P99 target for per-tenant SLO grades (ms; 0 inherits
+    # serving.slo.ttft_p99_ms)
+    ttft_p99_ms: float = 0.0
+
+    def _validate(self):
+        if self.weight <= 0:
+            raise ConfigError(
+                f"tenants class weight must be > 0, got {self.weight}")
+        for field in ("token_budget_per_s", "token_budget_burst",
+                      "ttft_p99_ms"):
+            if getattr(self, field) < 0:
+                raise ConfigError(
+                    f"tenants class {field} must be >= 0, got "
+                    f"{getattr(self, field)}")
+
+
+class TenantsConfig(ConfigModel):
+    """Multi-tenant QoS (``serving.tenants``): requests carry a
+    ``tenant_id`` + a class (``interactive`` | ``batch``); admission
+    becomes weighted-fair across tenants (``serving.policy:
+    "weighted_fair"``) with per-tenant token budgets, and a latency-class
+    arrival may evict a batch-class stream mid-flight through the
+    rollback-safe preemption machinery (the evicted stream resumes
+    bitwise-identically — the PR 12/14 contract)."""
+
+    enabled: bool = False
+    interactive: TenantClassConfig = None   # default weight 4.0
+    batch: TenantClassConfig = None         # default weight 1.0
+    # priority preemption: when no slot is free and an arrived interactive
+    # request waits, preempt the NEWEST-admitted batch-class stream
+    # (paged pools only — preemption rides the block-release machinery)
+    preempt: bool = True
+
+    def _validate(self):
+        if self.interactive is None:
+            self.interactive = TenantClassConfig(weight=4.0)
+        if self.batch is None:
+            self.batch = TenantClassConfig(weight=1.0)
+
+    def class_config(self, tenant_class):
+        return self.batch if tenant_class == "batch" else self.interactive
+
+
+class DegradedConfig(ConfigModel):
+    """Degraded modes as first-class policy (``serving.degraded``): an
+    ordered ladder the engine climbs under sustained SLO burn and descends
+    when the burn clears, with entry/exit hysteresis so the ladder never
+    oscillates. Rungs, in order: (1) shed new batch-class requests,
+    (2) also cap ``max_new_tokens`` on new admissions, (3) also drop
+    speculation (the compiled verify stays warm; seeded streams are
+    unaffected — the PR 14 pin), (4) shed interactive too — the last
+    resort. Interactive traffic is never shed before rung 4."""
+
+    enabled: bool = False
+    # windowed burn rate at/above which an evaluation counts toward
+    # climbing one rung
+    enter_burn: float = 1.0
+    # windowed burn rate at/below which an evaluation counts toward
+    # descending one rung; must sit strictly below enter_burn
+    exit_burn: float = 0.25
+    # consecutive armed evaluations before a rung change
+    enter_evals: int = 2
+    exit_evals: int = 2
+    # rung 2+: max_new_tokens cap applied to NEW admissions
+    max_new_tokens_cap: int = 8
+    # scheduler steps between evaluations
+    interval: int = 8
+
+    def _validate(self):
+        if self.enter_burn <= 0:
+            raise ConfigError(
+                f"degraded.enter_burn must be > 0, got {self.enter_burn}")
+        if not 0 <= self.exit_burn < self.enter_burn:
+            raise ConfigError(
+                "degraded.exit_burn must sit in [0, enter_burn) — the "
+                f"hysteresis dead band — got {self.exit_burn} vs "
+                f"enter_burn={self.enter_burn}")
+        for field in ("enter_evals", "exit_evals", "interval"):
+            if getattr(self, field) < 1:
+                raise ConfigError(
+                    f"degraded.{field} must be >= 1, got "
+                    f"{getattr(self, field)}")
+        if self.max_new_tokens_cap < 1:
+            raise ConfigError(
+                f"degraded.max_new_tokens_cap must be >= 1, got "
+                f"{self.max_new_tokens_cap}")
+
+
 class ServingConfig(ConfigModel):
     """Continuous-batching serving (Orca-style slot scheduler over ONE jitted
     decode program; DeepSpeed-Inference's serving-side batching layer,
@@ -654,7 +825,9 @@ class ServingConfig(ConfigModel):
     # prefill/decode interleaving: at most this many prefills per scheduler
     # step, so a burst of arrivals can't starve running decodes (TPOT)
     max_prefills_per_step: int = 1
-    # fcfs is the only policy today; the field pins the config surface
+    # admission policy: "fcfs" (strict arrival order + bounded HOL bypass)
+    # or "weighted_fair" (start-time fair queuing across tenants with
+    # per-tenant token budgets; serving.tenants configures the classes)
     policy: str = "fcfs"
     # deterministic virtual-clock mode (tests/simulation): scheduler time
     # advances by the cost model below instead of the wall clock
@@ -697,6 +870,15 @@ class ServingConfig(ConfigModel):
     # live decode rebalancing: hysteresis-guarded migration of long-tail
     # streams off hot replicas (rebalance.enabled)
     rebalance: RebalanceConfig = None
+    # SLO-driven replica autoscaling over the Router's fleet
+    # (autoscaler.enabled): drain/rejoin actuation on windowed burn rate
+    autoscaler: AutoscalerConfig = None
+    # tenant/priority classes: weighted-fair admission shares, per-tenant
+    # token budgets, priority preemption (tenants.enabled)
+    tenants: TenantsConfig = None
+    # degraded-mode ladder under SLO burn: shed batch -> cap tokens ->
+    # drop speculation -> shed interactive, hysteresis-guarded
+    degraded: DegradedConfig = None
     # cross-replica retry budget: a request that hits a recoverable
     # per-replica failure (unhealthy_slot, replica crash) is re-dispatched
     # to a different replica up to this many times before the terminal shed
@@ -719,6 +901,12 @@ class ServingConfig(ConfigModel):
             self.pools = PoolsConfig()
         if self.rebalance is None:
             self.rebalance = RebalanceConfig()
+        if self.autoscaler is None:
+            self.autoscaler = AutoscalerConfig()
+        if self.tenants is None:
+            self.tenants = TenantsConfig()
+        if self.degraded is None:
+            self.degraded = DegradedConfig()
         if self.pools.enabled and not self.kv_pool.enabled:
             raise ConfigError(
                 "serving.pools.enabled requires serving.kv_pool.enabled: "
@@ -746,11 +934,22 @@ class ServingConfig(ConfigModel):
         if self.max_queue_depth < 1:
             raise ConfigError(
                 f"serving.max_queue_depth must be >= 1, got {self.max_queue_depth}")
-        if self.policy != "fcfs":
+        if self.policy not in ("fcfs", "weighted_fair"):
             raise ConfigError(
-                f"serving.policy must be 'fcfs', got {self.policy!r}")
+                f"serving.policy must be 'fcfs' or 'weighted_fair', got "
+                f"{self.policy!r}")
         if self.max_prefills_per_step < 1:
             raise ConfigError("serving.max_prefills_per_step must be >= 1")
+        if self.autoscaler.enabled and not self.slo.armed \
+                and self.autoscaler.scale_up_queue_depth <= 0:
+            raise ConfigError(
+                "serving.autoscaler.enabled needs a sensor: set a "
+                "serving.slo target (burn-rate trigger) and/or "
+                "autoscaler.scale_up_queue_depth (queue trigger)")
+        if self.degraded.enabled and not self.slo.armed:
+            raise ConfigError(
+                "serving.degraded.enabled requires a serving.slo target: "
+                "the ladder's only input is the windowed SLO burn rate")
 
 
 class TelemetryConfig(ConfigModel):

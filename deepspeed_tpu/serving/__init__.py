@@ -7,16 +7,19 @@ Serving/* metrics — the request-level layer that turns the single-call
 """
 
 from .clock import VirtualClock, WallClock
+from .control import (DEGRADED_LADDER, Autoscaler, BurnSensor,
+                      DegradedModeController)
 from .engine import ServingEngine
 from .kv_pool import GARBAGE_BLOCK, KVPoolManager, prefix_chain_keys
 from .metrics import ServingMetrics, percentile
 from .migration import RequestSnapshot, advance_rng
 from .queue import RequestQueue
-from .request import (FINISH_EOS, FINISH_LENGTH, FINISH_UNHEALTHY,
-                      REJECT_ALL_REPLICAS_SATURATED, REJECT_NO_FREE_BLOCKS,
-                      REJECT_PROMPT_TOO_LONG, REJECT_QUEUE_FULL,
-                      REJECT_REPLICA_FAILED, Request, RequestState,
-                      SamplingParams, TokenEvent, as_request)
+from .request import (CLASS_BATCH, CLASS_INTERACTIVE, FINISH_EOS,
+                      FINISH_LENGTH, FINISH_UNHEALTHY,
+                      REJECT_ALL_REPLICAS_SATURATED, REJECT_DEGRADED,
+                      REJECT_NO_FREE_BLOCKS, REJECT_PROMPT_TOO_LONG,
+                      REJECT_QUEUE_FULL, REJECT_REPLICA_FAILED, Request,
+                      RequestState, SamplingParams, TokenEvent, as_request)
 from .router import Router, RouterMetrics
 from .scheduler import ServingScheduler, simulate_static_batching
 from .speculative import ModelDrafter, NgramDrafter
@@ -39,6 +42,12 @@ __all__ = [
     "GARBAGE_BLOCK",
     "Router",
     "RouterMetrics",
+    "Autoscaler",
+    "BurnSensor",
+    "DegradedModeController",
+    "DEGRADED_LADDER",
+    "CLASS_INTERACTIVE",
+    "CLASS_BATCH",
     "NgramDrafter",
     "ModelDrafter",
     "RequestSnapshot",
@@ -52,4 +61,5 @@ __all__ = [
     "REJECT_NO_FREE_BLOCKS",
     "REJECT_ALL_REPLICAS_SATURATED",
     "REJECT_REPLICA_FAILED",
+    "REJECT_DEGRADED",
 ]

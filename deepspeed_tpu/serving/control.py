@@ -1,0 +1,363 @@
+"""SLO-driven serving control plane: autoscaling + degraded-mode policy.
+
+PR 13 built the fleet's sensors (streaming log-bucket latency digests,
+``slo_burn_rate``, goodput, wide events) and the disaggregated-fleet work
+built the actuator surface (pooled replicas, drain(migrate=True)/rejoin,
+live migration, rebalance). This module closes the control loop:
+
+- :class:`BurnSensor` — the WINDOWED burn rate a controller actually needs:
+  the fraction-over-target of the samples added since the previous
+  evaluation, against the 1% error budget a P99 objective grants. The
+  cumulative digest burn (``evaluate_slo``) is an ever-growing average —
+  fine for grading a run, useless for reacting to a burst mid-run.
+- :class:`Autoscaler` — scales the Router's ACTIVE replica set between
+  ``autoscaler.min_replicas`` and the constructed fleet size through the
+  existing drain/rejoin lifecycle, per pool when ``serving.pools`` splits
+  the fleet. Hysteresis reuses the rebalance overshoot-guard discipline:
+  a dead band between thresholds, sustained evaluations, a cooldown, and
+  a capacity guard on drain-down — so scale decisions are deterministic
+  under the virtual clock and provably never ping-pong.
+- :class:`DegradedModeController` — the ordered degradation ladder
+  (``serving.degraded``): shed batch tenants first, then cap
+  ``max_new_tokens``, then drop speculation, before any interactive shed;
+  entry/exit hysteresis; ``Serving/degraded_level`` events.
+
+Everything here is pure host policy over the discrete-event fleet — every
+behavior is assertable deterministically under VirtualClocks, no chips.
+"""
+
+from ..telemetry.digest import LatencyDigest
+from .request import CLASS_BATCH, CLASS_INTERACTIVE
+
+# the degraded ladder, in escalation order; index == level
+DEGRADED_LADDER = ("healthy", "shed_batch", "cap_tokens", "no_speculation",
+                   "shed_interactive")
+
+
+class BurnSensor:
+    """Windowed SLO burn over a stream of digest states.
+
+    ``update(targets_ms, digests)`` returns the worst per-metric burn rate
+    over the samples added SINCE the previous call: (fraction of new
+    samples whose bucket sits strictly above the target's bucket) / 0.01.
+    Bucket-granular like ``evaluate_slo`` — deterministic, merge-stable.
+    A window with no new samples reads 0.0 (no evidence of burn — the
+    idle-fleet signal a drain-down needs). ``reset_window()`` digest swaps
+    shrink the counts; such windows also read 0.0 and re-baseline.
+    """
+
+    def __init__(self):
+        self._last = {}   # metric -> (count, count_above_target)
+
+    def update(self, targets_ms, digests):
+        worst = 0.0
+        for key, target in (targets_ms or {}).items():
+            if not key.endswith("_p99_ms") or not target or target <= 0:
+                continue
+            metric = key[:-len("_p99_ms")]
+            d = digests.get(metric)
+            if d is None:
+                continue
+            count = d.count
+            over = d.count_above(float(target) / 1e3)
+            last_count, last_over = self._last.get(metric, (0, 0))
+            self._last[metric] = (count, over)
+            d_count = count - last_count
+            d_over = over - last_over
+            if d_count > 0 and d_over > 0:
+                worst = max(worst, (d_over / d_count) / 0.01)
+        return worst
+
+
+def _merged_digests(metrics_list):
+    """Exact-merge the latency digests of N ServingMetrics (same bucket
+    arithmetic the fleet rollup uses — merge order cannot matter)."""
+    merged = {}
+    for m in metrics_list:
+        for name, d in m.latency_digests().items():
+            if name not in merged:
+                merged[name] = LatencyDigest()
+            merged[name].merge(d)
+    return merged
+
+
+class Autoscaler:
+    """Drain/rejoin actuation on windowed burn + queue depth.
+
+    The Router constructs one of these when ``serving.autoscaler.enabled``
+    and calls :meth:`maybe_scale` from its loop (step() and serve()),
+    mirroring ``_maybe_rebalance``'s cadence. Replica GROUPS scale
+    independently: the whole fleet when mixed, each prefill/decode pool
+    under ``serving.pools`` (load-responsive pool sizing). Within a group:
+
+    - **scale up** when the windowed burn rate >= ``scale_up_burn`` (or
+      mean queue depth per active replica >= ``scale_up_queue_depth``)
+      for ``sustain_evals`` consecutive evaluations and a standby replica
+      exists: ``rejoin`` the lowest-index standby, then pull the tail of
+      the deepest queue over to it (queued requests were routed before
+      the capacity existed — without the pull, scale-up only helps
+      arrivals that haven't happened yet);
+    - **drain down** when the group is idle — burn <= ``scale_down_burn``
+      AND every queue empty — for ``sustain_evals`` consecutive
+      evaluations, the group sits above ``min_replicas``, and the
+      CAPACITY GUARD holds: the surviving replicas' free slots can absorb
+      every in-flight stream of the drained one. ``drain(migrate=True)``
+      live-migrates any stragglers; the replica parks as a standby.
+
+    No-thrash argument (the rebalance overshoot-guard discipline): the
+    down threshold sits strictly below the up threshold (config-validated
+    dead band), both require sustained evidence, every action starts a
+    cooldown, and a down only fires when the load present at decision
+    time provably fits the survivors — so the action cannot manufacture
+    the opposite signal from existing load; only NEW offered load can
+    re-arm it, which is a scale-up the fleet genuinely needs.
+    """
+
+    def __init__(self, router, cfg):
+        self._router = router
+        self.cfg = cfg
+        self._calls = 0
+        self._next_eval = 0.0          # cooldown gate (frontier clock)
+        self._sensors = {}             # group -> BurnSensor
+        self._hot = {}                 # group -> consecutive armed evals
+        self._idle = {}                # group -> consecutive idle evals
+        self.events = []               # scale-event timeline (snapshot)
+        self._park_to_floor()
+
+    # ------------------------------------------------------------- groups
+    def _groups(self):
+        """[(name, [replica indices])] — one group per pool, else the
+        whole fleet. min_replicas applies per group."""
+        router = self._router
+        if router._pools is not None and router._pools.enabled:
+            n_pre = router._pools.prefill_replicas
+            idxs = list(range(len(router._replicas)))
+            return [("prefill", idxs[:n_pre]), ("decode", idxs[n_pre:])]
+        return [("fleet", list(range(len(router._replicas))))]
+
+    def _active(self, idxs):
+        return [i for i in idxs if not self._router._replicas[i].dead
+                and not self._router._replicas[i].draining]
+
+    def _standby(self, idxs):
+        """Parked replicas a scale-up can rejoin: draining, fully drained,
+        not dead (a dead replica needs a replacement engine — that is the
+        failover path's business, not the autoscaler's)."""
+        return [i for i in idxs
+                if self._router._replicas[i].draining
+                and not self._router._replicas[i].dead
+                and self._router.drained(i)]
+
+    def _park_to_floor(self):
+        """Initial state: each group starts at ``min_replicas`` ACTIVE
+        (lowest indices), the rest parked as standbys — the fleet the
+        Router was built with is capacity, not footprint. Construction-
+        time, so the drains are instant (nothing is in flight)."""
+        for name, idxs in self._groups():
+            for i in idxs[self.cfg.min_replicas:]:
+                self._router.drain(i, migrate=True)
+                self._record("park", i, name, 0.0, 0.0)
+
+    # ------------------------------------------------------------ sensing
+    def _record(self, action, idx, group, burn, queue_depth):
+        self.events.append({
+            "t": round(float(self._router._frontier()), 6),
+            "action": action, "replica": idx, "group": group,
+            "burn": round(float(burn), 4),
+            "queue_depth": round(float(queue_depth), 4),
+            "active": len(self._active(
+                dict(self._groups())[group])),
+        })
+
+    def maybe_scale(self):
+        """One control-loop evaluation (call every router loop iteration;
+        self-gates on ``interval`` and ``cooldown``)."""
+        self._calls += 1
+        if self._calls % self.cfg.interval:
+            return
+        router = self._router
+        now = router._frontier()
+        targets = router._slo.targets_ms() if router._slo is not None else {}
+        for name, idxs in self._groups():
+            active = self._active(idxs)
+            if not active:
+                continue
+            sensor = self._sensors.setdefault(name, BurnSensor())
+            burn = sensor.update(
+                targets,
+                _merged_digests([router._replicas[i].sv.metrics
+                                 for i in active]))
+            depths = [router._replicas[i].sv.queue.depth for i in active]
+            mean_depth = sum(depths) / len(active)
+            hot = burn >= self.cfg.scale_up_burn or (
+                self.cfg.scale_up_queue_depth > 0
+                and mean_depth >= self.cfg.scale_up_queue_depth)
+            idle = (burn <= self.cfg.scale_down_burn
+                    and sum(depths) == 0)
+            # the dead band between the thresholds arms NEITHER counter —
+            # sustained evidence cannot straddle it
+            self._hot[name] = self._hot.get(name, 0) + 1 if hot else 0
+            self._idle[name] = self._idle.get(name, 0) + 1 if idle else 0
+            if now < self._next_eval:
+                continue  # cooling down; counters still tracked above
+            if self._hot[name] >= self.cfg.sustain_evals:
+                if self._scale_up(name, idxs, burn, mean_depth):
+                    self._hot[name] = self._idle[name] = 0
+                    self._next_eval = now + self.cfg.cooldown
+            elif self._idle[name] >= self.cfg.sustain_evals \
+                    and len(active) > self.cfg.min_replicas:
+                if self._scale_down(name, active, burn, mean_depth):
+                    self._hot[name] = self._idle[name] = 0
+                    self._next_eval = now + self.cfg.cooldown
+
+    # ----------------------------------------------------------- actuation
+    def _scale_up(self, name, idxs, burn, mean_depth):
+        standby = self._standby(idxs)
+        if not standby:
+            return False
+        idx = standby[0]
+        self._router.rejoin(idx)
+        self._record("up", idx, name, burn, mean_depth)
+        # pull the deepest backlog's tail over: those requests were routed
+        # before this capacity existed, and new arrivals alone would leave
+        # the standby idle while the hot queue drains token by token
+        active = self._active(idxs)
+        deepest = max((i for i in active if i != idx),
+                      key=lambda i: self._router._replicas[i].sv.queue.depth,
+                      default=None)
+        if deepest is not None:
+            depth = self._router._replicas[deepest].sv.queue.depth
+            self._router.pull_queued(deepest, idx, depth // 2)
+        return True
+
+    def _scale_down(self, name, active, burn, mean_depth):
+        idx = active[-1]   # deterministic: the highest-index active replica
+        survivors = [i for i in active if i != idx]
+        rep = self._router._replicas[idx].sv
+        in_flight = len(rep._slots) + len(rep._prefill_jobs) \
+            + rep.queue.depth
+        free = sum(self._router._replicas[i].sv.n_slots
+                   - len(self._router._replicas[i].sv._slots)
+                   - len(self._router._replicas[i].sv._prefill_jobs)
+                   for i in survivors)
+        if in_flight > free:
+            return False   # capacity guard: survivors must absorb the move
+        self._router.drain(idx, migrate=True)
+        self._record("down", idx, name, burn, mean_depth)
+        return True
+
+    # ------------------------------------------------------------ rollups
+    def active_replicas(self):
+        return sum(len(self._active(idxs)) for _, idxs in self._groups())
+
+    def snapshot(self):
+        return {
+            "enabled": True,
+            "min_replicas": self.cfg.min_replicas,
+            "fleet_size": len(self._router._replicas),
+            "active_replicas": self.active_replicas(),
+            "groups": {name: {
+                "active": self._active(idxs),
+                "standby": self._standby(idxs),
+            } for name, idxs in self._groups()},
+            "scale_ups": sum(1 for e in self.events
+                             if e["action"] == "up"),
+            "scale_downs": sum(1 for e in self.events
+                               if e["action"] == "down"),
+            "events": list(self.events),
+        }
+
+
+class DegradedModeController:
+    """The ordered degradation ladder (``serving.degraded``).
+
+    One per ServingEngine; ``observe(now)`` runs on the scheduler-step
+    cadence (self-gated on ``interval``) and moves at most one rung per
+    evaluation, with the same sustained-evidence + dead-band hysteresis
+    the autoscaler uses. Policy queries (:meth:`sheds_class`,
+    :meth:`token_cap`, :meth:`speculation_off`) are read by the engine's
+    submit/admission paths; level transitions emit a
+    ``serving/degraded_level`` trace instant and the metrics cadence
+    mirrors the level as a ``Serving/degraded_level`` scalar. Residency
+    per rung is tracked for the bench artifact.
+    """
+
+    def __init__(self, cfg, slo, metrics, tracer=None, engine=None):
+        self.cfg = cfg
+        self.slo = slo
+        self.metrics = metrics
+        self.tracer = tracer
+        self._engine = engine
+        self.level = 0
+        self._sensor = BurnSensor()
+        self._steps = 0
+        self._hot = 0
+        self._cool = 0
+        self._last_t = None
+        self.residency = [0.0] * len(DEGRADED_LADDER)
+        self.transitions = []   # (t, level, burn)
+
+    def observe(self, now):
+        """One scheduler step; every ``interval`` steps, one ladder
+        evaluation. Returns the (possibly new) level."""
+        self._steps += 1
+        if self._steps % self.cfg.interval:
+            return self.level
+        if self._last_t is not None:
+            self.residency[self.level] += max(now - self._last_t, 0.0)
+        self._last_t = now
+        burn = self._sensor.update(self.slo.targets_ms(),
+                                   self.metrics.latency_digests())
+        if burn >= self.cfg.enter_burn:
+            self._hot, self._cool = self._hot + 1, 0
+        elif burn <= self.cfg.exit_burn:
+            self._hot, self._cool = 0, self._cool + 1
+        else:
+            self._hot = self._cool = 0    # dead band: no evidence either way
+        new = self.level
+        if self._hot >= self.cfg.enter_evals \
+                and self.level < len(DEGRADED_LADDER) - 1:
+            new = self.level + 1
+        elif self._cool >= self.cfg.exit_evals and self.level > 0:
+            new = self.level - 1
+        if new != self.level:
+            self._transition(new, burn, now)
+        return self.level
+
+    def _transition(self, new, burn, now):
+        self.level = new
+        self._hot = self._cool = 0
+        self.transitions.append((round(now, 6), new, round(burn, 4)))
+        if self._engine is not None and self._engine.spec:
+            # rung 3 drops speculation; descending re-arms it. Safe for
+            # seeded streams either way (the rng advances once per
+            # dispatched step in both programs — the PR 14 pin).
+            self._engine.set_speculation(not self.speculation_off())
+        if self.tracer is not None:
+            self.tracer.instant(
+                "serving/degraded_level", cat="serving", ts=now,
+                level=new, rung=DEGRADED_LADDER[new], burn=burn)
+
+    # ------------------------------------------------------ policy queries
+    def sheds_class(self, tenant_class):
+        """Is this class shed at the current rung? Batch from rung 1;
+        interactive ONLY at the last rung (the ladder's ordering pin)."""
+        if tenant_class == CLASS_BATCH:
+            return self.level >= 1
+        return self.level >= len(DEGRADED_LADDER) - 1
+
+    def token_cap(self):
+        """max_new_tokens cap for new admissions (0 = uncapped)."""
+        return self.cfg.max_new_tokens_cap if self.level >= 2 else 0
+
+    def speculation_off(self):
+        return self.level >= 3
+
+    def snapshot(self):
+        return {
+            "level": self.level,
+            "rung": DEGRADED_LADDER[self.level],
+            "ladder": list(DEGRADED_LADDER),
+            "residency": [round(r, 6) for r in self.residency],
+            "transitions": [list(t) for t in self.transitions],
+        }

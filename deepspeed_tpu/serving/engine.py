@@ -40,8 +40,9 @@ from .kv_pool import GARBAGE_BLOCK, KVPoolManager, prefix_chain_keys
 from .migration import RequestSnapshot, advance_rng
 from .metrics import ServingMetrics
 from .queue import RequestQueue
-from .request import (FINISH_EOS, FINISH_LENGTH, FINISH_STOP,
-                      FINISH_UNHEALTHY, Request, RequestState, TokenEvent,
+from .request import (CLASS_BATCH, CLASS_INTERACTIVE, FINISH_EOS,
+                      FINISH_LENGTH, FINISH_STOP, FINISH_UNHEALTHY,
+                      REJECT_DEGRADED, Request, RequestState, TokenEvent,
                       as_request)
 from .scheduler import ServingScheduler
 
@@ -156,7 +157,8 @@ class ServingEngine:
             self.queue, self.n_slots,
             max_prefills_per_step=self.cfg.max_prefills_per_step,
             policy=self.cfg.policy,
-            hol_bypass_limit=self.cfg.hol_bypass_limit)
+            hol_bypass_limit=self.cfg.hol_bypass_limit,
+            tenants=self.cfg.tenants if self.cfg.tenants.enabled else None)
         if monitor is None:
             mc = engine.config
             if (mc.tensorboard.enabled or mc.wandb.enabled
@@ -193,6 +195,25 @@ class ServingEngine:
         # arms the Serving/spec_* monitor events (coherent with
         # snapshot()["speculative"], the PR 4 trace==metrics discipline)
         self.metrics.speculative_armed = self.spec
+        # per-tenant SLO grading reads the class ttft overrides
+        if self.cfg.tenants.enabled:
+            self.metrics.tenants_cfg = self.cfg.tenants
+        # degraded-mode ladder (serving.degraded): the engine-local control
+        # loop — submit() consults it for class sheds + token caps, step()
+        # drives its evaluation cadence, transitions toggle speculation
+        self.degraded_ctl = None
+        if self.cfg.degraded.enabled:
+            from .control import DegradedModeController
+
+            self.degraded_ctl = DegradedModeController(
+                self.cfg.degraded, self.cfg.slo, self.metrics,
+                tracer=self.tracer, engine=self)
+            self.metrics.degraded = lambda: self.degraded_ctl.level
+            self.metrics.degraded_snapshot = self.degraded_ctl.snapshot
+        # priority preemption: step()s to skip re-attempting after an
+        # eviction freed too few blocks for the interactive candidate
+        # (prevents evict/re-admit ping-pong against a tight pool)
+        self._pp_cooldown = 0
 
         self._slots = {}              # slot index -> running Request
         self._free_slots = list(range(self.n_slots - 1, -1, -1))  # pop() -> 0 first
@@ -775,21 +796,40 @@ class ServingEngine:
             # offset from an absolute clock reading
             req.arrival_time += req.submit_time
             req.arrival_resolved = True
-        reason = self.queue.admit(
-            req, self.max_len,
-            kv_fits=self.pool_mgr.fits_ever if self.paged else None)
+        reason = None
+        if self.degraded_ctl is not None and not req.tokens:
+            # degraded-mode admission policy (fresh submissions only — a
+            # resumed/migrated stream is committed work, never shed here):
+            # rung >= 1 sheds batch, only the LAST rung sheds interactive;
+            # rung >= 2 caps the generation budget of what it still admits
+            if self.degraded_ctl.sheds_class(req.tenant_class):
+                reason = REJECT_DEGRADED
+                req.state = RequestState.REJECTED
+                req.reject_reason = reason
+                self.queue.shed_counts[reason] += 1
+            else:
+                cap = self.degraded_ctl.token_cap()
+                if cap and req.max_new_tokens > cap:
+                    req.max_new_tokens = cap
         if reason is None:
-            self.metrics.record_submit()
+            reason = self.queue.admit(
+                req, self.max_len,
+                kv_fits=self.pool_mgr.fits_ever if self.paged else None)
+        if reason is None:
+            self.metrics.record_submit(req)
             self.tracer.instant(
                 "request/queued", cat="serving", request_id=req.request_id,
                 trace_id=req.trace_id, prompt_len=req.prompt_len,
+                tenant_id=req.tenant_id, tenant_class=req.tenant_class,
                 # TTFT's zero point, exactly as Request.ttft defines it
                 start=req.start_time)
         else:
-            self.metrics.record_shed(reason)
+            self.metrics.record_shed(reason, req)
             self.tracer.instant("request/shed", cat="serving",
                                 request_id=req.request_id,
-                                trace_id=req.trace_id, reason=reason)
+                                trace_id=req.trace_id, reason=reason,
+                                tenant_id=req.tenant_id,
+                                tenant_class=req.tenant_class)
         return req
 
     # ------------------------------------------------------------- the loop
@@ -801,9 +841,11 @@ class ServingEngine:
         the list of TokenEvents produced."""
         events = []
         can_admit = self._make_can_admit() if self.paged else None
-        admitted = self.scheduler.next_admissions(len(self._free_slots),
-                                                  self.clock.now(),
-                                                  can_admit=can_admit)
+        admitted = self._maybe_priority_preempt(can_admit)
+        if admitted is None:
+            admitted = self.scheduler.next_admissions(len(self._free_slots),
+                                                      self.clock.now(),
+                                                      can_admit=can_admit)
         for req in admitted:
             self._start_request(req, events)
         if self._prefill_jobs and self._chunk_due():
@@ -830,8 +872,73 @@ class ServingEngine:
                 gap = head.arrival_time - self.clock.now()
                 if gap > 0:
                     self.clock.sleep(gap)
+        if self.degraded_ctl is not None:
+            self.degraded_ctl.observe(self.clock.now())
         self.metrics.observe_step(self.queue.depth, len(self._slots))
         return events
+
+    def _maybe_priority_preempt(self, can_admit):
+        """Priority preemption (serving.tenants.preempt): when every slot
+        is busy and an arrived INTERACTIVE request waits, evict the
+        newest-admitted BATCH stream through the rollback-safe preempt
+        machinery (rng captured, blocks released — it resumes bitwise-
+        identically later) and admit the interactive request DIRECTLY into
+        the freed capacity, returning the admission list for this step.
+        Direct admission is load-bearing: ``_preempt`` re-queues the
+        victim at the HEAD (it outranks every queued arrival by original
+        admission order), so routing the step through ``next_admissions``
+        would hand the freed slot straight back to the victim — an
+        evict/re-admit livelock instead of a priority grant. Returns None
+        when no preemption applies (the normal admission path runs).
+        Paged pools only: ``_preempt`` is block-machinery-coupled."""
+        tcfg = self.cfg.tenants
+        if not (tcfg.enabled and tcfg.preempt and self.paged) \
+                or self._free_slots or not self.queue.depth:
+            return None
+        if self._pp_cooldown > 0:
+            self._pp_cooldown -= 1
+            return None
+        now = self.clock.now()
+        cand_i = None
+        for i in range(self.queue.depth):
+            r = self.queue.peek_at(i)
+            if r.arrival_time is not None and r.arrival_time > now:
+                break  # arrivals are time-ordered; nothing further is due
+            if r.admit_time is not None:
+                continue  # a preemption returner resumes the normal way
+            if r.tenant_class == CLASS_INTERACTIVE \
+                    and self.scheduler.budget_ok(r, now):
+                cand_i = i
+                break
+        if cand_i is None:
+            return None
+        batch_slots = [s for s, r_ in self._slots.items()
+                       if r_.tenant_class == CLASS_BATCH]
+        if not batch_slots:
+            return None  # nothing evictable: classes never evict their own
+        victim_slot = max(batch_slots,
+                          key=lambda s_: self._slots[s_].admit_seq)
+        victim = self._slots[victim_slot]
+        self._preempt(victim_slot)
+        victim.priority_evictions += 1
+        self.metrics.priority_evictions += 1
+        self.tracer.instant("request/priority_evicted", cat="serving",
+                            ts=self.clock.now(),
+                            request_id=victim.request_id,
+                            trace_id=victim.trace_id,
+                            tenant_id=victim.tenant_id,
+                            n_tokens=len(victim.tokens))
+        # the victim's push_front shifted the candidate one slot back
+        cand = self.queue.peek_at(cand_i + 1)
+        if can_admit is not None and not can_admit(cand):
+            # the eviction freed too few blocks (large prompt vs short
+            # victim): leave the candidate queued and back off — retrying
+            # every step would churn evictions without ever admitting
+            self._pp_cooldown = 8
+            return []
+        cand = self.queue.pop_at(cand_i + 1)
+        self.scheduler.charge(cand, now)  # fair-share + budget accounting
+        return [cand]
 
     def _make_can_admit(self):
         """Block-aware admission predicate for the scheduler. The queue head
@@ -1068,7 +1175,7 @@ class ServingEngine:
         req.state = RequestState.RUNNING
         req.first_token_time = now
         req.tokens.append(t)
-        self.metrics.record_tokens(1)
+        self.metrics.record_tokens(1, req)
         self.metrics.record_first_token(req)
         self.tracer.instant("request/first_token", cat="serving", ts=now,
                             request_id=req.request_id,
@@ -1216,8 +1323,12 @@ class ServingEngine:
                 continue
             preempted_self = False
             while not mgr.can_allocate(1):
-                victim = max(self._slots,
-                             key=lambda s_: self._slots[s_].admit_seq)
+                # victim order: batch class before interactive (QoS), then
+                # newest admission first — a legacy all-interactive pool
+                # reduces to the original newest-admission rule exactly
+                victim = max(self._slots, key=lambda s_: (
+                    self._slots[s_].tenant_class == CLASS_BATCH,
+                    self._slots[s_].admit_seq))
                 self._preempt(victim)
                 if victim == slot:
                     preempted_self = True
@@ -1728,7 +1839,7 @@ class ServingEngine:
             for j in range(n):
                 t = int(toks[slot, j])
                 req.tokens.append(t)
-                self.metrics.record_tokens(1)
+                self.metrics.record_tokens(1, req)
                 self.metrics.record_decode_tokens(1)
                 if j == n - 1 and bool(done_now[slot]):
                     reason = FINISH_EOS if (req.eos_token_id is not None
@@ -1803,7 +1914,7 @@ class ServingEngine:
                 self._shed_unhealthy(req, events, now, int(nonfinite[slot]))
                 continue
             req.tokens.append(t)
-            self.metrics.record_tokens(1)
+            self.metrics.record_tokens(1, req)
             self.metrics.record_decode_tokens(1)
             if bool(done_now[slot]):
                 reason = FINISH_EOS if (req.eos_token_id is not None
@@ -1873,6 +1984,11 @@ class ServingEngine:
                             trace_id=req.trace_id, reason=reason,
                             n_tokens=len(req.tokens),
                             prompt_len=req.prompt_len,
+                            # multi-tenant QoS: the wide event carries the
+                            # tenant so fleet_report can grade per tenant
+                            tenant_id=req.tenant_id,
+                            tenant_class=req.tenant_class,
+                            priority_evictions=req.priority_evictions,
                             queue_wait=req.queue_wait,
                             admit_wait=None
                             if req.admit_time is None or start is None

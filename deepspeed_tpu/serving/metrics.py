@@ -108,6 +108,26 @@ class ServingMetrics:
         self.tracer = tracer
         self.slo_violations = 0   # emit intervals with >=1 violated target
         self.window_resets = 0    # reset_window() calls (warmup exclusion)
+        # multi-tenant QoS: per-tenant counters + latency digests, keyed by
+        # tenant_id (populated lazily — a single-tenant engine pays one
+        # "default" entry). Counters are CUMULATIVE (survive reset_window,
+        # like submitted/finished/shed); the digests reset with the window
+        # under the same epoch guard the global digests use, so a warmup
+        # cannot pollute a tenant's SLO grade. tenants_cfg (set by the
+        # engine when serving.tenants is configured) supplies per-class
+        # ttft_p99_ms overrides for the per-tenant grade.
+        self.tenants = {}
+        self.tenants_cfg = None
+        # degraded-mode hook (set by the engine when serving.degraded is
+        # armed): a callable returning the current ladder level, mirrored
+        # as the Serving/degraded_level scalar on the emit cadence
+        self.degraded = None
+        # full-ladder state for snapshot()["degraded"] (level, rung,
+        # residency, transitions) — set alongside ``degraded``
+        self.degraded_snapshot = None
+        # priority preemptions: evictions of a batch-class stream by an
+        # interactive arrival (a subset of ``preempted``)
+        self.priority_evictions = 0
         # goodput accounting, in DEVICE TOKENS of work (the virtual cost
         # model's currency: one prefill dispatch costs its padded length,
         # one decode step yields one token per active slot). useful = fresh
@@ -175,27 +195,52 @@ class ServingMetrics:
         self.rolled_back_tokens = 0
         self.verify_steps = 0
         self.decode_dispatches = 0
+        # per-tenant digests restart with the window too (same epoch), but
+        # the per-tenant COUNTERS survive — a warmup reset must not erase
+        # who submitted/was shed, only the latency samples it polluted
+        for t in self.tenants.values():
+            t["ttft_digest"] = LatencyDigest()
+            t["tpot_digest"] = LatencyDigest()
         # recorded so trace readers know the live digests no longer cover
         # the whole trace (fleet_report downgrades its digest-coherence
         # gate to informational when a reset happened mid-run)
         self.window_resets += 1
 
-    def record_submit(self):
+    def _tenant(self, request):
+        t = self.tenants.get(request.tenant_id)
+        if t is None:
+            t = self.tenants[request.tenant_id] = {
+                "class": request.tenant_class,
+                "submitted": 0, "finished": 0, "tokens": 0,
+                "shed": collections.Counter(),
+                "ttft_digest": LatencyDigest(),
+                "tpot_digest": LatencyDigest(),
+            }
+        return t
+
+    def record_submit(self, request=None):
         self._mark_started()
         self.submitted += 1
+        if request is not None:
+            self._tenant(request)["submitted"] += 1
 
-    def record_shed(self, reason):
+    def record_shed(self, reason, request=None):
         self._mark_started()
         self.shed[reason] += 1
+        if request is not None:
+            self._tenant(request)["shed"][reason] += 1
 
-    def record_tokens(self, n):
+    def record_tokens(self, n, request=None):
         self.total_tokens += int(n)
         self._window_tokens += int(n)
+        if request is not None:
+            self._tenant(request)["tokens"] += int(n)
 
     def record_first_token(self, request):
         if request.ttft is not None:
             self.ttft_samples.append(request.ttft)
             self.ttft_digest.add(request.ttft)
+            self._tenant(request)["ttft_digest"].add(request.ttft)
             request.ttft_epoch = self.window_resets
 
     def record_finish(self, request):
@@ -217,14 +262,17 @@ class ServingMetrics:
                     pass
                 if request.ttft_epoch == self.window_resets:
                     self.ttft_digest.remove(request.ttft)
+                    self._tenant(request)["ttft_digest"].remove(request.ttft)
             if request.queue_wait is not None \
                     and request.queue_wait_epoch == self.window_resets:
                 self.queue_wait_digest.remove(request.queue_wait)
             return
         self.finished += 1
+        self._tenant(request)["finished"] += 1
         if request.tpot is not None:
             self.tpot_samples.append(request.tpot)
             self.tpot_digest.add(request.tpot)
+            self._tenant(request)["tpot_digest"].add(request.tpot)
 
     def record_queue_wait(self, request):
         """Arrival -> first prefill dispatch (recorded once per request, at
@@ -315,8 +363,10 @@ class ServingMetrics:
     def record_unhealthy(self):
         self.unhealthy_slots += 1
 
-    def record_preempt(self):
+    def record_preempt(self, priority=False):
         self.preempted += 1
+        if priority:
+            self.priority_evictions += 1
 
     def observe_step(self, queue_depth, active_slots):
         """Once per scheduler step; periodically flushes monitor events."""
@@ -368,6 +418,38 @@ class ServingMetrics:
         return {"ttft": self.ttft_digest, "tpot": self.tpot_digest,
                 "queue_wait": self.queue_wait_digest}
 
+    def tenant_slo_targets(self, tenant_class):
+        """SLO targets for a tenant's grade: the serving.slo targets, with
+        the class's ``ttft_p99_ms`` override (serving.tenants.<class>)
+        taking precedence when configured."""
+        targets = dict(self.slo.targets_ms()) if self.slo is not None else {}
+        if self.tenants_cfg is not None:
+            cc = self.tenants_cfg.class_config(tenant_class)
+            if cc is not None and cc.ttft_p99_ms > 0:
+                targets["ttft_p99_ms"] = cc.ttft_p99_ms
+        return targets
+
+    def tenancy_snapshot(self):
+        """Per-tenant rollup: counters, per-tenant P99s off the tenant
+        digests, and an SLO grade against the class's targets — the
+        ``tenancy`` block in snapshot()/fleet.json/bench artifacts."""
+        out = {}
+        for tid in sorted(self.tenants):
+            t = self.tenants[tid]
+            digests = {"ttft": t["ttft_digest"], "tpot": t["tpot_digest"]}
+            out[tid] = {
+                "class": t["class"],
+                "submitted": t["submitted"],
+                "finished": t["finished"],
+                "shed": dict(t["shed"]),
+                "tokens": t["tokens"],
+                "ttft_p99_ms": t["ttft_digest"].quantile_ms(99),
+                "tpot_p99_ms": t["tpot_digest"].quantile_ms(99),
+                "slo": evaluate_slo(
+                    self.tenant_slo_targets(t["class"]), digests),
+            }
+        return out
+
     def slo_eval(self):
         """Grade the digests against serving.slo (configured: False block
         when no slo config / no targets)."""
@@ -408,8 +490,10 @@ class ServingMetrics:
             "speculative": self.speculative_snapshot(),
             "migration": self.migration_snapshot(),
             "slo": self.slo_eval(),
+            "tenancy": self.tenancy_snapshot(),
             "steps": self.steps,
             "queue_depth": self._queue_depth,
+            "priority_evictions": self.priority_evictions,
             "slot_occupancy": self._active_slots / max(self.n_slots, 1),
             "active_slots_peak": self.active_slots_peak,
             "preempted": self.preempted,
@@ -417,6 +501,8 @@ class ServingMetrics:
                 "nonfinite_logit_steps": self.nonfinite_logit_steps,
                 "unhealthy_slots": self.unhealthy_slots,
             },
+            **({"degraded": self.degraded_snapshot()}
+               if self.degraded_snapshot is not None else {}),
             **({"kv_pool": self.kv_pool()} if self.kv_pool is not None
                else {}),
             **({"router": self.router()} if self.router is not None
@@ -463,6 +549,9 @@ class ServingMetrics:
                            float(self.accept_rate), self.steps))
             events.append(("Serving/spec_accepted_tokens_per_step",
                            float(self.accepted_tokens_per_step), self.steps))
+        if self.degraded is not None:
+            events.append(("Serving/degraded_level",
+                           float(self.degraded()), self.steps))
         p50 = percentile(self.ttft_samples, 50)
         if p50 is not None:
             events.append(("Serving/ttft_ms", p50 * 1e3, self.steps))

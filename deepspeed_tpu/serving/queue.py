@@ -8,8 +8,9 @@ rejecting work, never by growing host/device memory until it falls over.
 
 import collections
 
-from .request import (REJECT_BAD_REQUEST, REJECT_NO_FREE_BLOCKS,
-                      REJECT_PROMPT_TOO_LONG, REJECT_QUEUE_FULL, RequestState)
+from .request import (CLASS_BATCH, CLASS_INTERACTIVE, REJECT_BAD_REQUEST,
+                      REJECT_NO_FREE_BLOCKS, REJECT_PROMPT_TOO_LONG,
+                      REJECT_QUEUE_FULL, RequestState)
 
 
 class RequestQueue:
@@ -35,7 +36,9 @@ class RequestQueue:
         request's block footprint exceeds what the pool could EVER free, so
         queueing it would wait forever: shed ``no_free_blocks`` now."""
         reason = None
-        if request.prompt_len < 1 or request.max_new_tokens < 1:
+        if request.prompt_len < 1 or request.max_new_tokens < 1 \
+                or request.tenant_class not in (CLASS_INTERACTIVE,
+                                                CLASS_BATCH):
             reason = REJECT_BAD_REQUEST
         elif request.prompt_len + request.max_new_tokens > max_total_len:
             reason = REJECT_PROMPT_TOO_LONG

@@ -33,6 +33,14 @@ REJECT_ALL_REPLICAS_SATURATED = "all_replicas_saturated"
 # kept failing) and the bounded retry budget (serving.retry_limit) is spent
 # — or no surviving replica could take it
 REJECT_REPLICA_FAILED = "replica_failed"
+# degraded-mode ladder (serving.degraded): the engine is shedding this
+# request's CLASS under SLO burn — batch from rung 1, interactive only at
+# the last rung (per-tenant shed counters pin the ordering)
+REJECT_DEGRADED = "degraded"
+
+# tenant/priority classes (serving.tenants)
+CLASS_INTERACTIVE = "interactive"
+CLASS_BATCH = "batch"
 
 FINISH_EOS = "eos"
 FINISH_LENGTH = "length"
@@ -74,6 +82,13 @@ class Request:
     # any replica carries it, so the fleet merger can stitch one lifecycle
     # from N per-replica streams (assigned at router/engine submit if None)
     trace_id: typing.Optional[str] = None
+    # multi-tenant QoS (serving.tenants): the paying tenant and its
+    # priority class. "interactive" rides the latency SLO (and may evict a
+    # batch stream under priority preemption); "batch" is throughput
+    # traffic — first shed under the degraded ladder, first evicted under
+    # slot pressure. Per-tenant digests/budgets/sheds key on tenant_id.
+    tenant_id: str = "default"
+    tenant_class: str = CLASS_INTERACTIVE
 
     # -- scheduler-owned runtime fields -------------------------------------
     state: RequestState = RequestState.QUEUED
@@ -88,6 +103,9 @@ class Request:
     # the queue, and the per-slot rng key captured at preemption so the
     # resumed stream continues bitwise-identically (greedy AND sampled)
     preemptions: int = 0
+    # of those, evictions by a higher-priority (interactive) arrival under
+    # serving.tenants.preempt — a subset of ``preemptions``
+    priority_evictions: int = 0
     resume_rng: typing.Optional[np.ndarray] = None
     # admission-time KV block reservation held in KVPoolManager._pending
     # until the slot insert consumes it (or an early finish cancels it)

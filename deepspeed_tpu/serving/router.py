@@ -35,6 +35,7 @@ import os
 
 from ..telemetry.digest import LatencyDigest, evaluate_slo
 from .clock import VirtualClock
+from .control import Autoscaler, BurnSensor
 from .kv_pool import prefix_chain_keys
 from .metrics import percentile, slo_digest_events
 from .migration import advance_rng
@@ -158,6 +159,10 @@ class RouterMetrics:
         # above, which counts affinity overrides at ROUTING time)
         self.handoffs = 0
         self.pool_rebalances = 0
+        # cumulative replica scheduler steps — the autoscaler acceptance
+        # currency: a parked replica steps zero times, so a right-sized
+        # fleet's total is strictly below an always-max static fleet's
+        self.replica_steps = 0
         self.per_replica_routed = collections.Counter()
         self._events_emitted = 0
         # fleet-level SLO bookkeeping (emit intervals with >=1 violated
@@ -214,6 +219,51 @@ class RouterMetrics:
         return evaluate_slo(
             self._router._slo.targets_ms() if self._router._slo is not None
             else {}, digests if digests is not None else self.fleet_digests())
+
+    def fleet_tenancy(self):
+        """Fleet per-tenant rollup: every replica's tenant counters summed
+        and tenant digests exact-merged (same associative bucket addition
+        as ``fleet_digests``), then graded against the tenant class's SLO
+        targets — the ``tenancy`` block of fleet.json / bench artifacts."""
+        reps = self._router._replicas
+        merged = {}
+        grader = None
+        for r in reps:
+            m = r.sv.metrics
+            if m.tenants_cfg is not None:
+                grader = m
+            for tid, t in m.tenants.items():
+                g = merged.get(tid)
+                if g is None:
+                    g = merged[tid] = {
+                        "class": t["class"], "submitted": 0, "finished": 0,
+                        "tokens": 0, "shed": collections.Counter(),
+                        "ttft": LatencyDigest(), "tpot": LatencyDigest(),
+                    }
+                g["submitted"] += t["submitted"]
+                g["finished"] += t["finished"]
+                g["tokens"] += t["tokens"]
+                g["shed"].update(t["shed"])
+                g["ttft"].merge(t["ttft_digest"])
+                g["tpot"].merge(t["tpot_digest"])
+        if grader is None and reps:
+            grader = reps[0].sv.metrics
+        out = {}
+        for tid in sorted(merged):
+            g = merged[tid]
+            digests = {"ttft": g["ttft"], "tpot": g["tpot"]}
+            out[tid] = {
+                "class": g["class"],
+                "submitted": g["submitted"],
+                "finished": g["finished"],
+                "shed": dict(g["shed"]),
+                "tokens": g["tokens"],
+                "ttft_p99_ms": g["ttft"].quantile_ms(99),
+                "tpot_p99_ms": g["tpot"].quantile_ms(99),
+                "slo": evaluate_slo(
+                    grader.tenant_slo_targets(g["class"]), digests),
+            }
+        return out
 
     def pool_rollup(self):
         """Per-pool topology rollup: routed counts, mean occupancy and the
@@ -272,6 +322,7 @@ class RouterMetrics:
             "handoffs": self.handoffs,
             "pool_rebalances": self.pool_rebalances,
             "pools": self.pool_rollup(),
+            "replica_steps": self.replica_steps,
         }
 
     def maybe_emit(self):
@@ -393,6 +444,9 @@ class Router:
         self._rebalance_cfg = replicas[0].cfg.rebalance
         self._rebalance_calls = 0
         self._rebalance_next = 0.0   # cooldown gate (fleet-frontier time)
+        # SLO-armed rebalance retarget: per-replica windowed burn sensors
+        # (idx -> BurnSensor), consulted only when serving.slo is armed
+        self._rebalance_sensors = {}
         self.metrics = RouterMetrics(self, monitor=monitor)
         self.tracer, self._fleet_dir = self._setup_tracing(tracer)
         self._rehome_replica_monitors()
@@ -400,6 +454,13 @@ class Router:
             # per-replica snapshots gain the cross-replica view (coherent
             # with the Serving/router_* events, asserted tier-1)
             rep.sv.metrics.router = self.metrics.snapshot
+        # SLO-driven autoscaling (serving.autoscaler): parks the fleet to
+        # its floor NOW (drains are instant pre-traffic), then scales the
+        # active set from the router loop — constructed last so the park
+        # events land on live metrics/tracer state
+        auto_cfg = replicas[0].cfg.autoscaler
+        self._autoscaler = Autoscaler(self, auto_cfg) \
+            if auto_cfg is not None and auto_cfg.enabled else None
 
     def _setup_tracing(self, tracer):
         """Arm fleet tracing when the replicas trace. Replicas built from
@@ -992,10 +1053,38 @@ class Router:
         if len(cands) < 2:
             return
         score = lambda r: r.decode_score(self.cfg)
-        hot = max(cands, key=lambda r: (score(r), r.idx))
-        cold = min(cands, key=lambda r: (score(r), r.idx))
-        if hot is cold or score(hot) - score(cold) <= cfg.min_gain:
-            return
+        if self._slo is not None and self._slo.armed:
+            # SLO-armed retarget: hot/cold selection scores each replica
+            # by its WINDOWED burn contribution (the latency damage it is
+            # doing to the fleet SLO right now), decode occupancy only
+            # breaking ties — a replica can sit at modest occupancy yet
+            # burn the budget (long-tail streams), and it is the one worth
+            # unloading. A move still requires a strictly positive
+            # occupancy gap toward the cold replica and passes the same
+            # per-stream overshoot guard below, so the no-thrash argument
+            # carries over: the guard bounds every move's reverse gap
+            # inside the hysteresis band regardless of how hot/cold were
+            # chosen, and burn windows re-baseline per evaluation.
+            targets = self._slo.targets_ms()
+            burns = {}
+            for r in cands:
+                sensor = self._rebalance_sensors.setdefault(
+                    r.idx, BurnSensor())
+                burns[r.idx] = sensor.update(
+                    targets, r.sv.metrics.latency_digests())
+            hot = max(cands, key=lambda r: (burns[r.idx], score(r), r.idx))
+            cold = min(cands, key=lambda r: (burns[r.idx], score(r), r.idx))
+            if hot is cold or burns[hot.idx] <= burns[cold.idx]:
+                return  # no burn differential: nothing to unload
+            gap_floor = 0.0   # burn triggered the move; any headroom helps
+            if score(hot) - score(cold) <= gap_floor:
+                return  # the cold replica has no spare capacity to absorb
+        else:
+            gap_floor = cfg.min_gain
+            hot = max(cands, key=lambda r: (score(r), r.idx))
+            cold = min(cands, key=lambda r: (score(r), r.idx))
+            if hot is cold or score(hot) - score(cold) <= cfg.min_gain:
+                return
         # longest-tail first: the streams with the most decode left
         # amortize the splice cost best (and vacate the most future work)
         streams = sorted(
@@ -1004,7 +1093,7 @@ class Router:
         moved = 0
         for req in streams:
             gap = score(hot) - score(cold)
-            if moved >= cfg.max_concurrent or gap <= cfg.min_gain:
+            if moved >= cfg.max_concurrent or gap <= gap_floor:
                 break
             if gap <= self._move_delta(hot, cold, req) - cfg.min_gain:
                 # overshoot guard: this stream is heavy enough that moving
@@ -1089,6 +1178,40 @@ class Router:
                 self.stall_replica(idx, duration)
         return out
 
+    def pull_queued(self, from_idx, to_idx, n):
+        """Move up to ``n`` not-yet-started requests from the TAIL of
+        replica ``from_idx``'s queue onto replica ``to_idx`` (relative
+        order preserved). The autoscaler's scale-up companion: queued
+        requests were routed before the new capacity existed — without the
+        pull a rejoined standby idles while the hot queue drains one
+        prefill per step. Tail-side so preemption returners and senior
+        arrivals keep their position; admission control is bypassed like
+        ``push_front`` (the requests already passed it at submit). Returns
+        the number of requests moved."""
+        src = self._replicas[from_idx].sv
+        dst_rep = self._replicas[to_idx]
+        moved = []
+        for _ in range(max(int(n), 0)):
+            if not len(src.queue) or src.queue.peek_at(
+                    len(src.queue) - 1).admit_time is not None:
+                break  # never pull a preemption returner off its replica
+            moved.append(src.queue.pop_at(len(src.queue) - 1))
+        if not moved:
+            return 0
+        now = self._frontier()
+        # an idle target's clock may lag the move (cf. _push_started)
+        if not dst_rep.busy:
+            gap = now - dst_rep.sv.clock.now()
+            if gap > 0:
+                dst_rep.sv.clock.sleep(gap)
+        for req in reversed(moved):   # popped back-to-front: re-append in order
+            dst_rep.sv.queue._q.append(req)
+            self._requests[req.request_id] = (req, to_idx)
+        self.tracer.instant("route/pull_queued", cat="router", ts=now,
+                            replica=from_idx, target=to_idx,
+                            moved=len(moved))
+        return len(moved)
+
     def drained(self, idx):
         """True once the draining replica has no in-flight work left."""
         return not self._replicas[idx].busy
@@ -1122,8 +1245,11 @@ class Router:
         events = list(self._fire_chaos())
         self._update_health()
         self._maybe_rebalance()
+        if self._autoscaler is not None:
+            self._autoscaler.maybe_scale()
         for rep in self._replicas:
             if rep.busy and not rep.dead:
+                self.metrics.replica_steps += 1
                 events.extend(self._filter_events(rep.idx, rep.sv.step()))
         self.metrics.maybe_emit()
         return events
@@ -1161,6 +1287,8 @@ class Router:
                     yield ev
                 self._update_health()
                 self._maybe_rebalance()
+                if self._autoscaler is not None:
+                    self._autoscaler.maybe_scale()
                 busy = [r for r in self._replicas if r.busy and not r.dead]
                 if busy:
                     horizon = min(r.sv.clock.now() for r in busy)
@@ -1183,10 +1311,12 @@ class Router:
                     # advance the laggard one step: no replica's clock ever
                     # runs ahead of another's un-simulated past
                     rep = min(busy, key=lambda r: r.sv.clock.now())
+                    self.metrics.replica_steps += 1
                     for ev in self._filter_events(rep.idx, rep.sv.step()):
                         yield ev
                 else:
                     for rep in busy:
+                        self.metrics.replica_steps += 1
                         for ev in self._filter_events(rep.idx,
                                                       rep.sv.step()):
                             yield ev
@@ -1269,6 +1399,12 @@ class Router:
             "digests": {name: d.snapshot() for name, d in digests.items()},
             "slo": self.metrics.fleet_slo(digests),
             "goodput": self.metrics.fleet_goodput(),
+            # multi-tenant QoS: fleet-merged per-tenant counters/digests/
+            # grades, plus the autoscaler's scale-event timeline (both
+            # blocks always present so artifact readers need no probing)
+            "tenancy": self.metrics.fleet_tenancy(),
+            "autoscaler": self._autoscaler.snapshot()
+            if self._autoscaler is not None else {"enabled": False},
             # >0 means the live digests were restarted mid-run (warmup
             # exclusion) and no longer cover the whole trace
             "window_resets": sum(r.sv.metrics.window_resets

@@ -37,6 +37,7 @@ _LIFECYCLE = ("route/decision", "route/shed", "route/failover",
               "route/retry", "route/handoff", "route/rebalance",
               "request/queued", "request/shed",
               "request/first_token", "request/preempted",
+              "request/priority_evicted",
               "request/resumed", "request/migrated_out", "request/migrated",
               "request/handoff_out", "request/handoff_in",
               "request/unhealthy", "request/finish")
@@ -86,6 +87,8 @@ def build_wide_events(merged_events):
         return reqs.setdefault(rid, {
             "request_id": rid, "trace_id": None, "state": None,
             "replica": None, "routing": None, "shed_reason": None,
+            "tenant_id": None, "tenant_class": None,
+            "priority_evictions": 0,
             "finish_reason": None, "prompt_len": None, "n_tokens": None,
             "chunks": 0, "preemptions": 0, "replay_tokens": 0,
             "padding_tokens": 0, "prefix_saved_tokens": 0,
@@ -137,10 +140,17 @@ def build_wide_events(merged_events):
             r["_start"] = args.get("start", e["ts"])
             r["prompt_len"] = args.get("prompt_len")
             r["replica"] = e.get("replica")
+            if args.get("tenant_id") is not None:
+                r["tenant_id"] = args["tenant_id"]
+                r["tenant_class"] = args.get("tenant_class")
         elif name == "request/first_token":
             r["_first"] = e["ts"]
         elif name == "request/preempted":
             r["_preempt_ts"].append(e["ts"])
+        elif name == "request/priority_evicted":
+            # annotation only: the eviction's stall window is tracked by
+            # its paired request/preempted instant
+            r["priority_evictions"] += 1
         elif name == "request/resumed":
             r["_resume_ts"].append(e["ts"])
         elif name == "request/migrated_out":
@@ -173,7 +183,8 @@ def build_wide_events(merged_events):
                       "prefix_saved_tokens", "kv_blocks_peak",
                       "drafted_tokens", "accepted_tokens",
                       "rolled_back_tokens", "migrations", "failovers",
-                      "retries", "handoffs", "rebalances"):
+                      "retries", "handoffs", "rebalances",
+                      "tenant_id", "tenant_class", "priority_evictions"):
                 src = "reason" if k == "finish_reason" else k
                 if args.get(src) is not None:
                     r[k] = args[src]

@@ -150,6 +150,32 @@ def project_bloom_7b1(measured_hbm_util, peak_bw_gbs, prompt=512,
     }), flush=True)
 
 
+def parse_tenant_mix(spec):
+    """Parse ``--tenants`` mix specs like ``interactive:0.3:slo=300,batch:0.7``
+    into ``[(class, fraction, ttft_slo_ms_or_None), ...]``. Fractions are
+    normalised; ``slo=`` overrides that class's per-tenant TTFT P99 target."""
+    mix = []
+    for part in spec.split(","):
+        fields = part.split(":")
+        if len(fields) < 2:
+            raise ValueError(f"--tenants entry {part!r}: want class:frac"
+                             f"[:slo=ms]")
+        cls, frac = fields[0].strip(), float(fields[1])
+        if cls not in ("interactive", "batch"):
+            raise ValueError(f"--tenants class {cls!r}: want interactive|batch")
+        if frac <= 0:
+            raise ValueError(f"--tenants fraction for {cls} must be > 0")
+        slo_ms = None
+        for extra in fields[2:]:
+            k, _, v = extra.partition("=")
+            if k.strip() != "slo":
+                raise ValueError(f"--tenants option {extra!r}: want slo=ms")
+            slo_ms = float(v)
+        mix.append((cls, frac, slo_ms))
+    total = sum(f for _, f, _ in mix)
+    return [(c, f / total, s) for c, f, s in mix]
+
+
 def run_open_loop(args):
     """Open-loop offered-load bench: seeded Poisson arrivals at ``--qps``
     through the continuous-batching serving engine; writes a throughput–
@@ -188,6 +214,22 @@ def run_open_loop(args):
     if args.slo_ttft_p99_ms or args.slo_tpot_p99_ms:
         serving_kw["slo"] = {"ttft_p99_ms": args.slo_ttft_p99_ms,
                              "tpot_p99_ms": args.slo_tpot_p99_ms}
+    tenant_mix = parse_tenant_mix(args.tenants) if args.tenants else None
+    if tenant_mix:
+        # multi-tenant QoS: weighted-fair admission over the class mix;
+        # slo= entries become per-class TTFT targets in the tenancy grades
+        serving_kw["policy"] = "weighted_fair"
+        tenants_cfg = {"enabled": True}
+        for cls, _, slo_ms in tenant_mix:
+            if slo_ms:
+                tenants_cfg[cls] = {"ttft_p99_ms": slo_ms}
+        serving_kw["tenants"] = tenants_cfg
+    if args.autoscale:
+        # queue-depth trigger keeps the autoscaler armed even without
+        # --slo-* targets (config validation requires SOME sensor input)
+        serving_kw["autoscaler"] = {
+            "enabled": True,
+            "scale_up_queue_depth": max(2.0, args.queue_depth / 2.0)}
     pools_on = bool(args.prefill_replicas or args.decode_replicas)
     if pools_on:
         if not args.paged:
@@ -238,12 +280,25 @@ def run_open_loop(args):
                               args.new_tokens + 1))
         tail = rng.randint(0, vocab,
                            (max(plen - len(shared), 1),)).astype(np.int32)
+        tenant_kw = {}
+        if tenant_mix:
+            # seeded class draw against the normalised mix fractions; one
+            # tenant per class so the tenancy block reads as the mix spec
+            u, acc = rng.rand(), 0.0
+            cls = tenant_mix[-1][0]
+            for c, frac, _ in tenant_mix:
+                acc += frac
+                if u < acc:
+                    cls = c
+                    break
+            tenant_kw = {"tenant_id": f"t-{cls}", "tenant_class": cls}
         requests.append(Request(
             prompt=np.concatenate([shared, tail])[:max(plen, 1)],
             max_new_tokens=new, arrival_time=float(arrivals[i]),
             # --session-affinity: a small pool of sticky sessions, so the
             # router's session map actually gets exercised under load
-            session_id=f"sess{i % 4}" if args.session_affinity else None))
+            session_id=f"sess{i % 4}" if args.session_affinity else None,
+            **tenant_kw))
 
     # the router path is the production topology: N ServingEngine replicas
     # over ONE weight set behind the load-aware dispatcher (N=1 still goes
@@ -257,7 +312,7 @@ def run_open_loop(args):
     for rep in replicas:
         rep.run([Request(
             prompt=rng.randint(0, vocab, (p,)).astype(np.int32),
-            max_new_tokens=2) for p in prompts])
+            max_new_tokens=2, tenant_id="warmup") for p in prompts])
         rep.metrics.reset_window()  # warmup out of the tokens/s window
 
     chaos_events = []
@@ -377,6 +432,13 @@ def run_open_loop(args):
         "percentiles": router_snap["percentiles"],
         "slo": router_snap["slo"],
         "goodput": router_snap["goodput"],
+        # multi-tenant QoS rollup (always present): fleet-merged per-tenant
+        # submitted/finished/shed/tokens + TTFT/TPOT digests and the
+        # per-tenant SLO grade (class ttft targets from --tenants slo=),
+        # plus the autoscaler's scale-event timeline and replica-step
+        # economics ({"enabled": false} when --autoscale is off)
+        "tenancy": router_snap["tenancy"],
+        "autoscaler": router_snap["autoscaler"],
         # the resilience block: live-migration / failover economics next to
         # the throughput they protected — snapshots taken, streams migrated,
         # cross-replica failovers and retries, terminal replica_failed
@@ -430,6 +492,7 @@ def run_open_loop(args):
         "rebalance": bool(args.rebalance),
         "slo_ttft_p99_ms": args.slo_ttft_p99_ms,
         "slo_tpot_p99_ms": args.slo_tpot_p99_ms,
+        "tenants": args.tenants, "autoscale": bool(args.autoscale),
         "chaos_kills": args.chaos_kills, "chaos_stalls": args.chaos_stalls,
         "chaos_seed": args.chaos_seed,
         "chaos_snapshot_interval": args.chaos_snapshot_interval})
@@ -524,6 +587,20 @@ def main():
                          "grades the fleet digests against it")
     ap.add_argument("--slo-tpot-p99-ms", type=float, default=0.0,
                     help="open-loop mode: serving.slo TPOT P99 target (ms)")
+    ap.add_argument("--tenants", default="",
+                    help="open-loop mode: multi-tenant class mix, e.g. "
+                         "'interactive:0.3:slo=300,batch:0.7' — requests "
+                         "draw a class by the (normalised) fractions, "
+                         "admission switches to weighted-fair (serving."
+                         "tenants), and slo= sets that class's per-tenant "
+                         "TTFT P99 target; the artifact's tenancy block "
+                         "carries per-tenant counters, digests and grades")
+    ap.add_argument("--autoscale", action="store_true",
+                    help="open-loop mode: arm serving.autoscaler — parks "
+                         "the fleet to the min-replica floor, scales up on "
+                         "sustained SLO burn / queue depth, drains back on "
+                         "idle; the artifact's autoscaler block records the "
+                         "scale-event timeline and replica-step economics")
     ap.add_argument("--chaos-kills", type=int, default=0,
                     help="open-loop mode (requires --paged): kill this many "
                          "replicas at seeded instants during the offered-"

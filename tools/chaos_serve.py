@@ -37,10 +37,20 @@ mid-handoff; recovery must re-dispatch through the surviving topology) and
 stalls land on the DECODE pool (degraded health while rebalancing is live).
 Same exit gates, plus the handoff machinery must actually have engaged.
 
+Burst mode (``--burst-requests N``): on top of the staggered baseline the
+schedule injects a DENSE arrival burst at ``--burst-at`` (gap
+``--burst-gap``) followed by a sparse recovery tail (``--burst-tail``
+requests, ``--burst-tail-gap`` apart) — and gains a RECOVERY exit gate:
+every tail request's TTFT must come back under ``--recovery-ttft-ms``
+(the uncontended bound), proving the fleet actually drained the burst
+backlog instead of wedging. The artifact gains a ``burst`` block
+(pre/burst/tail TTFT split, recovered flag).
+
 Exit codes: 0 ok; 2 survival gate (fault did not fire / request neither
 finished nor shed / disaggregated run with zero handoffs); 3 continuity
 gate (bitwise mismatch vs reference or chaos-vs-chaos nondeterminism);
-4 shed gate (shed rate above ``--max-shed``).
+4 shed gate (shed rate above ``--max-shed``); 5 recovery gate (post-burst
+tail TTFT never recovered to the uncontended bound).
 """
 
 import argparse
@@ -105,6 +115,26 @@ def make_requests(args):
         reqs.append(Request(prompt=prompt, max_new_tokens=args.new_tokens,
                             arrival_time=i * args.arrival_gap,
                             sampling=sampling))
+    if args.burst_requests:
+        # dense burst at --burst-at, then a sparse recovery tail whose
+        # arrivals are far enough apart that a healthy fleet serves each
+        # one uncontended — the recovery gate measures THEIR TTFT
+        for j in range(args.burst_requests):
+            plen = int(rng.randint(9, 30))
+            prompt = rng.randint(0, args.vocab, (plen,)).astype(np.int32)
+            sampling = SamplingParams(temperature=0.8, top_k=8,
+                                      seed=5000 + j) if j % 2 else None
+            reqs.append(Request(
+                prompt=prompt, max_new_tokens=args.new_tokens,
+                arrival_time=args.burst_at + j * args.burst_gap,
+                sampling=sampling))
+        burst_end = args.burst_at + args.burst_requests * args.burst_gap
+        for k in range(args.burst_tail):
+            plen = int(rng.randint(9, 30))
+            prompt = rng.randint(0, args.vocab, (plen,)).astype(np.int32)
+            reqs.append(Request(
+                prompt=prompt, max_new_tokens=args.new_tokens,
+                arrival_time=burst_end + (k + 1) * args.burst_tail_gap))
     return reqs
 
 
@@ -150,6 +180,7 @@ def run_chaos(engine, args):
                      for t, kind, idx, dur in events],
         "states": [r.state.value for r in requests],
         "streams": [list(r.tokens) for r in requests],
+        "ttfts": [r.ttft for r in requests],
         "finish_reasons": [r.finish_reason or r.reject_reason
                            for r in requests],
         "failovers": [r.failovers for r in requests],
@@ -190,6 +221,23 @@ def main(argv=None):
                     help="chaos schedule horizon in fleet virtual seconds")
     ap.add_argument("--stall-duration", type=float, default=0.25)
     ap.add_argument("--arrival-gap", type=float, default=0.05)
+    ap.add_argument("--burst-requests", type=int, default=0,
+                    help="burst mode: inject this many DENSE arrivals at "
+                         "--burst-at on top of the baseline, plus a sparse "
+                         "recovery tail — arms the recovery exit gate")
+    ap.add_argument("--burst-at", type=float, default=0.5,
+                    help="burst start (fleet virtual seconds)")
+    ap.add_argument("--burst-gap", type=float, default=0.01,
+                    help="intra-burst arrival gap (virtual s)")
+    ap.add_argument("--burst-tail", type=int, default=3,
+                    help="sparse post-burst requests the recovery gate "
+                         "measures")
+    ap.add_argument("--burst-tail-gap", type=float, default=60.0,
+                    help="tail arrival spacing (virtual s) — wide enough "
+                         "that a DRAINED fleet serves each uncontended")
+    ap.add_argument("--recovery-ttft-ms", type=float, default=5000.0,
+                    help="recovery gate: every tail request's TTFT must be "
+                         "under this bound (virtual ms) or exit 5")
     ap.add_argument("--max-shed", type=float, default=0.5,
                     help="max tolerated shed rate before exit 4 (kills with "
                          "retry_limit 0 legitimately shed their victims)")
@@ -226,13 +274,39 @@ def main(argv=None):
     deterministic = all(
         chaos[k] == rerun[k]
         for k in ("states", "streams", "finish_reasons", "failovers",
-                  "migrations", "schedule")) \
+                  "migrations", "schedule", "ttfts")) \
         and chaos["snapshot"]["router"]["migration"] == \
         rerun["snapshot"]["router"]["migration"] \
         and all(chaos["snapshot"]["router"][k] ==
                 rerun["snapshot"]["router"][k]
                 for k in ("handoffs", "pool_rebalances"))
-    shed_rate = chaos["n_rejected"] / max(args.requests, 1)
+    n_total = len(chaos["states"])
+    shed_rate = chaos["n_rejected"] / max(n_total, 1)
+
+    # ---- burst recovery split -------------------------------------------
+    burst = None
+    if args.burst_requests:
+        pre = slice(0, args.requests)
+        mid = slice(args.requests, args.requests + args.burst_requests)
+        tail = slice(args.requests + args.burst_requests, n_total)
+        p99 = lambda xs: None if not [x for x in xs if x is not None] \
+            else round(max(x for x in xs if x is not None) * 1e3, 2)
+        tail_ttfts = [t for t in chaos["ttfts"][tail] if t is not None]
+        burst = {
+            "burst_requests": args.burst_requests,
+            "burst_at": args.burst_at,
+            "pre_ttft_p99_ms": p99(chaos["ttfts"][pre]),
+            "burst_ttft_p99_ms": p99(chaos["ttfts"][mid]),
+            "tail_ttft_p99_ms": p99(chaos["ttfts"][tail]),
+            "recovery_ttft_ms": args.recovery_ttft_ms,
+            # every tail request finished AND came back under the
+            # uncontended bound — the fleet drained the backlog
+            "recovered": bool(
+                tail_ttfts
+                and len(tail_ttfts) == tail.stop - tail.start
+                and all(t * 1e3 <= args.recovery_ttft_ms
+                        for t in tail_ttfts)),
+        }
 
     record = {
         "tool": "chaos_serve",
@@ -241,7 +315,9 @@ def main(argv=None):
                     "rebalance", "requests", "kills", "stalls", "seed",
                     "slots", "new_tokens", "vocab", "seq", "retry_limit",
                     "snapshot_interval", "horizon", "stall_duration",
-                    "arrival_gap", "max_shed")},
+                    "arrival_gap", "max_shed", "burst_requests", "burst_at",
+                    "burst_gap", "burst_tail", "burst_tail_gap",
+                    "recovery_ttft_ms")},
         "schedule": chaos["schedule"],
         "kills_fired": kills_fired,
         "stalls_fired": stalls_fired,
@@ -254,6 +330,7 @@ def main(argv=None):
         "nonterminal_requests": nonterminal,
         "bitwise_mismatches": mismatches,
         "deterministic_rerun": deterministic,
+        "burst": burst,
         # the recovery economics: the resilience block bench artifacts carry
         "resilience": dict(mig, replay_tokens=goodput["replay_tokens"],
                            migrated_saved_tokens=mig["migrated_saved_tokens"]),
@@ -307,6 +384,12 @@ def main(argv=None):
         print(f"FAIL: shed rate {shed_rate} > {args.max_shed}",
               file=sys.stderr)
         return 4
+    if burst is not None and not burst["recovered"]:
+        print(f"FAIL: post-burst tail TTFT p99 {burst['tail_ttft_p99_ms']} "
+              f"ms never recovered under {args.recovery_ttft_ms} ms "
+              f"(burst p99 {burst['burst_ttft_p99_ms']} ms)",
+              file=sys.stderr)
+        return 5
     return 0
 
 

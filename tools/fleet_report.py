@@ -146,6 +146,49 @@ def summarize(wide, fleet=None, targets_ms=None, top_k=5):
                       for k, v in (targets_ms or {}).items()}
     slo = evaluate_slo(targets_ms, digests)
 
+    # per-tenant SLO grade table: group wide rows by tenant_id and grade
+    # each tenant's trace-derived digests; when the live fleet.json carries
+    # the tenancy rollup (per-class targets included), its grade wins —
+    # the live grade saw per-class ttft overrides the bare targets don't
+    tenancy = None
+    by_tenant = {}
+    for r in rows:
+        tid = r.get("tenant_id")
+        if tid:
+            by_tenant.setdefault(tid, []).append(r)
+    fleet_ten = (fleet or {}).get("tenancy") or {}
+    if by_tenant or fleet_ten:
+        tenancy = []
+        for tid in sorted(set(by_tenant) | set(fleet_ten)):
+            rs = by_tenant.get(tid, [])
+            d = _digests_for({r["request_id"]: r for r in rs})
+            blk = fleet_ten.get(tid) or {}
+            grade = blk.get("slo") or evaluate_slo(targets_ms, d)
+            tenancy.append({
+                "tenant": tid,
+                "class": blk.get("class") or next(
+                    (r.get("tenant_class") for r in rs
+                     if r.get("tenant_class")), "?"),
+                "requests": len(rs) or blk.get("submitted") or 0,
+                "finished": sum(1 for r in rs
+                                if r.get("state") == "finished")
+                if rs else blk.get("finished") or 0,
+                "shed": sum(1 for r in rs if r.get("state") == "shed")
+                if rs else sum((blk.get("shed") or {}).values()),
+                "preemptions": sum(r.get("preemptions") or 0 for r in rs),
+                "ttft_p99_ms": d["ttft"].quantile_ms(99)
+                if rs else blk.get("ttft_p99_ms"),
+                "queue_wait_p99_ms": d["queue_wait"].quantile_ms(99),
+                "slo_pass": grade.get("pass") if grade.get("configured")
+                else None,
+                "violated": sorted(m for m, v in
+                                   (grade.get("violated") or {}).items()
+                                   if v),
+            })
+
+    # the autoscaler's scale-event timeline, straight from the live rollup
+    autoscaler = (fleet or {}).get("autoscaler")
+
     critical = slowest_requests(wide, top_k=top_k)
 
     strip = lambda r: {k: v for k, v in r.items() if not k.startswith("_")}
@@ -185,6 +228,8 @@ def summarize(wide, fleet=None, targets_ms=None, top_k=5):
         # per-request view
         "resilience": ((fleet or {}).get("router") or {}).get("migration"),
         "slo": slo,
+        "tenancy": tenancy,
+        "autoscaler": autoscaler,
         "digest_coherence": coherence,
         "critical_paths": critical,
         "pools": pools,
@@ -237,6 +282,32 @@ def print_report(summary):
               f"[{res.get('replica_kills', 0)} kills / "
               f"{res.get('replica_stalls', 0)} stalls fired]")
 
+    if summary.get("tenancy"):
+        ms = lambda v: "-" if v is None else f"{v:.1f}"
+        print("\nper-tenant SLO grades:")
+        print("| tenant | class | reqs | finished | shed | preempt "
+              "| ttft p99 ms | queue p99 ms | grade |")
+        print("|---|---|---|---|---|---|---|---|---|")
+        for t in summary["tenancy"]:
+            grade = "-" if t["slo_pass"] is None else (
+                "PASS" if t["slo_pass"]
+                else "FAIL (" + ", ".join(t["violated"]) + ")")
+            print(f"| {t['tenant']} | {t['class']} | {t['requests']} "
+                  f"| {t['finished']} | {t['shed']} | {t['preemptions']} "
+                  f"| {ms(t['ttft_p99_ms'])} "
+                  f"| {ms(t['queue_wait_p99_ms'])} | {grade} |")
+
+    auto = summary.get("autoscaler")
+    if auto and auto.get("enabled"):
+        print(f"\nautoscaler: {auto.get('scale_ups', 0)} ups / "
+              f"{auto.get('scale_downs', 0)} downs, "
+              f"{auto.get('active_replicas')}/{auto.get('fleet_size')} "
+              f"replicas active (floor {auto.get('min_replicas')})")
+        for ev in auto.get("events") or []:
+            print(f"  t={ev['t']:.3f} {ev['action']:>4} replica{ev['replica']}"
+                  f" [{ev['group']}] burn={ev['burn']:.2f}"
+                  f" queue={ev['queue_depth']:.1f} -> {ev['active']} active")
+
     slo = summary["slo"]
     if slo["configured"]:
         for m, target in slo["targets_ms"].items():
@@ -283,21 +354,26 @@ def print_report(summary):
 
 
 def _selftest_wide_events(planted):
-    """Deterministic synthetic fleet: 2 replicas x 20 requests with smooth
-    sub-target latencies. The planted twin grows a slow tail on replica1 —
+    """Deterministic synthetic fleet: 2 replicas x 20 requests, two tenants
+    (t-int interactive / t-batch batch, alternating), smooth sub-target
+    latencies. The planted twin STARVES the batch tenant on replica1 —
     queue-wait-dominated TTFTs far over the 2000 ms target plus a
-    preemption replay burst — so ``--fail-on slo`` exits 3; the clean twin
-    exits 0. (The program_lint/health_report planted/clean idiom.)"""
+    preemption replay burst, all landing on t-batch — so the per-tenant
+    grade table shows t-batch FAILING and ``--fail-on slo`` exits 3; the
+    clean twin exits 0. (The program_lint/health_report planted/clean
+    idiom.)"""
     wide = {}
     rid = 0
     for rep in range(2):
         for i in range(20):
+            cls = "batch" if i % 2 else "interactive"
             ttft = 0.4 + 0.02 * ((i * 7 + rep * 3) % 10)   # 400-600 ms
             queue = 0.1 + 0.01 * (i % 5)
             preempted = 0.0
             preemptions = replay = 0
-            if planted and rep == 1 and i >= 16:
-                # the planted defect: a preemption-thrashed tail
+            if planted and rep == 1 and i >= 12 and cls == "batch":
+                # the planted defect: the batch tenant starved behind a
+                # preemption-thrashed interactive burst
                 ttft = 6.0 + 0.5 * i
                 queue = 4.0
                 preempted = 1.5
@@ -305,6 +381,8 @@ def _selftest_wide_events(planted):
             wide[rid] = {
                 "request_id": rid, "trace_id": f"req-{rid:06d}",
                 "state": "finished", "replica": f"replica{rep}",
+                "tenant_id": "t-batch" if cls == "batch" else "t-int",
+                "tenant_class": cls,
                 "routing": {"replica": rep, "policy": "least_loaded",
                             "scores": {"0": 0.1, "1": 0.2},
                             "affinity": None, "rebalanced": False},
@@ -388,11 +466,20 @@ def main(argv=None):
         print("DIGEST COHERENCE FAILED: trace-derived digests do not match "
               "the live fleet.json snapshots", file=sys.stderr)
         return 2
-    if args.fail_on == "slo" and summary["slo"]["configured"] \
-            and not summary["slo"]["pass"]:
-        bad = [m for m, v in summary["slo"]["violated"].items() if v]
-        print(f"FAIL: SLO violated for {bad}", file=sys.stderr)
-        return 3
+    if args.fail_on == "slo":
+        if summary["slo"]["configured"] and not summary["slo"]["pass"]:
+            bad = [m for m, v in summary["slo"]["violated"].items() if v]
+            print(f"FAIL: SLO violated for {bad}", file=sys.stderr)
+            return 3
+        # a tenant can starve while the fleet aggregate stays green — the
+        # per-tenant grades gate too (weighted-fair bounds starvation by
+        # construction; a FAIL here means QoS is actually broken)
+        starved = [t["tenant"] for t in (summary.get("tenancy") or [])
+                   if t["slo_pass"] is False]
+        if starved:
+            print(f"FAIL: per-tenant SLO violated for {starved}",
+                  file=sys.stderr)
+            return 3
     return 0
 
 
